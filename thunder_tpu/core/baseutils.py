@@ -1,0 +1,84 @@
+"""Small shared utilities for the thunder_tpu core.
+
+Capability parity notes: mirrors the role of the reference's
+``thunder/core/baseutils.py`` (``check()`` error helper and friends) but is a
+fresh, minimal TPU-first implementation.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from typing import Any
+
+
+class ThunderTPUError(RuntimeError):
+    """Base error for thunder_tpu."""
+
+
+def check(cond: Any, msg: str | Callable[[], str], exc_type: type = RuntimeError) -> None:
+    """Raise ``exc_type`` with ``msg`` (string or thunk) when ``cond`` is falsy."""
+    if not cond:
+        raise exc_type(msg() if callable(msg) else msg)
+
+
+def check_type(x: Any, types: type | tuple[type, ...], name: str = "value") -> None:
+    if not isinstance(x, types):
+        raise TypeError(f"{name} expected {types}, got {type(x).__name__}: {x!r}")
+
+
+def is_collection(x: Any) -> bool:
+    return isinstance(x, (tuple, list, dict))
+
+
+def sequencify(x: Any) -> Sequence:
+    if x is None:
+        return ()
+    if isinstance(x, (tuple, list)):
+        return x
+    return (x,)
+
+
+def canonicalize_dim(ndim: int, dim: int) -> int:
+    check(-ndim <= dim < max(ndim, 1), lambda: f"dim {dim} out of range for ndim {ndim}", IndexError)
+    return dim + ndim if dim < 0 else dim
+
+
+def canonicalize_dims(ndim: int, dims: int | Sequence[int]) -> tuple[int, ...]:
+    if isinstance(dims, int):
+        return (canonicalize_dim(ndim, dims),)
+    return tuple(canonicalize_dim(ndim, d) for d in dims)
+
+
+class OrderedSet:
+    """Insertion-ordered set (dict-backed)."""
+
+    def __init__(self, items=()):
+        self._d = dict.fromkeys(items)
+
+    def add(self, x):
+        self._d[x] = None
+
+    def update(self, items):
+        for x in items:
+            self._d[x] = None
+
+    def discard(self, x):
+        self._d.pop(x, None)
+
+    def remove(self, x):
+        del self._d[x]
+
+    def __contains__(self, x):
+        return x in self._d
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self):
+        return len(self._d)
+
+    def __bool__(self):
+        return bool(self._d)
+
+    def __repr__(self):
+        return f"OrderedSet({list(self._d)})"

@@ -1,0 +1,76 @@
+"""Codegen helpers: turning trace values into printable / compilable Python.
+
+Reference parity: ``thunder/core/codeutils.py`` (SigInfo, printable-value
+handling). Traces print as real Python programs that can be compiled and
+executed — thunder's signature capability (``thunder/core/trace.py:320,444``).
+"""
+
+from __future__ import annotations
+
+import keyword
+from typing import Any
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.devices import Device, MeshSpec
+from thunder_tpu.core.proxies import AnyProxy, NumberProxy, Proxy, StringProxy, TensorProxy
+
+
+def sanitize_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    if not out or out[0].isdigit() or keyword.iskeyword(out):
+        out = "_" + out
+    return out
+
+
+def prettyprint(x: Any) -> str:
+    """Print a trace value as Python source. Proxies print as their names."""
+    if isinstance(x, Proxy):
+        return x.name
+    if isinstance(x, dtypes.dtype):
+        return f"dtypes.{x.name}"
+    if isinstance(x, Device):
+        return f'devices.Device("{x}")'
+    if isinstance(x, MeshSpec):
+        kw = ", ".join(f"{n}={s}" for n, s in zip(x.axis_names, x.axis_sizes))
+        return f"devices.MeshSpec.make({kw})"
+    if isinstance(x, (bool, int, float, complex, str, bytes)) or x is None:
+        return repr(x)
+    if x is Ellipsis:
+        return "..."
+    if isinstance(x, slice):
+        return f"slice({prettyprint(x.start)}, {prettyprint(x.stop)}, {prettyprint(x.step)})"
+    if isinstance(x, tuple):
+        inner = ", ".join(prettyprint(i) for i in x)
+        return f"({inner},)" if len(x) == 1 else f"({inner})"
+    if isinstance(x, list):
+        return "[" + ", ".join(prettyprint(i) for i in x) + "]"
+    if isinstance(x, dict):
+        return "{" + ", ".join(f"{prettyprint(k)}: {prettyprint(v)}" for k, v in x.items()) + "}"
+    if isinstance(x, type):
+        return x.__name__
+    if callable(x) and hasattr(x, "__name__"):
+        return x.__name__
+    raise NotImplementedError(f"cannot prettyprint {type(x)}: {x!r}")
+
+
+def type_comment(x: Any) -> str | None:
+    if isinstance(x, TensorProxy):
+        return f'{x.name}: "{x.type_string()}"'
+    if isinstance(x, NumberProxy):
+        return f'{x.name}: "{x.type_string()} {x.value}"'
+    if isinstance(x, StringProxy):
+        return f'{x.name}: "str {x.value!r}"'
+    if isinstance(x, AnyProxy):
+        return f'{x.name}: "Any"'
+    return None
+
+
+class SigInfo:
+    """Captured signature of the traced function: ordered arg names."""
+
+    def __init__(self, name: str, args: list[str]):
+        self.name = sanitize_name(name)
+        self.args = list(args)
+
+    def prettyprint(self) -> str:
+        return f"def {self.name}({', '.join(self.args)}):"

@@ -1,0 +1,152 @@
+"""thunder_tpu dtype system.
+
+A small, hashable dtype lattice that maps 1:1 onto JAX/XLA dtypes, including
+bfloat16 and the fp8 variants used by the FP8-GEMM executor.
+
+Capability parity: the reference models dtypes with weak/strong variants for
+torch scalar-promotion semantics (``thunder/core/dtypes.py``). On TPU we keep
+a single strong dtype per element type plus explicit ``weak`` flag handling in
+the type-promotion logic of the ops layer (JAX-style promotion).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class dtype:
+    """An element type. Instances are singletons; compare with ``is`` or ``==``."""
+
+    __slots__ = ("name", "jax", "bytes", "is_float", "is_complex", "is_signed", "is_bool", "is_int", "is_fp8")
+
+    def __init__(self, name: str, jax_dtype, nbytes: int, *, is_float=False, is_complex=False,
+                 is_signed=True, is_bool=False, is_int=False, is_fp8=False):
+        self.name = name
+        self.jax = jnp.dtype(jax_dtype) if jax_dtype is not None else None
+        self.bytes = nbytes
+        self.is_float = is_float
+        self.is_complex = is_complex
+        self.is_signed = is_signed
+        self.is_bool = is_bool
+        self.is_int = is_int
+        self.is_fp8 = is_fp8
+
+    @property
+    def is_inexact(self) -> bool:
+        return self.is_float or self.is_complex
+
+    @property
+    def is_exact(self) -> bool:
+        return self.is_int or self.is_bool
+
+    def __repr__(self) -> str:
+        return f"dtypes.{self.name}"
+
+    def shortname(self) -> str:
+        return _SHORTNAMES.get(self.name, self.name)
+
+
+bool8 = dtype("bool8", jnp.bool_, 1, is_bool=True, is_signed=False)
+uint8 = dtype("uint8", jnp.uint8, 1, is_int=True, is_signed=False)
+uint16 = dtype("uint16", jnp.uint16, 2, is_int=True, is_signed=False)
+uint32 = dtype("uint32", jnp.uint32, 4, is_int=True, is_signed=False)
+uint64 = dtype("uint64", jnp.uint64, 8, is_int=True, is_signed=False)
+int8 = dtype("int8", jnp.int8, 1, is_int=True)
+int16 = dtype("int16", jnp.int16, 2, is_int=True)
+int32 = dtype("int32", jnp.int32, 4, is_int=True)
+int64 = dtype("int64", jnp.int64, 8, is_int=True)
+float8_e4m3fn = dtype("float8_e4m3fn", jnp.float8_e4m3fn, 1, is_float=True, is_fp8=True)
+float8_e5m2 = dtype("float8_e5m2", jnp.float8_e5m2, 1, is_float=True, is_fp8=True)
+float16 = dtype("float16", jnp.float16, 2, is_float=True)
+bfloat16 = dtype("bfloat16", jnp.bfloat16, 2, is_float=True)
+float32 = dtype("float32", jnp.float32, 4, is_float=True)
+float64 = dtype("float64", jnp.float64, 8, is_float=True)
+complex64 = dtype("complex64", jnp.complex64, 8, is_complex=True)
+complex128 = dtype("complex128", jnp.complex128, 16, is_complex=True)
+
+all_dtypes: tuple[dtype, ...] = (
+    bool8, uint8, uint16, uint32, uint64, int8, int16, int32, int64,
+    float8_e4m3fn, float8_e5m2, float16, bfloat16, float32, float64,
+    complex64, complex128,
+)
+
+_SHORTNAMES = {
+    "bool8": "b8", "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+    "int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64",
+    "float8_e4m3fn": "f8e4m3", "float8_e5m2": "f8e5m2",
+    "float16": "f16", "bfloat16": "bf16", "float32": "f32", "float64": "f64",
+    "complex64": "c64", "complex128": "c128",
+}
+
+_BY_NAME = {d.name: d for d in all_dtypes}
+_BY_JAX = {d.jax: d for d in all_dtypes}
+
+# Python scalar types → default dtypes (JAX x64 disabled defaults)
+_PY_TO_DTYPE = {bool: bool8, int: int32, float: float32, complex: complex64}
+
+
+def to_jax(d: "dtype | Any"):
+    """thunder_tpu dtype (or python type) → jnp dtype."""
+    if isinstance(d, dtype):
+        return d.jax
+    if d in _PY_TO_DTYPE:
+        return _PY_TO_DTYPE[d].jax
+    return jnp.dtype(d)
+
+
+def to_dtype(x: Any) -> dtype:
+    """Anything dtype-like (jnp dtype, np dtype, str, python type, array) → thunder_tpu dtype."""
+    if isinstance(x, dtype):
+        return x
+    if isinstance(x, str):
+        if x in _BY_NAME:
+            return _BY_NAME[x]
+        return _BY_JAX[jnp.dtype(x)]
+    if isinstance(x, type) and x in _PY_TO_DTYPE:
+        return _PY_TO_DTYPE[x]
+    if hasattr(x, "dtype"):
+        return _BY_JAX[jnp.dtype(x.dtype)]
+    return _BY_JAX[jnp.dtype(x)]
+
+
+def corresponding_real_dtype(d: dtype) -> dtype:
+    if d is complex64:
+        return float32
+    if d is complex128:
+        return float64
+    return d
+
+
+def finfo(d: dtype):
+    return jnp.finfo(d.jax)
+
+
+def iinfo(d: dtype):
+    return jnp.iinfo(d.jax)
+
+
+def promote(*ds: "dtype | type") -> dtype:
+    """Type promotion following JAX/numpy semantics (python scalars are weak)."""
+    jds = []
+    for d in ds:
+        if isinstance(d, dtype):
+            jds.append(d.jax)
+        elif d in _PY_TO_DTYPE:
+            # weak scalar: represent by python scalar value for jnp promotion
+            jds.append(d(0))
+        else:
+            jds.append(jnp.dtype(d))
+    return _BY_JAX[jnp.dtype(jnp.result_type(*jds))]
+
+
+def is_dtype_like(x: Any) -> bool:
+    if isinstance(x, dtype):
+        return True
+    try:
+        np.dtype(x)
+        return True
+    except Exception:
+        return False

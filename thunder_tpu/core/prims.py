@@ -1,0 +1,671 @@
+"""The primitive operation set.
+
+~110 prims, chosen TPU-first: ``dot_general`` (XLA's native contraction) is
+the core matmul prim that ``matmul``/``linear``/``einsum`` decompose into;
+shape prims mirror XLA/StableHLO ops (broadcast_in_dim, slice, pad,
+transpose); RNG is functional (explicit threefry keys, split + sample prims)
+so compiled programs are reproducible and cacheable; there are no stride or
+memory-format prims (XLA owns layout).
+
+Reference parity: ``thunder/core/prims.py:96-270`` defines ~154 prims
+(PrimIDs). CUDA-isms dropped: STRIDE_ORDER, CUDA device prims. Added beyond
+the reference: SHARDING_CONSTRAINT, functional RNG keys, DETACH.
+Collective prims live in ``thunder_tpu/distributed/prims.py``.
+
+Prim metas only compute output *metadata* (proxies); they enforce the strict
+contracts (same shapes for elementwise, explicit broadcasts) — broadcasting
+and type promotion happen in the ops layer (``thunder_tpu/ops``), mirroring
+the reference's clang/prims split.
+"""
+
+from __future__ import annotations
+
+import math
+from enum import Enum, auto
+from numbers import Number
+from typing import Any, Sequence
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check, canonicalize_dims
+from thunder_tpu.core.devices import Device
+from thunder_tpu.core.proxies import (
+    AnyProxy,
+    NumberProxy,
+    Proxy,
+    StringProxy,
+    TensorProxy,
+    pyval,
+)
+from thunder_tpu.core.symbol import Symbol
+
+
+class PrimIDs(Enum):
+    # utility
+    PYTHON_RETURN = auto(); COMMENT = auto(); PYTHON_DEL = auto(); PYTHON_PRINT = auto(); SINK = auto()
+    # prologue check/unpack
+    UNPACK_TRIVIAL = auto(); CHECK_TENSOR_SHAPE_AND_METADATA = auto()
+    CHECK_NUMBER_TYPE_AND_VALUE = auto(); CHECK_STRING_VALUE = auto(); CHECK_LITERAL_LIKE = auto()
+    # dtype/device/sharding
+    CONVERT_ELEMENT_TYPE = auto(); DEVICE_PUT = auto(); SHARDING_CONSTRAINT = auto(); DETACH = auto()
+    # creation
+    FULL = auto(); IOTA = auto()
+    # rng (functional, keyed)
+    RNG_KEY = auto(); RNG_SPLIT = auto(); UNIFORM = auto(); NORMAL = auto(); RANDOM_BITS = auto()
+    # shape
+    BROADCAST_IN_DIM = auto(); CAT = auto(); FLIP = auto(); RESHAPE = auto(); SLICE = auto()
+    SQUEEZE = auto(); TRANSPOSE = auto(); PAD = auto()
+    TAKE = auto(); TAKE_ALONG_AXIS = auto(); SCATTER_ADD = auto(); INDEX_PUT = auto()
+    DYNAMIC_SLICE = auto(); DYNAMIC_UPDATE_SLICE = auto()
+    # elementwise unary
+    ABS = auto(); ACOS = auto(); ACOSH = auto(); ASIN = auto(); ASINH = auto(); ATAN = auto()
+    ATANH = auto(); BITWISE_NOT = auto(); CEIL = auto(); COS = auto(); COSH = auto(); ERF = auto()
+    ERFC = auto(); ERFINV = auto(); EXP = auto(); EXP2 = auto(); EXPM1 = auto(); FLOOR = auto()
+    ISFINITE = auto(); ISINF = auto(); ISNAN = auto(); LGAMMA = auto(); LOG = auto(); LOG10 = auto()
+    LOG1P = auto(); LOG2 = auto(); LOGICAL_NOT = auto(); NEG = auto(); RECIPROCAL = auto()
+    ROUND = auto(); RSQRT = auto(); SIGN = auto(); SIGNBIT = auto(); SIN = auto(); SINH = auto()
+    SQRT = auto(); TAN = auto(); TANH = auto(); TRUNC = auto()
+    # elementwise binary
+    ADD = auto(); ATAN2 = auto(); BITWISE_AND = auto(); BITWISE_OR = auto(); BITWISE_XOR = auto()
+    COPYSIGN = auto(); DIV = auto(); EQ = auto(); FMOD = auto(); GE = auto(); GT = auto(); LE = auto()
+    LT = auto(); MAXIMUM = auto(); MINIMUM = auto(); MUL = auto(); NE = auto(); POW = auto()
+    REMAINDER = auto(); SHIFT_LEFT = auto(); SHIFT_RIGHT = auto(); SUB = auto()
+    # ternary
+    WHERE = auto()
+    # reductions
+    SUM = auto(); PROD = auto(); AMAX = auto(); AMIN = auto(); ARGMAX = auto(); ARGMIN = auto()
+    CUMSUM = auto(); SORT = auto(); ARGSORT = auto(); TOPK = auto()
+    # linalg / nn
+    DOT_GENERAL = auto(); CONVOLUTION = auto()
+    # host interaction
+    ITEM = auto()
+
+
+class OpTags(Enum):
+    SHAPE_OP = auto()
+    REDUCTION_OP = auto()
+    RANDOM_OP = auto()
+    MATMUL_OP = auto()
+    ELEMENTWISE_OP = auto()
+    DONT_DCE = auto()
+    COLLECTIVE_OP = auto()
+    UNPACK_OP = auto()
+    CHECK_OP = auto()
+    DEVICE_SYNC_OP = auto()
+
+
+_prims_by_id: dict[Any, Symbol] = {}
+
+
+def get_prim(prim_id) -> Symbol | None:
+    return _prims_by_id.get(prim_id)
+
+
+def all_prims() -> dict[Any, Symbol]:
+    return dict(_prims_by_id)
+
+
+def make_prim(prim_id, name: str, meta, *, tags: Sequence[OpTags] = (), python_impl=None) -> Symbol:
+    sym = Symbol(name, meta, id=prim_id, is_prim=True, tags=frozenset(tags), python_impl=python_impl)
+    _prims_by_id[prim_id] = sym
+    return sym
+
+
+# ---------------------------------------------------------------------------
+# meta helpers
+# ---------------------------------------------------------------------------
+
+def _tensor_args(args) -> list[TensorProxy]:
+    return [a for a in args if isinstance(a, TensorProxy)]
+
+
+def _same_shape(*ts: TensorProxy) -> tuple[int, ...]:
+    shapes = {t.shape for t in ts}
+    check(len(shapes) <= 1, lambda: f"elementwise prim requires equal shapes, got {shapes}")
+    return ts[0].shape
+
+
+def _result_dtype(*args) -> dtypes.dtype:
+    return dtypes.promote(*[a.dtype if isinstance(a, TensorProxy) else type(pyval(a)) for a in args])
+
+
+def _ew_unary_meta(a, *, out_dtype: dtypes.dtype | None = None) -> TensorProxy:
+    check(isinstance(a, TensorProxy), lambda: f"expected TensorProxy, got {type(a)}")
+    return TensorProxy(shape=a.shape, dtype=out_dtype or a.dtype, device=a.device)
+
+
+def _make_ew_unary(pid, name, *, out_dtype=None, float_only=False):
+    def meta(a):
+        if float_only:
+            check(a.dtype.is_inexact, lambda: f"{name} requires floating dtype, got {a.dtype}")
+        return _ew_unary_meta(a, out_dtype=out_dtype)
+
+    return make_prim(pid, name, meta, tags=(OpTags.ELEMENTWISE_OP,))
+
+
+def _ew_binary_meta_factory(name, *, bool_out=False):
+    def meta(a, b):
+        ts = _tensor_args((a, b))
+        check(len(ts) >= 1, lambda: f"{name}: at least one operand must be a tensor")
+        shape = _same_shape(*ts)
+        dtype = dtypes.bool8 if bool_out else _result_dtype(a, b)
+        return TensorProxy(shape=shape, dtype=dtype, device=ts[0].device)
+
+    return meta
+
+
+def _make_ew_binary(pid, name, *, bool_out=False):
+    return make_prim(pid, name, _ew_binary_meta_factory(name, bool_out=bool_out),
+                     tags=(OpTags.ELEMENTWISE_OP,))
+
+
+# ---------------------------------------------------------------------------
+# utility prims
+# ---------------------------------------------------------------------------
+
+def _return_meta(*args):
+    return None
+
+
+python_return = make_prim(PrimIDs.PYTHON_RETURN, "python_return", lambda v: None, tags=(OpTags.DONT_DCE,))
+comment = make_prim(PrimIDs.COMMENT, "comment", lambda s: None, tags=(OpTags.DONT_DCE,))
+python_del = make_prim(PrimIDs.PYTHON_DEL, "python_del", lambda *args: None, tags=(OpTags.DONT_DCE,))
+python_print = make_prim(PrimIDs.PYTHON_PRINT, "python_print", lambda *args: None, tags=(OpTags.DONT_DCE,))
+sink = make_prim(PrimIDs.SINK, "sink", lambda *args, **kwargs: None, tags=(OpTags.DONT_DCE,))
+
+
+# ---------------------------------------------------------------------------
+# prologue check/unpack prims (the guard program; reference CHECK_*/UNPACK_*)
+# ---------------------------------------------------------------------------
+
+def _unpack_trivial_meta(x=None, *, name: str):
+    return x
+
+
+unpack_trivial = make_prim(PrimIDs.UNPACK_TRIVIAL, "unpack_trivial", _unpack_trivial_meta,
+                           tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE))
+
+
+def _check_tensor_meta(t: TensorProxy, shape: tuple, dtype: dtypes.dtype, device_str: str):
+    return None
+
+
+check_tensor_shape_and_metadata = make_prim(
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, "check_tensor_shape_and_metadata", _check_tensor_meta,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+check_number_type_and_value = make_prim(
+    PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE, "check_number_type_and_value", lambda n, v: None,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+check_string_value = make_prim(
+    PrimIDs.CHECK_STRING_VALUE, "check_string_value", lambda s, v: None,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+check_literal_like = make_prim(
+    PrimIDs.CHECK_LITERAL_LIKE, "check_literal_like", lambda x, v: None,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+# ---------------------------------------------------------------------------
+# dtype / device / sharding
+# ---------------------------------------------------------------------------
+
+def _convert_element_type_meta(a: TensorProxy, dtype: dtypes.dtype) -> TensorProxy:
+    check(isinstance(a, TensorProxy), lambda: f"convert_element_type expects a tensor, got {type(a)}")
+    dtype = dtypes.to_dtype(dtype)
+    return TensorProxy(shape=a.shape, dtype=dtype, device=a.device)
+
+
+convert_element_type = make_prim(PrimIDs.CONVERT_ELEMENT_TYPE, "convert_element_type", _convert_element_type_meta)
+
+
+def _device_put_meta(a: TensorProxy, device: Device) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=device)
+
+
+device_put = make_prim(PrimIDs.DEVICE_PUT, "device_put", _device_put_meta)
+
+
+def _sharding_constraint_meta(a: TensorProxy, spec: tuple) -> TensorProxy:
+    """spec: tuple of mesh-axis-name (str), tuple of names, or None per dim."""
+    check(len(spec) <= a.ndim, lambda: f"sharding spec {spec} longer than rank {a.ndim}")
+    return a.replace(sharding=tuple(spec))
+
+
+sharding_constraint = make_prim(PrimIDs.SHARDING_CONSTRAINT, "sharding_constraint", _sharding_constraint_meta)
+
+
+def _detach_meta(a: TensorProxy) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+detach = make_prim(PrimIDs.DETACH, "detach", _detach_meta)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def _full_meta(shape: Sequence[int], fill_value, dtype: dtypes.dtype, device: Device | None = None) -> TensorProxy:
+    from thunder_tpu.core.devices import default_device
+
+    return TensorProxy(shape=tuple(shape), dtype=dtypes.to_dtype(dtype),
+                       device=device or default_device())
+
+
+full = make_prim(PrimIDs.FULL, "full", _full_meta)
+
+
+def _iota_meta(length: int, *, start: int = 0, step: int = 1, dtype: dtypes.dtype = dtypes.int32,
+               device: Device | None = None) -> TensorProxy:
+    from thunder_tpu.core.devices import default_device
+
+    return TensorProxy(shape=(int(pyval(length)),), dtype=dtypes.to_dtype(dtype), device=device or default_device())
+
+
+iota = make_prim(PrimIDs.IOTA, "iota", _iota_meta)
+
+
+# ---------------------------------------------------------------------------
+# rng: functional threefry keys (jax.random compatible)
+# ---------------------------------------------------------------------------
+
+def _rng_key_meta(seed) -> TensorProxy:
+    from thunder_tpu.core.devices import default_device
+
+    return TensorProxy(shape=(2,), dtype=dtypes.uint32, device=default_device())
+
+
+rng_key = make_prim(PrimIDs.RNG_KEY, "rng_key", _rng_key_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _rng_split_meta(key: TensorProxy) -> tuple[TensorProxy, TensorProxy]:
+    return (TensorProxy(shape=(2,), dtype=dtypes.uint32, device=key.device),
+            TensorProxy(shape=(2,), dtype=dtypes.uint32, device=key.device))
+
+
+rng_split = make_prim(PrimIDs.RNG_SPLIT, "rng_split", _rng_split_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _uniform_meta(shape, lo, hi, *, dtype: dtypes.dtype, key: TensorProxy) -> TensorProxy:
+    return TensorProxy(shape=tuple(shape), dtype=dtypes.to_dtype(dtype), device=key.device)
+
+
+uniform = make_prim(PrimIDs.UNIFORM, "uniform", _uniform_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _normal_meta(shape, *, dtype: dtypes.dtype, key: TensorProxy) -> TensorProxy:
+    return TensorProxy(shape=tuple(shape), dtype=dtypes.to_dtype(dtype), device=key.device)
+
+
+normal = make_prim(PrimIDs.NORMAL, "normal", _normal_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _random_bits_meta(shape, *, key: TensorProxy) -> TensorProxy:
+    return TensorProxy(shape=tuple(shape), dtype=dtypes.uint32, device=key.device)
+
+
+random_bits = make_prim(PrimIDs.RANDOM_BITS, "random_bits", _random_bits_meta, tags=(OpTags.RANDOM_OP,))
+
+
+# ---------------------------------------------------------------------------
+# shape prims
+# ---------------------------------------------------------------------------
+
+def _broadcast_in_dim_meta(a: TensorProxy, shape: Sequence[int], broadcast_dimensions: Sequence[int]) -> TensorProxy:
+    shape = tuple(int(pyval(s)) for s in shape)
+    bdims = tuple(broadcast_dimensions)
+    check(len(bdims) == a.ndim, lambda: f"broadcast_in_dim: len(broadcast_dimensions)={len(bdims)} != rank {a.ndim}")
+    for i, d in enumerate(bdims):
+        check(a.shape[i] == 1 or a.shape[i] == shape[d],
+              lambda: f"broadcast_in_dim: input dim {i} (size {a.shape[i]}) incompatible with output dim {d} (size {shape[d]})")
+    return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
+
+
+broadcast_in_dim = make_prim(PrimIDs.BROADCAST_IN_DIM, "broadcast_in_dim", _broadcast_in_dim_meta,
+                             tags=(OpTags.SHAPE_OP,))
+
+
+def _cat_meta(tensors: Sequence[TensorProxy], dim: int) -> TensorProxy:
+    check(len(tensors) > 0, "cat of zero tensors")
+    a = tensors[0]
+    dim = canonicalize_dims(a.ndim, dim)[0]
+    total = 0
+    for t in tensors:
+        check(t.ndim == a.ndim, "cat: rank mismatch")
+        for i in range(a.ndim):
+            if i != dim:
+                check(t.shape[i] == a.shape[i], lambda: f"cat: shape mismatch on dim {i}")
+        total += t.shape[dim]
+    shape = list(a.shape)
+    shape[dim] = total
+    return TensorProxy(shape=tuple(shape), dtype=_result_dtype(*tensors), device=a.device)
+
+
+cat = make_prim(PrimIDs.CAT, "cat", _cat_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _flip_meta(a: TensorProxy, dims: Sequence[int]) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+flip = make_prim(PrimIDs.FLIP, "flip", _flip_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _reshape_meta(a: TensorProxy, shape: Sequence[int]) -> TensorProxy:
+    shape = tuple(int(pyval(s)) for s in shape)
+    check(math.prod(shape) == a.numel,
+          lambda: f"reshape: cannot reshape {a.shape} ({a.numel} elems) to {shape}")
+    return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
+
+
+reshape = make_prim(PrimIDs.RESHAPE, "reshape", _reshape_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _slice_meta(a: TensorProxy, start_indices: Sequence[int], end_indices: Sequence[int],
+                strides: Sequence[int] | None = None) -> TensorProxy:
+    strides = strides or [1] * a.ndim
+    shape = []
+    for s, e, st, dimsz in zip(start_indices, end_indices, strides, a.shape):
+        s, e, st = int(pyval(s)), int(pyval(e)), int(pyval(st))
+        check(0 <= s <= e <= dimsz and st > 0, lambda: f"bad slice [{s}:{e}:{st}] for dim of size {dimsz}")
+        shape.append((e - s + st - 1) // st)
+    return TensorProxy(shape=tuple(shape), dtype=a.dtype, device=a.device)
+
+
+slice_prim = make_prim(PrimIDs.SLICE, "slice_prim", _slice_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _squeeze_meta(a: TensorProxy, dims: Sequence[int]) -> TensorProxy:
+    dims = set(canonicalize_dims(a.ndim, tuple(dims)))
+    for d in dims:
+        check(a.shape[d] == 1, lambda: f"squeeze: dim {d} has size {a.shape[d]} != 1")
+    shape = tuple(s for i, s in enumerate(a.shape) if i not in dims)
+    return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
+
+
+squeeze = make_prim(PrimIDs.SQUEEZE, "squeeze", _squeeze_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _transpose_meta(a: TensorProxy, permutation: Sequence[int]) -> TensorProxy:
+    perm = tuple(permutation)
+    check(sorted(perm) == list(range(a.ndim)), lambda: f"invalid permutation {perm} for rank {a.ndim}")
+    return TensorProxy(shape=tuple(a.shape[p] for p in perm), dtype=a.dtype, device=a.device)
+
+
+transpose = make_prim(PrimIDs.TRANSPOSE, "transpose", _transpose_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _pad_meta(a: TensorProxy, padding_value, padding_config: Sequence[tuple[int, int, int]]) -> TensorProxy:
+    check(len(padding_config) == a.ndim, "pad: config length != rank")
+    shape = []
+    for (lo, hi, interior), s in zip(padding_config, a.shape):
+        shape.append(lo + hi + s + max(0, s - 1) * interior)
+    return TensorProxy(shape=tuple(shape), dtype=a.dtype, device=a.device)
+
+
+pad = make_prim(PrimIDs.PAD, "pad", _pad_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _take_meta(a: TensorProxy, indices: TensorProxy, dim: int) -> TensorProxy:
+    dim = canonicalize_dims(a.ndim, dim)[0]
+    check(indices.dtype.is_int, "take: indices must be integer")
+    shape = a.shape[:dim] + indices.shape + a.shape[dim + 1:]
+    return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
+
+
+take = make_prim(PrimIDs.TAKE, "take", _take_meta)
+
+
+def _take_along_axis_meta(a: TensorProxy, indices: TensorProxy, dim: int) -> TensorProxy:
+    dim = canonicalize_dims(a.ndim, dim)[0]
+    check(indices.ndim == a.ndim, "take_along_axis: rank mismatch")
+    return TensorProxy(shape=indices.shape, dtype=a.dtype, device=a.device)
+
+
+take_along_axis = make_prim(PrimIDs.TAKE_ALONG_AXIS, "take_along_axis", _take_along_axis_meta)
+
+
+def _scatter_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, dim: int) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+scatter_add = make_prim(PrimIDs.SCATTER_ADD, "scatter_add", _scatter_add_meta)
+
+
+def _index_put_meta(a: TensorProxy, indices: Sequence[TensorProxy], values: TensorProxy, accumulate: bool) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+index_put = make_prim(PrimIDs.INDEX_PUT, "index_put", _index_put_meta)
+
+
+def _dynamic_slice_meta(a: TensorProxy, start_indices: Sequence, slice_sizes: Sequence[int]) -> TensorProxy:
+    return TensorProxy(shape=tuple(int(s) for s in slice_sizes), dtype=a.dtype, device=a.device)
+
+
+dynamic_slice = make_prim(PrimIDs.DYNAMIC_SLICE, "dynamic_slice", _dynamic_slice_meta)
+
+
+def _dynamic_update_slice_meta(a: TensorProxy, update: TensorProxy, start_indices: Sequence) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+dynamic_update_slice = make_prim(PrimIDs.DYNAMIC_UPDATE_SLICE, "dynamic_update_slice", _dynamic_update_slice_meta)
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+
+abs = _make_ew_unary(PrimIDs.ABS, "abs")
+acos = _make_ew_unary(PrimIDs.ACOS, "acos", float_only=True)
+acosh = _make_ew_unary(PrimIDs.ACOSH, "acosh", float_only=True)
+asin = _make_ew_unary(PrimIDs.ASIN, "asin", float_only=True)
+asinh = _make_ew_unary(PrimIDs.ASINH, "asinh", float_only=True)
+atan = _make_ew_unary(PrimIDs.ATAN, "atan", float_only=True)
+atanh = _make_ew_unary(PrimIDs.ATANH, "atanh", float_only=True)
+bitwise_not = _make_ew_unary(PrimIDs.BITWISE_NOT, "bitwise_not")
+ceil = _make_ew_unary(PrimIDs.CEIL, "ceil")
+cos = _make_ew_unary(PrimIDs.COS, "cos", float_only=True)
+cosh = _make_ew_unary(PrimIDs.COSH, "cosh", float_only=True)
+erf = _make_ew_unary(PrimIDs.ERF, "erf", float_only=True)
+erfc = _make_ew_unary(PrimIDs.ERFC, "erfc", float_only=True)
+erfinv = _make_ew_unary(PrimIDs.ERFINV, "erfinv", float_only=True)
+exp = _make_ew_unary(PrimIDs.EXP, "exp", float_only=True)
+exp2 = _make_ew_unary(PrimIDs.EXP2, "exp2", float_only=True)
+expm1 = _make_ew_unary(PrimIDs.EXPM1, "expm1", float_only=True)
+floor = _make_ew_unary(PrimIDs.FLOOR, "floor")
+isfinite = _make_ew_unary(PrimIDs.ISFINITE, "isfinite", out_dtype=dtypes.bool8)
+isinf = _make_ew_unary(PrimIDs.ISINF, "isinf", out_dtype=dtypes.bool8)
+isnan = _make_ew_unary(PrimIDs.ISNAN, "isnan", out_dtype=dtypes.bool8)
+lgamma = _make_ew_unary(PrimIDs.LGAMMA, "lgamma", float_only=True)
+log = _make_ew_unary(PrimIDs.LOG, "log", float_only=True)
+log10 = _make_ew_unary(PrimIDs.LOG10, "log10", float_only=True)
+log1p = _make_ew_unary(PrimIDs.LOG1P, "log1p", float_only=True)
+log2 = _make_ew_unary(PrimIDs.LOG2, "log2", float_only=True)
+logical_not = _make_ew_unary(PrimIDs.LOGICAL_NOT, "logical_not", out_dtype=dtypes.bool8)
+neg = _make_ew_unary(PrimIDs.NEG, "neg")
+reciprocal = _make_ew_unary(PrimIDs.RECIPROCAL, "reciprocal", float_only=True)
+round = _make_ew_unary(PrimIDs.ROUND, "round")
+rsqrt = _make_ew_unary(PrimIDs.RSQRT, "rsqrt", float_only=True)
+sign = _make_ew_unary(PrimIDs.SIGN, "sign")
+signbit = _make_ew_unary(PrimIDs.SIGNBIT, "signbit", out_dtype=dtypes.bool8)
+sin = _make_ew_unary(PrimIDs.SIN, "sin", float_only=True)
+sinh = _make_ew_unary(PrimIDs.SINH, "sinh", float_only=True)
+sqrt = _make_ew_unary(PrimIDs.SQRT, "sqrt", float_only=True)
+tan = _make_ew_unary(PrimIDs.TAN, "tan", float_only=True)
+tanh = _make_ew_unary(PrimIDs.TANH, "tanh", float_only=True)
+trunc = _make_ew_unary(PrimIDs.TRUNC, "trunc")
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+
+add = _make_ew_binary(PrimIDs.ADD, "add")
+atan2 = _make_ew_binary(PrimIDs.ATAN2, "atan2")
+bitwise_and = _make_ew_binary(PrimIDs.BITWISE_AND, "bitwise_and")
+bitwise_or = _make_ew_binary(PrimIDs.BITWISE_OR, "bitwise_or")
+bitwise_xor = _make_ew_binary(PrimIDs.BITWISE_XOR, "bitwise_xor")
+copysign = _make_ew_binary(PrimIDs.COPYSIGN, "copysign")
+div = _make_ew_binary(PrimIDs.DIV, "div")
+eq = _make_ew_binary(PrimIDs.EQ, "eq", bool_out=True)
+fmod = _make_ew_binary(PrimIDs.FMOD, "fmod")
+ge = _make_ew_binary(PrimIDs.GE, "ge", bool_out=True)
+gt = _make_ew_binary(PrimIDs.GT, "gt", bool_out=True)
+le = _make_ew_binary(PrimIDs.LE, "le", bool_out=True)
+lt = _make_ew_binary(PrimIDs.LT, "lt", bool_out=True)
+maximum = _make_ew_binary(PrimIDs.MAXIMUM, "maximum")
+minimum = _make_ew_binary(PrimIDs.MINIMUM, "minimum")
+mul = _make_ew_binary(PrimIDs.MUL, "mul")
+ne = _make_ew_binary(PrimIDs.NE, "ne", bool_out=True)
+pow = _make_ew_binary(PrimIDs.POW, "pow")
+remainder = _make_ew_binary(PrimIDs.REMAINDER, "remainder")
+shift_left = _make_ew_binary(PrimIDs.SHIFT_LEFT, "shift_left")
+shift_right = _make_ew_binary(PrimIDs.SHIFT_RIGHT, "shift_right")
+sub = _make_ew_binary(PrimIDs.SUB, "sub")
+
+
+# ---------------------------------------------------------------------------
+# ternary
+# ---------------------------------------------------------------------------
+
+def _where_meta(pred, a, b) -> TensorProxy:
+    ts = _tensor_args((pred, a, b))
+    check(len(ts) >= 1, "where: at least one operand must be a tensor")
+    shape = _same_shape(*ts)
+    dtype = _result_dtype(a, b)
+    return TensorProxy(shape=shape, dtype=dtype, device=ts[0].device)
+
+
+where = make_prim(PrimIDs.WHERE, "where", _where_meta, tags=(OpTags.ELEMENTWISE_OP,))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduction_shape(a: TensorProxy, dims: Sequence[int]) -> tuple[int, ...]:
+    dims = set(dims)
+    return tuple(s for i, s in enumerate(a.shape) if i not in dims)
+
+
+def _make_reduction(pid, name, *, out_dtype=None):
+    def meta(a: TensorProxy, dims: Sequence[int]) -> TensorProxy:
+        dims = canonicalize_dims(a.ndim, tuple(dims))
+        return TensorProxy(shape=_reduction_shape(a, dims), dtype=out_dtype or a.dtype, device=a.device)
+
+    return make_prim(pid, name, meta, tags=(OpTags.REDUCTION_OP,))
+
+
+sum = _make_reduction(PrimIDs.SUM, "sum")
+prod = _make_reduction(PrimIDs.PROD, "prod")
+amax = _make_reduction(PrimIDs.AMAX, "amax")
+amin = _make_reduction(PrimIDs.AMIN, "amin")
+
+
+def _arg_reduction_meta_factory(name):
+    def meta(a: TensorProxy, dim: int | None) -> TensorProxy:
+        if dim is None:
+            return TensorProxy(shape=(), dtype=dtypes.int32, device=a.device)
+        d = canonicalize_dims(a.ndim, dim)[0]
+        return TensorProxy(shape=_reduction_shape(a, (d,)), dtype=dtypes.int32, device=a.device)
+
+    return meta
+
+
+argmax = make_prim(PrimIDs.ARGMAX, "argmax", _arg_reduction_meta_factory("argmax"), tags=(OpTags.REDUCTION_OP,))
+argmin = make_prim(PrimIDs.ARGMIN, "argmin", _arg_reduction_meta_factory("argmin"), tags=(OpTags.REDUCTION_OP,))
+
+
+def _cumsum_meta(a: TensorProxy, dim: int) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", _cumsum_meta)
+
+
+def _sort_meta(a: TensorProxy, dim: int, descending: bool) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+sort = make_prim(PrimIDs.SORT, "sort", _sort_meta)
+
+
+def _argsort_meta(a: TensorProxy, dim: int, descending: bool) -> TensorProxy:
+    return TensorProxy(shape=a.shape, dtype=dtypes.int32, device=a.device)
+
+
+argsort = make_prim(PrimIDs.ARGSORT, "argsort", _argsort_meta)
+
+
+def _topk_meta(a: TensorProxy, k: int, dim: int) -> tuple[TensorProxy, TensorProxy]:
+    dim = canonicalize_dims(a.ndim, dim)[0]
+    k = int(pyval(k))
+    shape = list(a.shape)
+    shape[dim] = k
+    return (TensorProxy(shape=tuple(shape), dtype=a.dtype, device=a.device),
+            TensorProxy(shape=tuple(shape), dtype=dtypes.int32, device=a.device))
+
+
+topk = make_prim(PrimIDs.TOPK, "topk", _topk_meta)
+
+
+# ---------------------------------------------------------------------------
+# linalg: dot_general is the core contraction prim (maps 1:1 to lax.dot_general,
+# which XLA tiles onto the MXU). matmul/linear/einsum decompose into it.
+# ---------------------------------------------------------------------------
+
+def _dot_general_meta(a: TensorProxy, b: TensorProxy, *, contract_dims: tuple[tuple[int, ...], tuple[int, ...]],
+                      batch_dims: tuple[tuple[int, ...], tuple[int, ...]] = ((), ()),
+                      preferred_element_type: dtypes.dtype | None = None) -> TensorProxy:
+    (ac, bc), (ab, bb) = contract_dims, batch_dims
+    check(len(ac) == len(bc), "dot_general: contracting dim count mismatch")
+    check(len(ab) == len(bb), "dot_general: batch dim count mismatch")
+    for i, j in zip(ac, bc):
+        check(a.shape[i] == b.shape[j],
+              lambda: f"dot_general: contract dim mismatch a.shape[{i}]={a.shape[i]} b.shape[{j}]={b.shape[j]}")
+    for i, j in zip(ab, bb):
+        check(a.shape[i] == b.shape[j], lambda: f"dot_general: batch dim mismatch")
+    batch_shape = tuple(a.shape[i] for i in ab)
+    a_free = tuple(s for i, s in enumerate(a.shape) if i not in ac and i not in ab)
+    b_free = tuple(s for i, s in enumerate(b.shape) if i not in bc and i not in bb)
+    out_dtype = preferred_element_type or dtypes.promote(a.dtype, b.dtype)
+    return TensorProxy(shape=batch_shape + a_free + b_free, dtype=dtypes.to_dtype(out_dtype), device=a.device)
+
+
+dot_general = make_prim(PrimIDs.DOT_GENERAL, "dot_general", _dot_general_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _convolution_meta(a: TensorProxy, w: TensorProxy, bias: TensorProxy | None, *, stride: Sequence[int],
+                      padding: Sequence[tuple[int, int]], dilation: Sequence[int], groups: int) -> TensorProxy:
+    # a: (N, Cin, *spatial), w: (Cout, Cin/groups, *kernel) — torch layout
+    n, cin = a.shape[0], a.shape[1]
+    cout = w.shape[0]
+    spatial = []
+    for i, (s, (pl, ph), d) in enumerate(zip(stride, padding, dilation)):
+        size = a.shape[2 + i]
+        k = w.shape[2 + i]
+        eff_k = (k - 1) * d + 1
+        spatial.append((size + pl + ph - eff_k) // s + 1)
+    return TensorProxy(shape=(n, cout, *spatial), dtype=dtypes.promote(a.dtype, w.dtype), device=a.device)
+
+
+convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", _convolution_meta, tags=(OpTags.MATMUL_OP,))
+
+
+# ---------------------------------------------------------------------------
+# host interaction
+# ---------------------------------------------------------------------------
+
+def _item_meta(a: TensorProxy) -> NumberProxy:
+    check(a.numel == 1, "item() requires a 1-element tensor")
+    py = float if a.dtype.is_float else (bool if a.dtype.is_bool else int)
+    return NumberProxy(py(0), python_type=py)
+
+
+item = make_prim(PrimIDs.ITEM, "item", _item_meta, tags=(OpTags.DEVICE_SYNC_OP,))

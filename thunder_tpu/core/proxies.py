@@ -1,0 +1,550 @@
+"""Proxies: the values that flow through traces.
+
+A proxy stands for a runtime value (a jax.Array, a Python number, a string,
+an RNG key, a future from an async collective) while a function is being
+traced. ``TensorProxy`` carries shape/dtype/device plus TPU-first metadata:
+an optional logical ``sharding`` (axis names per dim) and a
+``DistParallelType`` marker used by the distributed transforms.
+
+Reference parity: ``thunder/core/proxies.py`` (Variable, Proxy, NumberProxy,
+TensorProxy, FutureTensorProxy, DistParallelType). Fresh implementation —
+numbers are static by default (CONSTANT_VALUES caching), shapes are static
+(XLA requires static shapes; symbolic batch/seq dims are handled by the
+cache's bucketing instead).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from numbers import Number
+from typing import Any, Sequence
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.devices import Device, default_device, to_device
+
+
+class DistParallelType(Enum):
+    NONE = "none"
+    REPLICATED = "replicated"
+    FULLY_SHARDED = "fully_sharded"  # FSDP: dim-0 sharded
+    COLUMN_WISE = "column_wise"  # TP: output-feature sharded
+    ROW_WISE = "row_wise"  # TP: input-feature sharded
+
+
+class Variable:
+    """Hashable identity wrapper for a proxy (proxies hash by object, traces
+    need name-identity)."""
+
+    __slots__ = ("proxy",)
+
+    def __init__(self, proxy: "Proxy"):
+        self.proxy = proxy
+
+    def __eq__(self, other):
+        return isinstance(other, Variable) and self.proxy.name == other.proxy.name
+
+    def __hash__(self):
+        return hash(self.proxy.name)
+
+    def __repr__(self):
+        return f"Variable({self.proxy.name})"
+
+
+def variableify(x):
+    return Variable(x) if isinstance(x, Proxy) else x
+
+
+def unvariableify(x):
+    return x.proxy if isinstance(x, Variable) else x
+
+
+class Proxy:
+    """Base proxy: a named placeholder recorded in a trace."""
+
+    def __init__(self, name: str | None = None, prefix: str | None = None):
+        from thunder_tpu.core.trace import get_tracectx
+
+        trc = get_tracectx()
+        if name is None:
+            check(trc is not None, "cannot create an unnamed proxy outside a trace context")
+            name = trc.make_name(prefix=prefix or self._name_prefix())
+        elif trc is not None:
+            trc.register_name(name)
+        self.name = name
+
+    def _name_prefix(self) -> str:
+        return "p"
+
+    def replace_name(self, name: str) -> "Proxy":
+        import copy
+
+        p = copy.copy(self)
+        p.name = name
+        return p
+
+    def type_string(self) -> str:
+        return "Any"
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class AnyProxy(Proxy):
+    """Proxy for an opaque object threaded through a trace (e.g. RNG key)."""
+
+    def __init__(self, value: Any = None, name: str | None = None):
+        super().__init__(name, prefix="o")
+        self.value = value
+
+    def _name_prefix(self):
+        return "o"
+
+
+class StringProxy(Proxy):
+    def __init__(self, value: str, name: str | None = None):
+        super().__init__(name, prefix="s")
+        self.value = value
+
+    def type_string(self):
+        return "str"
+
+
+class NumberProxy(Proxy):
+    """A Python number captured by the trace.
+
+    Static by default: its concrete ``value`` is known at trace time and
+    baked into the cache key (CONSTANT_VALUES caching, the reference's
+    default — ``thunder/core/options.py:95``). Arithmetic on NumberProxies
+    evaluates eagerly on the values.
+    """
+
+    def __init__(self, value: Number, name: str | None = None, python_type: type | None = None):
+        super().__init__(name, prefix="n")
+        self.value = value
+        self.python_type = python_type or type(value)
+
+    def _name_prefix(self):
+        return "n"
+
+    def type_string(self):
+        return self.python_type.__name__
+
+    def __repr__(self):
+        return f"<NumberProxy {self.name}={self.value}>"
+
+    # static-number arithmetic evaluates eagerly
+    def _val(self):
+        return self.value
+
+    def __bool__(self):
+        return bool(self.value)
+
+    def __int__(self):
+        return int(self.value)
+
+    def __float__(self):
+        return float(self.value)
+
+    def __index__(self):
+        return int(self.value)
+
+    def __hash__(self):
+        return hash(self.value)
+
+    def __eq__(self, other):
+        return self.value == (other.value if isinstance(other, NumberProxy) else other)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+
+def _nval(x):
+    return x.value if isinstance(x, NumberProxy) else x
+
+
+for _op in ("add", "sub", "mul", "truediv", "floordiv", "mod", "pow"):
+    def _make(op):
+        def fwd(self, other):
+            return getattr(self._val(), f"__{op}__")(_nval(other))
+
+        def rev(self, other):
+            return getattr(type(_nval(other)), f"__{op}__")(_nval(other), self._val())
+
+        return fwd, rev
+
+    _f, _r = _make(_op)
+    setattr(NumberProxy, f"__{_op}__", _f)
+    setattr(NumberProxy, f"__r{_op}__", _r)
+for _op in ("lt", "le", "gt", "ge"):
+    def _mkcmp(op):
+        def cmp(self, other):
+            return getattr(self._val(), f"__{op}__")(_nval(other))
+
+        return cmp
+
+    setattr(NumberProxy, f"__{_op}__", _mkcmp(_op))
+setattr(NumberProxy, "__neg__", lambda self: -self._val())
+
+
+def pyval(x):
+    """Concrete python value of a proxy-or-value (numbers/strings)."""
+    if isinstance(x, (NumberProxy, StringProxy)):
+        return x.value
+    return x
+
+
+class TensorProxy(Proxy):
+    """Proxy for a jax.Array.
+
+    Carries: shape (static ints), dtype, device, requires_grad, and the
+    distributed markers ``distparallel_type`` + ``sharding`` (a tuple of
+    optional mesh-axis names, one per dim — the logical PartitionSpec) +
+    ``fsdp_padding`` (elements of dim-0 padding added by FSDP sharding).
+    """
+
+    def __init__(
+        self,
+        name: str | None = None,
+        *,
+        shape: Sequence[int],
+        dtype: dtypes.dtype,
+        device: Device | None = None,
+        requires_grad: bool = False,
+        distparallel_type: DistParallelType = DistParallelType.NONE,
+        sharding: tuple | None = None,
+        fsdp_padding: int = 0,
+        tags: frozenset | None = None,
+    ):
+        super().__init__(name, prefix="t")
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtypes.to_dtype(dtype)
+        self.device = device if device is not None else default_device()
+        self.requires_grad = requires_grad
+        self.distparallel_type = distparallel_type
+        self.sharding = sharding
+        self.fsdp_padding = fsdp_padding
+        self.tags = tags or frozenset()
+
+    def _name_prefix(self):
+        return "t"
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def numel(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def size(self) -> int:
+        return self.numel
+
+    def type_string(self) -> str:
+        sh = ",".join(str(s) for s in self.shape)
+        dev = str(self.device)
+        extra = ""
+        if self.distparallel_type is not DistParallelType.NONE:
+            extra = f" {self.distparallel_type.value}"
+        if self.sharding is not None:
+            extra += f" P{tuple(self.sharding)!r}"
+        return f'{dev} {self.dtype.shortname()}[{sh}]{extra}'
+
+    def replace(self, **changes) -> "TensorProxy":
+        kw = dict(
+            shape=self.shape, dtype=self.dtype, device=self.device,
+            requires_grad=self.requires_grad, distparallel_type=self.distparallel_type,
+            sharding=self.sharding, fsdp_padding=self.fsdp_padding, tags=self.tags,
+        )
+        name = changes.pop("name", None)
+        kw.update(changes)
+        return TensorProxy(name, **kw)
+
+    def __repr__(self):
+        return f'<TensorProxy {self.name}: {self.type_string()}>'
+
+    # -- operator overloads: dispatch to the core op namespace ------------
+    @staticmethod
+    def _ops():
+        from thunder_tpu import ops
+
+        return ops
+
+    def __add__(self, other):
+        return self._ops().add(self, other)
+
+    def __radd__(self, other):
+        return self._ops().add(other, self)
+
+    def __sub__(self, other):
+        return self._ops().sub(self, other)
+
+    def __rsub__(self, other):
+        return self._ops().sub(other, self)
+
+    def __mul__(self, other):
+        return self._ops().mul(self, other)
+
+    def __rmul__(self, other):
+        return self._ops().mul(other, self)
+
+    def __truediv__(self, other):
+        return self._ops().true_divide(self, other)
+
+    def __rtruediv__(self, other):
+        return self._ops().true_divide(other, self)
+
+    def __floordiv__(self, other):
+        return self._ops().floor_divide(self, other)
+
+    def __mod__(self, other):
+        return self._ops().remainder(self, other)
+
+    def __pow__(self, other):
+        return self._ops().pow(self, other)
+
+    def __rpow__(self, other):
+        return self._ops().pow(other, self)
+
+    def __matmul__(self, other):
+        return self._ops().matmul(self, other)
+
+    def __rmatmul__(self, other):
+        return self._ops().matmul(other, self)
+
+    def __neg__(self):
+        return self._ops().neg(self)
+
+    def __abs__(self):
+        return self._ops().abs(self)
+
+    def __eq__(self, other):
+        return self._ops().eq(self, other)
+
+    def __ne__(self, other):
+        return self._ops().ne(self, other)
+
+    def __lt__(self, other):
+        return self._ops().lt(self, other)
+
+    def __le__(self, other):
+        return self._ops().le(self, other)
+
+    def __gt__(self, other):
+        return self._ops().gt(self, other)
+
+    def __ge__(self, other):
+        return self._ops().ge(self, other)
+
+    def __and__(self, other):
+        return self._ops().bitwise_and(self, other)
+
+    def __or__(self, other):
+        return self._ops().bitwise_or(self, other)
+
+    def __xor__(self, other):
+        return self._ops().bitwise_xor(self, other)
+
+    def __invert__(self):
+        return self._ops().bitwise_not(self)
+
+    def __getitem__(self, idx):
+        return self._ops().getitem(self, idx)
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise RuntimeError(
+            "The truth value of a TensorProxy is not defined during tracing; "
+            "use lax-style control flow (ops.where / cond) instead of Python `if` on tensors."
+        )
+
+    def __len__(self):
+        check(self.ndim > 0, "len() of a 0-d tensor")
+        return self.shape[0]
+
+    # -- common tensor methods --------------------------------------------
+    @property
+    def T(self):
+        return self._ops().transpose(self, tuple(reversed(range(self.ndim))))
+
+    @property
+    def mT(self):
+        perm = tuple(range(self.ndim - 2)) + (self.ndim - 1, self.ndim - 2)
+        return self._ops().transpose(self, perm)
+
+    def astype(self, dt):
+        return self._ops().convert_element_type(self, dtypes.to_dtype(dt))
+
+    to = astype
+
+    def float(self):
+        return self.astype(dtypes.float32)
+
+    def bfloat16(self):
+        return self.astype(dtypes.bfloat16)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._ops().reshape(self, shape)
+
+    def view(self, *shape):
+        return self.reshape(*shape)
+
+    def flatten(self, start_dim=0, end_dim=-1):
+        return self._ops().flatten(self, start_dim, end_dim)
+
+    def transpose(self, dim0, dim1):
+        perm = list(range(self.ndim))
+        perm[dim0], perm[dim1] = perm[dim1], perm[dim0]
+        return self._ops().transpose(self, tuple(perm))
+
+    def permute(self, *dims):
+        if len(dims) == 1 and isinstance(dims[0], (tuple, list)):
+            dims = tuple(dims[0])
+        return self._ops().transpose(self, dims)
+
+    def swapaxes(self, a, b):
+        return self.transpose(a, b)
+
+    def squeeze(self, dim=None):
+        return self._ops().squeeze(self, dim)
+
+    def unsqueeze(self, dim):
+        return self._ops().unsqueeze(self, dim)
+
+    def expand(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._ops().expand(self, shape)
+
+    def contiguous(self):
+        return self
+
+    def sum(self, dim=None, keepdim=False, dtype=None):
+        return self._ops().sum(self, dim, keepdim=keepdim, dtype=dtype)
+
+    def mean(self, dim=None, keepdim=False, dtype=None):
+        return self._ops().mean(self, dim, keepdim=keepdim, dtype=dtype)
+
+    def var(self, dim=None, correction=1, keepdim=False):
+        return self._ops().var(self, dim, correction=correction, keepdim=keepdim)
+
+    def amax(self, dim=None, keepdim=False):
+        return self._ops().amax(self, dim, keepdim=keepdim)
+
+    def amin(self, dim=None, keepdim=False):
+        return self._ops().amin(self, dim, keepdim=keepdim)
+
+    def max(self, dim=None, keepdim=False):
+        if dim is None:
+            return self._ops().amax(self, None)
+        return self._ops().max_with_indices(self, dim, keepdim)
+
+    def argmax(self, dim=None, keepdim=False):
+        return self._ops().argmax(self, dim, keepdim=keepdim)
+
+    def exp(self):
+        return self._ops().exp(self)
+
+    def log(self):
+        return self._ops().log(self)
+
+    def sqrt(self):
+        return self._ops().sqrt(self)
+
+    def rsqrt(self):
+        return self._ops().rsqrt(self)
+
+    def tanh(self):
+        return self._ops().tanh(self)
+
+    def sigmoid(self):
+        return self._ops().sigmoid(self)
+
+    def neg(self):
+        return self._ops().neg(self)
+
+    def abs(self):
+        return self._ops().abs(self)
+
+    def clamp(self, min=None, max=None):
+        return self._ops().clamp(self, min, max)
+
+    def pow(self, e):
+        return self._ops().pow(self, e)
+
+    def matmul(self, other):
+        return self._ops().matmul(self, other)
+
+    def masked_fill(self, mask, value):
+        return self._ops().masked_fill(self, mask, value)
+
+    def split(self, split_size, dim=0):
+        return self._ops().split(self, split_size, dim)
+
+    def chunk(self, chunks, dim=0):
+        return self._ops().chunk(self, chunks, dim)
+
+    def item(self):
+        return self._ops().item(self)
+
+    def type_as(self, other):
+        return self.astype(other.dtype)
+
+    def detach(self):
+        from thunder_tpu.core import prims
+
+        return prims.detach(self)
+
+
+class FutureTensorProxy(Proxy):
+    """Result of an async collective before its ``wait``.
+
+    The reference makes every collective async, returning a FutureTensorProxy
+    consumed by an explicit ``wait`` prim (``thunder/distributed/prims.py:62-171``)
+    so trace reordering can overlap comm and compute. We keep the same IR
+    design; on TPU the XLA scheduler does the actual overlap and ``wait``
+    lowers to identity.
+    """
+
+    def __init__(self, like: TensorProxy, name: str | None = None, shape=None, dtype=None):
+        super().__init__(name, prefix="f")
+        self.shape = tuple(shape if shape is not None else like.shape)
+        self.dtype = dtype if dtype is not None else like.dtype
+        self.device = like.device
+
+    def _name_prefix(self):
+        return "f"
+
+    def type_string(self):
+        sh = ",".join(str(s) for s in self.shape)
+        return f"FUT {self.dtype.shortname()}[{sh}]"
+
+    def wait(self) -> TensorProxy:
+        from thunder_tpu.distributed import prims as dist_prims
+
+        return dist_prims.wait(self)
+
+
+def proxy_for(value: Any, name: str | None = None) -> Proxy:
+    """Create a proxy describing a concrete runtime value."""
+    import jax
+    import numpy as np
+
+    if isinstance(value, Proxy):
+        return value
+    if isinstance(value, (jax.Array, np.ndarray)) or hasattr(value, "shape") and hasattr(value, "dtype"):
+        return TensorProxy(name, shape=value.shape, dtype=dtypes.to_dtype(value.dtype))
+    if isinstance(value, str):
+        return StringProxy(value, name)
+    if isinstance(value, Number):
+        return NumberProxy(value, name)
+    return AnyProxy(value, name)

@@ -1,0 +1,22 @@
+"""Pytree helpers (flatten/unflatten/map), built on jax.tree_util.
+
+Reference parity: ``thunder/core/pytree.py`` wraps optree; we wrap
+jax.tree_util, which is the canonical registry for JAX-adjacent code and
+already understands flax/optax containers.
+"""
+
+from __future__ import annotations
+
+import jax.tree_util as jtu
+
+tree_flatten = jtu.tree_flatten
+tree_unflatten = jtu.tree_unflatten
+tree_map = jtu.tree_map
+tree_leaves = jtu.tree_leaves
+tree_structure = jtu.tree_structure
+register_pytree_node = jtu.register_pytree_node
+register_pytree_node_class = jtu.register_pytree_node_class
+
+
+def tree_flatten_with_dataclass(tree):
+    return jtu.tree_flatten(tree)

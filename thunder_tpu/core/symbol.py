@@ -1,0 +1,229 @@
+"""Symbol and BoundSymbol: the ops of the trace IR.
+
+A ``Symbol`` is a traceable operation; calling it under a trace context runs
+its meta (which computes output proxies, and for composite symbols records
+sub-operations) and appends a ``BoundSymbol`` to the trace. Executors later
+*claim* bound symbols, swapping in symbols that carry a concrete
+``python_impl`` — the generated Python program then calls those impls.
+
+Reference parity: ``thunder/core/symbol.py:128,307`` (Symbol, BoundSymbol,
+BoundSymbolRHS for CSE). Fresh TPU-first implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.codeutils import prettyprint, sanitize_name, type_comment
+from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable, variableify
+from thunder_tpu.core.pytree import tree_flatten
+from thunder_tpu.core.trace import get_tracectx
+
+
+class Symbol:
+    """A traceable operation.
+
+    Args:
+      name: printable name.
+      meta: fn from proxies → output proxies. For prims it only computes
+        metadata; for composites it calls other symbols (recorded as
+        subsymbols).
+      id: stable identifier (PrimIDs member or string) used by executor
+        claiming and grad-rule registries.
+      is_prim: if True, calls do not recurse — the meta's own symbol calls
+        are suppressed.
+      executor: the executor that claims bound symbols of this symbol
+        (set on executor-registered symbols).
+      python_impl: concrete callable used when executing generated code.
+      tags: OpTags.
+    """
+
+    __slots__ = ("name", "meta", "id", "is_prim", "executor", "python_impl",
+                 "_bind_postprocess", "tags", "_module_name")
+
+    def __init__(
+        self,
+        name: str,
+        meta: Callable | None = None,
+        *,
+        id: Any = None,
+        is_prim: bool = False,
+        executor=None,
+        python_impl: Callable | None = None,
+        _bind_postprocess: Callable | None = None,
+        tags: frozenset | None = None,
+    ):
+        self.name = name
+        self.meta = meta
+        self.id = id
+        self.is_prim = is_prim
+        self.executor = executor
+        self.python_impl = python_impl
+        self._bind_postprocess = _bind_postprocess
+        self.tags = tags or frozenset()
+
+    def codegen_name(self) -> str:
+        if self.executor is not None:
+            return sanitize_name(f"{self.executor.name}_{self.name}")
+        return sanitize_name(self.name)
+
+    def __repr__(self):
+        return f"[Symbol {self.name}]"
+
+    def __call__(self, *args, **kwargs):
+        trc = get_tracectx()
+        check(
+            trc is not None,
+            lambda: f"symbol {self.name} called outside a trace context; use thunder_tpu.jit",
+        )
+        if self.is_prim:
+            result = self.meta(*args, **kwargs)
+            subsymbols: list = []
+        else:
+            scope: list = []
+            trc.push_scope(scope)
+            try:
+                result = self.meta(*args, **kwargs)
+            finally:
+                trc.pop_scope()
+            subsymbols = scope
+        bsym = BoundSymbol(self, args, kwargs, result, subsymbols)
+        if self._bind_postprocess is not None:
+            self._bind_postprocess(bsym)
+        trc.add_bound_symbol(bsym)
+        return result
+
+    def bind(self, *args, output, subsymbols=(), **kwargs) -> "BoundSymbol":
+        """Create a BoundSymbol without tracing (used by trace transforms)."""
+        b = BoundSymbol(self, args, kwargs, output, list(subsymbols))
+        if self._bind_postprocess is not None:
+            self._bind_postprocess(b)
+        return b
+
+
+class BoundSymbol:
+    __slots__ = ("sym", "args", "kwargs", "output", "subsymbols", "_call_ctx", "header")
+
+    def __init__(self, sym: Symbol, args: Sequence, kwargs: dict, output: Any, subsymbols: list):
+        self.sym = sym
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs)
+        self.output = output
+        self.subsymbols = subsymbols
+        self._call_ctx: dict[str, Any] | None = None  # extra ctx (fusion callables)
+        self.header: str | None = None
+
+    # -- dataflow ----------------------------------------------------------
+    def flat_args(self) -> list:
+        flat, _ = tree_flatten((self.args, self.kwargs))
+        return flat
+
+    def flat_proxy_args(self) -> list[Proxy]:
+        return [a for a in self.flat_args() if isinstance(a, Proxy)]
+
+    def flat_outs(self) -> list:
+        flat, _ = tree_flatten(self.output)
+        return flat
+
+    def flat_proxy_outs(self) -> list[Proxy]:
+        return [o for o in self.flat_outs() if isinstance(o, Proxy)]
+
+    @property
+    def rhs(self):
+        """Hashable right-hand-side key for CSE."""
+        return (
+            self.sym.id if self.sym.id is not None else self.sym.name,
+            tuple(variableify(a) for a in self.flat_args()),
+        )
+
+    # -- rewriting ---------------------------------------------------------
+    def from_bsym(self, **changes) -> "BoundSymbol":
+        kw = dict(sym=self.sym, args=self.args, kwargs=self.kwargs, output=self.output,
+                  subsymbols=self.subsymbols)
+        kw.update(changes)
+        b = BoundSymbol(kw["sym"], kw["args"], kw["kwargs"], kw["output"], list(kw["subsymbols"]))
+        b._call_ctx = self._call_ctx
+        b.header = self.header
+        return b
+
+    def from_bsym_swap_proxies(self, swap_map: dict[Variable, Proxy], skip_output: bool = False) -> "BoundSymbol":
+        """Return a copy with proxies replaced per ``swap_map``."""
+
+        def swap(x):
+            if isinstance(x, Proxy):
+                v = Variable(x)
+                return swap_map.get(v, x)
+            if isinstance(x, tuple):
+                return tuple(swap(i) for i in x)
+            if isinstance(x, list):
+                return [swap(i) for i in x]
+            if isinstance(x, dict):
+                return {k: swap(v) for k, v in x.items()}
+            return x
+
+        new_args = swap(self.args)
+        new_kwargs = swap(self.kwargs)
+        new_output = self.output if skip_output else swap(self.output)
+        new_subs = [s.from_bsym_swap_proxies(swap_map, skip_output=skip_output) for s in self.subsymbols]
+        b = BoundSymbol(self.sym, new_args, new_kwargs, new_output, new_subs)
+        b._call_ctx = self._call_ctx
+        b.header = self.header
+        return b
+
+    # -- codegen -----------------------------------------------------------
+    def _fmt_output(self) -> str:
+        outs = self.flat_outs()
+        if self.output is None or len(outs) == 0:
+            return ""
+        return prettyprint(self.output) + " = "
+
+    def python(self, indent: int = 1) -> list[str]:
+        pad = "  " * indent
+        lines = []
+        if self.header:
+            for h in self.header.splitlines():
+                lines.append(f"{pad}# {h}")
+        name = self.sym.codegen_name()
+        if self.sym.name == "python_return":
+            lines.append(f"{pad}return {prettyprint(self.args[0]) if self.args else 'None'}")
+            return lines
+        if self.sym.name == "comment":
+            lines.append(f"{pad}# {self.args[0]}")
+            return lines
+        if self.sym.name == "python_del":
+            names = ", ".join(prettyprint(a) for a in self.args)
+            lines.append(f"{pad}del {names}")
+            return lines
+        argstr = ", ".join(
+            [prettyprint(a) for a in self.args]
+            + [f"{k}={prettyprint(v)}" for k, v in self.kwargs.items()]
+        )
+        comment = ""
+        outs = self.flat_proxy_outs()
+        if len(outs) == 1 and isinstance(outs[0], TensorProxy):
+            comment = f'  # {type_comment(outs[0])}'
+        lines.append(f"{pad}{self._fmt_output()}{name}({argstr}){comment}")
+        return lines
+
+    def gather_ctx(self, ctx: dict[str, Any]) -> None:
+        if self.sym.name in ("python_return", "comment", "python_del"):
+            return
+        name = self.sym.codegen_name()
+        impl = self._resolve_impl()
+        check(impl is not None, lambda: f"no executable implementation for symbol {self.sym.name!r} "
+                                        f"(id={self.sym.id}); run transform_for_execution first")
+        ctx[name] = impl
+        if self._call_ctx:
+            ctx.update(self._call_ctx)
+
+    def _resolve_impl(self):
+        if self.sym.python_impl is not None:
+            return self.sym.python_impl
+        # fall back to the always-on eager JAX executor for unclaimed prims
+        from thunder_tpu.executors.eagerjax import get_eager_impl
+
+        return get_eager_impl(self.sym)
+
+    def __repr__(self):
+        return "\n".join(self.python(indent=0))

@@ -1,0 +1,78 @@
+"""Common trace-to-trace transforms: DCE, CSE, and the user Transform ABC.
+
+Reference parity: ``thunder/core/transform_common.py`` (dce :98, cse :253,
+Transform ABC :337). In-place functionalization is unnecessary here — the
+frontend traces functionally from the start (JAX semantics); torch-style
+in-place methods are rewritten functionally at the ops layer.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import Proxy, Variable
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx, from_trace
+from thunder_tpu.core.utils import consumed_vars, produced_vars
+
+
+def _has_tag(bsym: BoundSymbol, tag: OpTags) -> bool:
+    return tag in bsym.sym.tags
+
+
+def dce(trc: TraceCtx) -> TraceCtx:
+    """Dead-code elimination over top-level bound symbols."""
+    needed: set[Variable] = set()
+    keep: list[BoundSymbol] = []
+    for bsym in reversed(trc.bound_symbols):
+        keep_it = (
+            _has_tag(bsym, OpTags.DONT_DCE)
+            or bsym.sym.id in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL)
+            or any(v in needed for v in produced_vars(bsym))
+        )
+        if keep_it:
+            keep.append(bsym)
+            needed |= consumed_vars(bsym)
+    new = from_trace(trc)
+    new.bound_symbols = list(reversed(keep))
+    new.set_provenance("Dead code elimination")
+    return new
+
+
+def cse(trc: TraceCtx) -> TraceCtx:
+    """Common-subexpression elimination (skips random/effectful ops)."""
+    seen: dict = {}
+    swap: dict[Variable, Proxy] = {}
+    out: list[BoundSymbol] = []
+    for bsym in trc.bound_symbols:
+        if swap:
+            bsym = bsym.from_bsym_swap_proxies(swap, skip_output=True)
+        if (_has_tag(bsym, OpTags.RANDOM_OP) or _has_tag(bsym, OpTags.DONT_DCE)
+                or bsym.sym.id in (PrimIDs.PYTHON_RETURN, PrimIDs.UNPACK_TRIVIAL)):
+            out.append(bsym)
+            continue
+        key = bsym.rhs
+        prev = seen.get(key)
+        if prev is None:
+            seen[key] = bsym
+            out.append(bsym)
+        else:
+            for old, new in zip(bsym.flat_proxy_outs(), prev.flat_proxy_outs()):
+                swap[Variable(old)] = new
+    new = from_trace(trc)
+    new.bound_symbols = out
+    new.set_provenance("Common subexpression elimination")
+    return new
+
+
+class Transform:
+    """User-pluggable transform with hooks at the reference's three points
+    (``thunder/core/transform_common.py:337``)."""
+
+    def transform_traces_pre_prologue(self, prologue_trc, computation_trc, epilogue_trc, **kwargs):
+        return prologue_trc, computation_trc, epilogue_trc
+
+    def transform_trace_post_optimization(self, trc: TraceCtx, **kwargs) -> TraceCtx:
+        return trc
+
+    def transform_module(self, model):
+        return model

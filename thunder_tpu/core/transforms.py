@@ -1,0 +1,798 @@
+"""Program transforms: trace evaluation/replay and autograd (VJP).
+
+The VJP engine mirrors the reference's design (``thunder/core/transforms.py``:
+``augmented_forward_pass`` :3233, ``backward_pass`` :3264,
+``forward_and_backward_from_trace`` :3587) but with a closure-based rule
+registry: each differentiable prim registers a rule that computes its primal
+output *and returns a pullback*; both directions are recorded as ordinary
+trace operations, so the result of differentiation is itself a printable,
+transformable trace. Composites without a registered rule are differentiated
+through their decomposition. Executors can override grads per-op by
+registering a rule for the op's id (the reference's ``register_augmented_forward``
+/ grad_transform mechanism).
+
+Two consumption modes:
+- ``inline_value_and_grad(fn)``: usable *inside* a traced function — inlines
+  fwd+bwd into the current trace (whole-train-step compilation, the TPU-first
+  default; improves on the reference, which never compiles the optimizer —
+  SURVEY §3.5).
+- ``forward_and_backward_from_trace(trc)``: splits into an augmented forward
+  trace returning (outputs, saved_for_backward) and a backward trace — the
+  torch-autograd-style split used by the module API.
+"""
+
+from __future__ import annotations
+
+import math
+from numbers import Number
+from typing import Any, Callable, Sequence
+
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import NumberProxy, Proxy, TensorProxy, Variable
+from thunder_tpu.core.pytree import tree_flatten, tree_map, tree_unflatten
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx, from_trace, get_tracectx, tracectx
+from thunder_tpu.core.utils import free_vars
+
+# ---------------------------------------------------------------------------
+# trace evaluation (replay)
+# ---------------------------------------------------------------------------
+
+def _env_map(env: dict, x):
+    if isinstance(x, Proxy):
+        v = Variable(x)
+        return env[v] if v in env else x
+    if isinstance(x, tuple):
+        return tuple(_env_map(env, i) for i in x)
+    if isinstance(x, list):
+        return [_env_map(env, i) for i in x]
+    if isinstance(x, dict):
+        return {k: _env_map(env, v) for k, v in x.items()}
+    return x
+
+
+def _bind_outputs(env: dict, old_out, new_out):
+    old_flat, _ = tree_flatten(old_out)
+    new_flat, _ = tree_flatten(new_out)
+    for o, n in zip(old_flat, new_flat):
+        if isinstance(o, Proxy):
+            env[Variable(o)] = n
+
+
+def eval_trace(trc: TraceCtx, *args):
+    """Replay a trace's operations under the current trace context (or
+    eagerly, if the symbols resolve). Returns the trace's output."""
+    env: dict = {}
+    check(len(args) == len(trc.args), lambda: f"eval_trace: expected {len(trc.args)} args, got {len(args)}")
+    for p, a in zip(trc.args, args):
+        env[Variable(p)] = a
+    result = None
+    for bsym in trc.bound_symbols:
+        if bsym.sym.id is PrimIDs.PYTHON_RETURN:
+            result = _env_map(env, bsym.args[0]) if bsym.args else None
+            break
+        if bsym.sym.id in (PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
+            continue
+        out = bsym.sym(*_env_map(env, bsym.args), **_env_map(env, bsym.kwargs))
+        _bind_outputs(env, bsym.output, out)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# VJP rule registry
+# ---------------------------------------------------------------------------
+
+_vjp_rules: dict[Any, Callable] = {}
+
+# prims that are legitimately non-differentiable (grads stop here)
+_NONDIFF = {
+    PrimIDs.EQ, PrimIDs.NE, PrimIDs.GE, PrimIDs.GT, PrimIDs.LE, PrimIDs.LT,
+    PrimIDs.BITWISE_AND, PrimIDs.BITWISE_OR, PrimIDs.BITWISE_XOR, PrimIDs.BITWISE_NOT,
+    PrimIDs.LOGICAL_NOT, PrimIDs.SIGN, PrimIDs.SIGNBIT, PrimIDs.FLOOR, PrimIDs.CEIL,
+    PrimIDs.ROUND, PrimIDs.TRUNC, PrimIDs.ISNAN, PrimIDs.ISINF, PrimIDs.ISFINITE,
+    PrimIDs.ARGMAX, PrimIDs.ARGMIN, PrimIDs.ARGSORT, PrimIDs.IOTA, PrimIDs.FULL,
+    PrimIDs.RNG_KEY, PrimIDs.RNG_SPLIT, PrimIDs.UNIFORM, PrimIDs.NORMAL,
+    PrimIDs.RANDOM_BITS, PrimIDs.ITEM, PrimIDs.SHIFT_LEFT, PrimIDs.SHIFT_RIGHT,
+    PrimIDs.FMOD, PrimIDs.REMAINDER, PrimIDs.COPYSIGN,
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE, PrimIDs.UNPACK_TRIVIAL,
+    PrimIDs.PYTHON_PRINT, PrimIDs.COMMENT, PrimIDs.SINK, PrimIDs.DEVICE_PUT,
+    PrimIDs.SHARDING_CONSTRAINT, PrimIDs.SORT, PrimIDs.TOPK, PrimIDs.CUMSUM,
+}
+
+
+def register_vjp(op_id):
+    def deco(rule):
+        _vjp_rules[op_id] = rule
+        return rule
+
+    return deco
+
+
+def has_vjp_rule(op_id) -> bool:
+    return op_id in _vjp_rules
+
+
+def _is_float_tensor(x) -> bool:
+    return isinstance(x, TensorProxy) and x.dtype.is_inexact
+
+
+# ---------------------------------------------------------------------------
+# augmented forward + backward passes
+# ---------------------------------------------------------------------------
+
+class PullbackRecord:
+    __slots__ = ("out", "pullback")
+
+    def __init__(self, out, pullback):
+        self.out = out
+        self.pullback = pullback
+
+
+def augmented_forward(bsyms: Sequence[BoundSymbol], env: dict) -> list[PullbackRecord]:
+    """Replay ``bsyms`` under the current trace, collecting pullbacks.
+
+    ``env`` maps the original trace's proxies (by Variable) to replayed
+    values; it is updated in place.
+    """
+    records: list[PullbackRecord] = []
+    for bsym in bsyms:
+        sym_id = bsym.sym.id
+        if sym_id in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
+            continue
+        margs = _env_map(env, bsym.args)
+        mkwargs = _env_map(env, bsym.kwargs)
+        rule = _vjp_rules.get(sym_id)
+        if rule is not None:
+            out, pullback = rule(*margs, **mkwargs)
+            records.append(PullbackRecord(out, pullback))
+            _bind_outputs(env, bsym.output, out)
+        elif bsym.subsymbols:
+            records.extend(augmented_forward(bsym.subsymbols, env))
+            # composite outputs are produced by subsymbols; map directly
+            out_flat, _ = tree_flatten(bsym.output)
+            for o in out_flat:
+                if isinstance(o, Proxy) and Variable(o) not in env:
+                    env[Variable(o)] = o  # produced literally by subsymbol replay
+        else:
+            if sym_id not in _NONDIFF and any(_is_float_tensor(o) for o in bsym.flat_proxy_outs()) \
+                    and any(_is_float_tensor(a) for a in bsym.flat_proxy_args()):
+                raise NotImplementedError(f"no VJP rule for prim {bsym.sym.name} (id={sym_id})")
+            out = bsym.sym(*margs, **mkwargs)
+            _bind_outputs(env, bsym.output, out)
+    return records
+
+
+def backward_pass(records: list[PullbackRecord], grads: dict[Variable, Any]) -> dict[Variable, Any]:
+    """Walk pullbacks in reverse, accumulating cotangents keyed by Variable."""
+    from thunder_tpu import ops
+
+    def put_grad(p, g):
+        if g is None or not isinstance(p, TensorProxy):
+            return
+        if not p.dtype.is_inexact:
+            return
+        v = Variable(p)
+        if v in grads:
+            grads[v] = ops.add(grads[v], g)
+        else:
+            grads[v] = g
+
+    for rec in reversed(records):
+        out_flat = [o for o in tree_flatten(rec.out)[0] if isinstance(o, Proxy)]
+        gs = [grads.get(Variable(o)) for o in out_flat]
+        if all(g is None for g in gs):
+            continue
+        g_arg = gs[0] if len(gs) == 1 else tuple(gs)
+        pairs = rec.pullback(g_arg)
+        if pairs is None:
+            continue
+        for p, g in pairs:
+            put_grad(p, g)
+    return grads
+
+
+# ---------------------------------------------------------------------------
+# user-facing transforms
+# ---------------------------------------------------------------------------
+
+def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
+    """Trace ``fn`` in a detached TraceCtx with fresh input proxies mirroring
+    the (possibly proxy) arguments. Returns (trace, input_proxies, out)."""
+    from thunder_tpu.core.proxies import proxy_for
+
+    inner = TraceCtx("subfn")
+    outer = get_tracectx()
+    if outer is not None:
+        # share the name registry so replayed proxies don't collide
+        inner._names = outer._names
+        inner._counters = outer._counters
+    with tracectx(inner):
+        flat, treedef = tree_flatten((args, kwargs))
+        proxies = []
+        for leaf in flat:
+            if isinstance(leaf, TensorProxy):
+                proxies.append(TensorProxy(shape=leaf.shape, dtype=leaf.dtype, device=leaf.device))
+            elif isinstance(leaf, Proxy):
+                proxies.append(leaf)
+            else:
+                proxies.append(leaf)
+        pargs, pkwargs = tree_unflatten(treedef, proxies)
+        out = fn(*pargs, **pkwargs)
+        prims.python_return(out)
+    inner.output = out
+    inner.args = [p for p in proxies if isinstance(p, Proxy)]
+    return inner, [p for p in proxies if isinstance(p, Proxy)], out
+
+
+def inline_value_and_grad(fn, argnums=0, has_aux: bool = False):
+    """Differentiate ``fn`` inline in the current trace (or under jit).
+
+    Returns a callable: (args) -> (value, grads) where grads matches the
+    structure of args[argnums]. The loss must be a scalar float tensor.
+    """
+    argnums_t = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+
+    def transformed(*args, **kwargs):
+        from thunder_tpu import ops
+
+        check(get_tracectx() is not None,
+              "inline_value_and_grad must run under tracing (wrap with thunder_tpu.jit)")
+        inner, inner_inputs, _ = _trace_subfn(fn, args, kwargs)
+        # env: inner input proxies -> actual outer values (same flatten order)
+        flat_actual, _ = tree_flatten((args, kwargs))
+        env: dict = {}
+        j = 0
+        for leaf in flat_actual:
+            if isinstance(leaf, Proxy):
+                env[Variable(inner_inputs[j])] = leaf
+                j += 1
+        check(j == len(inner_inputs), "inline_value_and_grad: argument flattening mismatch")
+        records = augmented_forward(inner.bound_symbols, env)
+        out = _env_map(env, inner.output)
+        if has_aux:
+            check(isinstance(out, tuple) and len(out) == 2, "has_aux=True requires fn to return (loss, aux)")
+            loss, aux = out
+        else:
+            loss = out
+        check(isinstance(loss, TensorProxy) and loss.numel == 1 and loss.dtype.is_inexact,
+              lambda: f"grad requires a scalar float loss, got {loss}")
+        grads: dict[Variable, Any] = {Variable(loss): ops.ones_like(loss)}
+        backward_pass(records, grads)
+
+        def grad_of(x):
+            if isinstance(x, TensorProxy):
+                g = grads.get(Variable(x))
+                return g if g is not None else ops.zeros_like(x)
+            return None
+
+        grad_results = tuple(tree_map(grad_of, args[i]) for i in argnums_t)
+        gout = grad_results[0] if isinstance(argnums, int) else grad_results
+        return ((loss, aux), gout) if has_aux else (loss, gout)
+
+    return transformed
+
+
+def forward_and_backward_from_trace(trc: TraceCtx) -> tuple[TraceCtx, TraceCtx, list]:
+    """Split a computation trace into an augmented forward trace returning
+    ``(outputs, saved_for_backward)`` and a backward trace
+    ``(saved_for_backward..., cotangents...) -> grads_of_inputs``."""
+    from thunder_tpu import ops
+
+    fwd = from_trace(trc)
+    fwd.fn_name = "augmented_forward"
+    env: dict = {Variable(p): p for p in trc.args}
+    with tracectx(fwd):
+        records = augmented_forward(trc.bound_symbols, env)
+        out = _env_map(env, trc.output)
+
+    out_flat = [o for o in tree_flatten(out)[0] if isinstance(o, TensorProxy) and o.dtype.is_inexact]
+
+    # backward trace: replay pullbacks with fresh cotangent inputs
+    bwd = TraceCtx("backward")
+    bwd._names = set(fwd._names)
+    bwd._counters = dict(fwd._counters)
+    with tracectx(bwd):
+        cotangents = [TensorProxy(f"ct{i}", shape=o.shape, dtype=o.dtype, device=o.device)
+                      for i, o in enumerate(out_flat)]
+        grads: dict[Variable, Any] = {}
+        for o, ct in zip(out_flat, cotangents):
+            grads[Variable(o)] = ct
+        backward_pass(records, grads)
+        input_grads = tuple(
+            grads.get(Variable(p)) if isinstance(p, TensorProxy) else None for p in trc.args
+        )
+        prims.python_return(input_grads)
+    bwd.output = input_grads
+
+    # saved-for-backward = free variables of the backward trace minus cotangents
+    ct_names = {c.name for c in cotangents}
+    saved = [v.proxy for v in free_vars(bwd.bound_symbols) if v.proxy.name not in ct_names]
+    bwd.args = list(saved) + list(cotangents)
+
+    with tracectx(fwd):
+        prims.python_return((out, tuple(saved)))
+    fwd.output = (out, tuple(saved))
+    fwd.set_provenance("Augmented forward pass")
+    bwd.set_provenance("Backward pass")
+    return fwd, bwd, saved
+
+
+# ---------------------------------------------------------------------------
+# VJP rules for prims
+# ---------------------------------------------------------------------------
+
+def _pairs(*pairs):
+    return [(p, g) for p, g in pairs if isinstance(p, TensorProxy)]
+
+
+def _unary(prim, dfn):
+    """dfn(g, a, out) -> grad_a"""
+
+    def rule(a):
+        out = prim(a)
+
+        def pullback(g):
+            return _pairs((a, dfn(g, a, out)))
+
+        return out, pullback
+
+    return rule
+
+
+def _register_unary(pid, prim, dfn):
+    _vjp_rules[pid] = _unary(prim, dfn)
+
+
+def _O():
+    from thunder_tpu import ops
+
+    return ops
+
+
+_register_unary(PrimIDs.NEG, prims.neg, lambda g, a, o: _O().neg(g))
+_register_unary(PrimIDs.ABS, prims.abs, lambda g, a, o: _O().mul(g, _O().sign(a)))
+_register_unary(PrimIDs.EXP, prims.exp, lambda g, a, o: _O().mul(g, o))
+_register_unary(PrimIDs.EXP2, prims.exp2, lambda g, a, o: _O().mul(_O().mul(g, o), math.log(2.0)))
+_register_unary(PrimIDs.EXPM1, prims.expm1, lambda g, a, o: _O().mul(g, _O().add(o, 1.0)))
+_register_unary(PrimIDs.LOG, prims.log, lambda g, a, o: _O().true_divide(g, a))
+_register_unary(PrimIDs.LOG1P, prims.log1p, lambda g, a, o: _O().true_divide(g, _O().add(a, 1.0)))
+_register_unary(PrimIDs.LOG2, prims.log2, lambda g, a, o: _O().true_divide(g, _O().mul(a, math.log(2.0))))
+_register_unary(PrimIDs.LOG10, prims.log10, lambda g, a, o: _O().true_divide(g, _O().mul(a, math.log(10.0))))
+_register_unary(PrimIDs.SQRT, prims.sqrt, lambda g, a, o: _O().true_divide(g, _O().mul(2.0, o)))
+_register_unary(PrimIDs.RSQRT, prims.rsqrt,
+                lambda g, a, o: _O().mul(_O().mul(-0.5, g), _O().mul(o, _O().mul(o, o))))
+_register_unary(PrimIDs.SIN, prims.sin, lambda g, a, o: _O().mul(g, _O().cos(a)))
+_register_unary(PrimIDs.COS, prims.cos, lambda g, a, o: _O().neg(_O().mul(g, _O().sin(a))))
+_register_unary(PrimIDs.TAN, prims.tan, lambda g, a, o: _O().mul(g, _O().add(1.0, _O().mul(o, o))))
+_register_unary(PrimIDs.TANH, prims.tanh, lambda g, a, o: _O().mul(g, _O().sub(1.0, _O().mul(o, o))))
+_register_unary(PrimIDs.SINH, prims.sinh, lambda g, a, o: _O().mul(g, _O().cosh(a)))
+_register_unary(PrimIDs.COSH, prims.cosh, lambda g, a, o: _O().mul(g, _O().sinh(a)))
+_register_unary(PrimIDs.ASIN, prims.asin,
+                lambda g, a, o: _O().true_divide(g, _O().sqrt(_O().sub(1.0, _O().mul(a, a)))))
+_register_unary(PrimIDs.ACOS, prims.acos,
+                lambda g, a, o: _O().neg(_O().true_divide(g, _O().sqrt(_O().sub(1.0, _O().mul(a, a))))))
+_register_unary(PrimIDs.ATAN, prims.atan,
+                lambda g, a, o: _O().true_divide(g, _O().add(1.0, _O().mul(a, a))))
+_register_unary(PrimIDs.ASINH, prims.asinh,
+                lambda g, a, o: _O().true_divide(g, _O().sqrt(_O().add(_O().mul(a, a), 1.0))))
+_register_unary(PrimIDs.ACOSH, prims.acosh,
+                lambda g, a, o: _O().true_divide(g, _O().sqrt(_O().sub(_O().mul(a, a), 1.0))))
+_register_unary(PrimIDs.ATANH, prims.atanh,
+                lambda g, a, o: _O().true_divide(g, _O().sub(1.0, _O().mul(a, a))))
+_register_unary(PrimIDs.ERF, prims.erf,
+                lambda g, a, o: _O().mul(g, _O().mul(2.0 / math.sqrt(math.pi),
+                                                     _O().exp(_O().neg(_O().mul(a, a))))))
+_register_unary(PrimIDs.ERFC, prims.erfc,
+                lambda g, a, o: _O().neg(_O().mul(g, _O().mul(2.0 / math.sqrt(math.pi),
+                                                              _O().exp(_O().neg(_O().mul(a, a)))))))
+_register_unary(PrimIDs.RECIPROCAL, prims.reciprocal,
+                lambda g, a, o: _O().neg(_O().mul(g, _O().mul(o, o))))
+
+
+@register_vjp(PrimIDs.ADD)
+def _add_vjp(a, b):
+    out = prims.add(a, b)
+
+    def pullback(g):
+        return _pairs((a, g), (b, g))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.SUB)
+def _sub_vjp(a, b):
+    out = prims.sub(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, g), (b, ops.neg(g)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.MUL)
+def _mul_vjp(a, b):
+    out = prims.mul(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, ops.mul(g, b)), (b, ops.mul(g, a)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.DIV)
+def _div_vjp(a, b):
+    out = prims.div(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        ga = ops.true_divide(g, b)
+        gb = ops.neg(ops.true_divide(ops.mul(g, out), b))
+        return _pairs((a, ga), (b, gb))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.POW)
+def _pow_vjp(a, b):
+    out = prims.pow(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        ga = ops.mul(g, ops.mul(b, ops.pow(a, ops.sub(b, 1.0)))) if isinstance(a, TensorProxy) else None
+        gb = None
+        if isinstance(b, TensorProxy):
+            loga = ops.where(ops.gt(a, 0.0), ops.log(ops.maximum(a, 1e-45)), ops.zeros_like(a))
+            gb = ops.mul(g, ops.mul(out, loga))
+        return _pairs((a, ga), (b, gb))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.MAXIMUM)
+def _maximum_vjp(a, b):
+    out = prims.maximum(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        mask = ops.ge(a, b) if isinstance(a, TensorProxy) else ops.le(b, a)
+        maskf = ops.convert_element_type(mask, g.dtype)
+        return _pairs((a, ops.mul(g, maskf)), (b, ops.mul(g, ops.sub(1.0, maskf))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.MINIMUM)
+def _minimum_vjp(a, b):
+    out = prims.minimum(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        mask = ops.le(a, b) if isinstance(a, TensorProxy) else ops.ge(b, a)
+        maskf = ops.convert_element_type(mask, g.dtype)
+        return _pairs((a, ops.mul(g, maskf)), (b, ops.mul(g, ops.sub(1.0, maskf))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.ATAN2)
+def _atan2_vjp(a, b):
+    out = prims.atan2(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        denom = ops.add(ops.mul(a, a), ops.mul(b, b))
+        return _pairs((a, ops.true_divide(ops.mul(g, b), denom)),
+                      (b, ops.neg(ops.true_divide(ops.mul(g, a), denom))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.WHERE)
+def _where_vjp(pred, a, b):
+    out = prims.where(pred, a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        ga = ops.where(pred, g, ops.zeros_like(g)) if isinstance(a, TensorProxy) else None
+        gb = ops.where(pred, ops.zeros_like(g), g) if isinstance(b, TensorProxy) else None
+        return _pairs((a, ga), (b, gb))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.CONVERT_ELEMENT_TYPE)
+def _convert_vjp(a, dtype):
+    out = prims.convert_element_type(a, dtype)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        if isinstance(a, TensorProxy) and a.dtype.is_inexact:
+            return _pairs((a, ops.convert_element_type(g, a.dtype)))
+        return None
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.DETACH)
+def _detach_vjp(a):
+    out = prims.detach(a)
+    return out, lambda g: None
+
+
+@register_vjp(PrimIDs.BROADCAST_IN_DIM)
+def _broadcast_in_dim_vjp(a, shape, broadcast_dimensions):
+    out = prims.broadcast_in_dim(a, shape, broadcast_dimensions)
+    bdims = tuple(broadcast_dimensions)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        reduce_dims = [d for d in range(len(shape)) if d not in bdims]
+        for i, d in enumerate(bdims):
+            if a.shape[i] == 1 and shape[d] != 1:
+                reduce_dims.append(d)
+        ga = g
+        if reduce_dims:
+            ga = prims.sum(g, tuple(sorted(reduce_dims)))
+        ga = ops.reshape(ga, a.shape)
+        return _pairs((a, ga))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.RESHAPE)
+def _reshape_vjp(a, shape):
+    out = prims.reshape(a, shape)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, ops.reshape(g, a.shape)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.SQUEEZE)
+def _squeeze_vjp(a, dims):
+    out = prims.squeeze(a, dims)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, ops.reshape(g, a.shape)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.TRANSPOSE)
+def _transpose_vjp(a, permutation):
+    out = prims.transpose(a, permutation)
+    perm = tuple(permutation)
+
+    def pullback(g):
+        inv = [0] * len(perm)
+        for i, p in enumerate(perm):
+            inv[p] = i
+        return _pairs((a, prims.transpose(g, tuple(inv))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.SLICE)
+def _slice_vjp(a, start_indices, end_indices, strides=None):
+    out = prims.slice_prim(a, start_indices, end_indices, strides)
+    st = tuple(strides) if strides is not None else (1,) * a.ndim
+
+    def pullback(g):
+        cfg = []
+        for d, (s, stride) in enumerate(zip(start_indices, st)):
+            osz = out.shape[d]
+            covered = s + (osz - 1) * stride + 1 if osz > 0 else s
+            cfg.append((s, a.shape[d] - covered, stride - 1))
+        return _pairs((a, prims.pad(g, 0.0, tuple(cfg))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.PAD)
+def _pad_vjp(a, padding_value, padding_config):
+    out = prims.pad(a, padding_value, padding_config)
+
+    def pullback(g):
+        starts, ends, strides = [], [], []
+        for (lo, hi, interior), s in zip(padding_config, a.shape):
+            starts.append(lo)
+            ends.append(lo + s + max(0, s - 1) * interior)
+            strides.append(interior + 1)
+        return _pairs((a, prims.slice_prim(g, starts, ends, strides)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.CAT)
+def _cat_vjp(tensors, dim):
+    out = prims.cat(tensors, dim)
+
+    def pullback(g):
+        pairs = []
+        off = 0
+        for t in tensors:
+            starts = [0] * t.ndim
+            ends = list(g.shape)
+            starts[dim], ends[dim] = off, off + t.shape[dim]
+            pairs.append((t, prims.slice_prim(g, starts, ends)))
+            off += t.shape[dim]
+        return _pairs(*pairs)
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.FLIP)
+def _flip_vjp(a, dims):
+    out = prims.flip(a, dims)
+
+    def pullback(g):
+        return _pairs((a, prims.flip(g, dims)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.SUM)
+def _sum_vjp(a, dims):
+    out = prims.sum(a, dims)
+    dims_t = tuple(dims)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        keep_shape = tuple(1 if i in dims_t else s for i, s in enumerate(a.shape))
+        return _pairs((a, ops.expand_to(ops.reshape(g, keep_shape), a.shape)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.PROD)
+def _prod_vjp(a, dims):
+    out = prims.prod(a, dims)
+    dims_t = tuple(dims)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        keep_shape = tuple(1 if i in dims_t else s for i, s in enumerate(a.shape))
+        gb = ops.expand_to(ops.reshape(g, keep_shape), a.shape)
+        ob = ops.expand_to(ops.reshape(out, keep_shape), a.shape)
+        return _pairs((a, ops.true_divide(ops.mul(gb, ob), a)))
+
+    return out, pullback
+
+
+def _minmax_reduction_vjp(prim):
+    def rule(a, dims):
+        out = prim(a, dims)
+        dims_t = tuple(dims)
+
+        def pullback(g):
+            from thunder_tpu import ops
+
+            keep_shape = tuple(1 if i in dims_t else s for i, s in enumerate(a.shape))
+            ob = ops.expand_to(ops.reshape(out, keep_shape), a.shape)
+            gb = ops.expand_to(ops.reshape(g, keep_shape), a.shape)
+            mask = ops.convert_element_type(ops.eq(a, ob), g.dtype)
+            counts = ops.expand_to(ops.reshape(prims.sum(mask, dims_t), keep_shape), a.shape)
+            return _pairs((a, ops.true_divide(ops.mul(gb, mask), counts)))
+
+        return out, pullback
+
+    return rule
+
+
+_vjp_rules[PrimIDs.AMAX] = _minmax_reduction_vjp(prims.amax)
+_vjp_rules[PrimIDs.AMIN] = _minmax_reduction_vjp(prims.amin)
+
+
+@register_vjp(PrimIDs.TAKE)
+def _take_vjp(a, indices, dim):
+    out = prims.take(a, indices, dim)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        n = indices.numel if isinstance(indices, TensorProxy) else 1
+        g2 = ops.reshape(g, a.shape[:dim] + (n,) + a.shape[dim + 1:])
+        idx_flat = ops.reshape(indices, (n,))
+        idx_shape = tuple(1 if i != dim else n for i in range(g2.ndim))
+        idx_b = ops.expand_to(ops.reshape(idx_flat, idx_shape), g2.shape)
+        zeros = ops.zeros_like(a)
+        return _pairs((a, prims.scatter_add(zeros, idx_b, g2, dim)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.TAKE_ALONG_AXIS)
+def _take_along_axis_vjp(a, indices, dim):
+    out = prims.take_along_axis(a, indices, dim)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, prims.scatter_add(ops.zeros_like(a), indices, g, dim)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.SCATTER_ADD)
+def _scatter_add_vjp(a, indices, value, dim):
+    out = prims.scatter_add(a, indices, value, dim)
+
+    def pullback(g):
+        return _pairs((a, g), (value, prims.take_along_axis(g, indices, dim)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.DOT_GENERAL)
+def _dot_general_vjp(a, b, *, contract_dims, batch_dims=((), ()), preferred_element_type=None):
+    out = prims.dot_general(a, b, contract_dims=contract_dims, batch_dims=batch_dims,
+                            preferred_element_type=preferred_element_type)
+    (ac, bc), (ab, bb) = contract_dims, batch_dims
+    ac, bc, ab, bb = tuple(ac), tuple(bc), tuple(ab), tuple(bb)
+    a_free = [d for d in range(a.ndim) if d not in ac and d not in ab]
+    b_free = [d for d in range(b.ndim) if d not in bc and d not in bb]
+    nb = len(ab)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        # grad_a: contract g's b_free dims with b's free dims
+        g_bfree_pos = tuple(range(nb + len(a_free), nb + len(a_free) + len(b_free)))
+        ga_t = prims.dot_general(g, b, contract_dims=(g_bfree_pos, tuple(b_free)),
+                                 batch_dims=(tuple(range(nb)), bb))
+        # ga_t dims: [batch(ab order), a_free(asc), b_contract dims(asc) ~ paired a_contract]
+        src = [0] * a.ndim
+        for i, d in enumerate(ab):
+            src[d] = i
+        for j, d in enumerate(a_free):
+            src[d] = nb + j
+        sorted_bc = sorted(bc)
+        for idx, bd in enumerate(sorted_bc):
+            a_dim = ac[bc.index(bd)]
+            src[a_dim] = nb + len(a_free) + idx
+        ga = prims.transpose(ga_t, tuple(src)) if tuple(src) != tuple(range(a.ndim)) else ga_t
+        if ga.dtype is not a.dtype:
+            ga = ops.convert_element_type(ga, a.dtype)
+
+        # grad_b: contract g's a_free dims with a's free dims
+        g_afree_pos = tuple(range(nb, nb + len(a_free)))
+        gb_t = prims.dot_general(g, a, contract_dims=(g_afree_pos, tuple(a_free)),
+                                 batch_dims=(tuple(range(nb)), ab))
+        # gb_t dims: [batch(bb order), b_free(asc), a_contract dims(asc) ~ paired b_contract]
+        srcb = [0] * b.ndim
+        for i, d in enumerate(bb):
+            srcb[d] = i
+        for j, d in enumerate(b_free):
+            srcb[d] = nb + j
+        sorted_ac = sorted(ac)
+        for idx, ad in enumerate(sorted_ac):
+            b_dim = bc[ac.index(ad)]
+            srcb[b_dim] = nb + len(b_free) + idx
+        gb = prims.transpose(gb_t, tuple(srcb)) if tuple(srcb) != tuple(range(b.ndim)) else gb_t
+        if gb.dtype is not b.dtype:
+            gb = ops.convert_element_type(gb, b.dtype)
+        return _pairs((a, ga), (b, gb))
+
+    return out, pullback

@@ -1,0 +1,71 @@
+"""Trace dataflow analysis: producers/consumers, free variables.
+
+Reference parity: ``thunder/core/utils.py`` (producers_and_consumers,
+consumer analysis). Analyses here are *recursive over subsymbols*: a
+composite bound symbol consumes/produces everything its decomposition does
+(needed e.g. for the functional RNG key threading, where key proxies flow
+between the subsymbols of adjacent random composites).
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core.proxies import Proxy, Variable
+from thunder_tpu.core.symbol import BoundSymbol
+
+
+def produced_vars(bsym: BoundSymbol) -> set[Variable]:
+    out = {Variable(p) for p in bsym.flat_proxy_outs()}
+    for sub in bsym.subsymbols:
+        out |= produced_vars(sub)
+    return out
+
+
+def consumed_vars(bsym: BoundSymbol) -> set[Variable]:
+    """Free proxy inputs of a bound symbol (recursing into subsymbols)."""
+    produced: set[Variable] = set()
+    consumed: set[Variable] = set()
+
+    def walk(b: BoundSymbol):
+        for p in b.flat_proxy_args():
+            v = Variable(p)
+            if v not in produced:
+                consumed.add(v)
+        for sub in b.subsymbols:
+            walk(sub)
+            for p in sub.flat_proxy_outs():
+                produced.add(Variable(p))
+        for p in b.flat_proxy_outs():
+            produced.add(Variable(p))
+
+    walk(bsym)
+    return consumed
+
+
+def producers(bsyms) -> dict[Variable, BoundSymbol]:
+    m: dict[Variable, BoundSymbol] = {}
+    for bsym in bsyms:
+        for v in produced_vars(bsym):
+            m.setdefault(v, bsym)
+    return m
+
+
+def consumers(bsyms) -> dict[Variable, list[BoundSymbol]]:
+    m: dict[Variable, list[BoundSymbol]] = {}
+    for bsym in bsyms:
+        for v in consumed_vars(bsym):
+            m.setdefault(v, []).append(bsym)
+    return m
+
+
+def free_vars(bsyms) -> list[Variable]:
+    """Ordered free variables of a bsym sequence (consumed before produced)."""
+    produced: set[Variable] = set()
+    free: list[Variable] = []
+    seen: set[Variable] = set()
+    for bsym in bsyms:
+        for v in sorted(consumed_vars(bsym), key=lambda v: v.proxy.name):
+            if v not in produced and v not in seen:
+                seen.add(v)
+                free.append(v)
+        produced |= produced_vars(bsym)
+    return free

@@ -1,0 +1,30 @@
+"""Distributed execution: device meshes and parallelism transforms.
+
+TPU-native replacement for the reference's ``thunder/distributed`` package:
+no ProcessGroup/NCCL runtime — collectives are trace prims that lower to
+``jax.lax`` ops on named mesh axes inside the compiled program; XLA schedules
+them over ICI/DCN. See ``thunder_tpu/distributed/prims.py`` and
+``transforms.py``.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any
+
+_mesh_stack: list = []
+
+
+def current_mesh():
+    return _mesh_stack[-1] if _mesh_stack else None
+
+
+@contextmanager
+def use_mesh(mesh):
+    """Activate a jax.sharding.Mesh for collective lowering + sharding
+    constraints."""
+    _mesh_stack.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _mesh_stack.pop()

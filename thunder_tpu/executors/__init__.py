@@ -1,0 +1,176 @@
+"""Executor framework: prioritized, extensible op claiming + region fusion.
+
+The best idea in the reference (``thunder/extend/__init__.py:56-281``) kept
+here: every operation in a trace can be *claimed* by an executor — an
+``OperatorExecutor`` substitutes a single bound symbol with an
+executor-specific symbol carrying a concrete runtime callable (e.g. a Pallas
+flash-attention kernel claiming ``nn.scaled_dot_product_attention``), and a
+``FusionExecutor`` groups whole regions into one fused callable (the XLA
+executor jax.jit's regions). Executors are consulted in priority order;
+the eager-JAX executor is the always-on fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.symbol import BoundSymbol, Symbol
+
+
+class ImplInfo:
+    """How an executor implements one symbol id."""
+
+    __slots__ = ("symbol", "checker", "execution_transform", "grad_transform")
+
+    def __init__(self, symbol: Symbol | None = None, checker: Callable | None = None,
+                 execution_transform: Callable | None = None, grad_transform: Callable | None = None):
+        self.symbol = symbol
+        self.checker = checker
+        self.execution_transform = execution_transform
+        self.grad_transform = grad_transform
+
+
+class Executor:
+    def __init__(self, name: str, version: str = "0.1"):
+        self.name = name
+        self.version = version
+        self.implmap: dict[Any, ImplInfo] = {}
+
+    def can_execute(self, bsym: BoundSymbol) -> bool:
+        impl = self.implmap.get(bsym.sym.id)
+        if impl is None:
+            return False
+        if impl.checker is not None:
+            try:
+                return bool(impl.checker(*bsym.args, **bsym.kwargs))
+            except Exception:
+                return False
+        return True
+
+    def get_impl(self, bsym: BoundSymbol) -> ImplInfo | None:
+        return self.implmap.get(bsym.sym.id)
+
+    def __repr__(self):
+        return f"<Executor {self.name}>"
+
+
+class OperatorExecutor(Executor):
+    """Executor providing per-op runtime callables (reference
+    ``thunder/extend/__init__.py:197-279``)."""
+
+    def register_operator(self, name: str, *, meta: Callable | None = None, fn: Callable,
+                          like: Symbol | None = None, tags=None) -> Symbol:
+        if meta is None and like is not None:
+            meta = like.meta
+        sym = Symbol(name, meta, id=f"{self.name}.{name}", is_prim=True, executor=self,
+                     python_impl=fn, tags=tags or (like.tags if like is not None else None))
+        return sym
+
+    def register_implementation(self, id_or_sym, op: Symbol | None = None, *,
+                                checker: Callable | None = None,
+                                execution_transform: Callable | None = None,
+                                grad_transform: Callable | None = None) -> None:
+        sym_id = id_or_sym.id if isinstance(id_or_sym, Symbol) else id_or_sym
+        self.implmap[sym_id] = ImplInfo(symbol=op, checker=checker,
+                                        execution_transform=execution_transform,
+                                        grad_transform=grad_transform)
+
+
+class FusionExecutor(Executor):
+    """Executor that fuses whole regions of the trace; with optimization-fuel
+    debugging as in the reference (``thunder/extend/__init__.py:143-162``)."""
+
+    def __init__(self, name: str, version: str = "0.1"):
+        super().__init__(name, version)
+        import os
+
+        fuel = os.environ.get(f"{name.upper()}_OPTIMIZATION_FUEL")
+        self._fuel = int(fuel) if fuel else None
+
+    def get_fuel(self, amount: int = 1) -> bool:
+        if self._fuel is None:
+            return True
+        if self._fuel < amount:
+            return False
+        self._fuel -= amount
+        return True
+
+    def fusion_pass(self, trace):
+        raise NotImplementedError
+
+    def can_fuse(self, bsym: BoundSymbol) -> bool:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+_executor_map: dict[str, Executor] = {}
+_default_executors: list[Executor] = []
+_always_executors: list[Executor] = []
+
+
+def register_executor(ex: Executor, *, default: bool = False, always: bool = False, index: int | None = None):
+    _executor_map[ex.name] = ex
+    if default and ex not in _default_executors:
+        _default_executors.insert(index if index is not None else len(_default_executors), ex)
+    if always and ex not in _always_executors:
+        _always_executors.append(ex)
+    return ex
+
+
+def get_executor(name: str) -> Executor | None:
+    _ensure_builtin_executors()
+    return _executor_map.get(name)
+
+def get_all_executors() -> tuple[Executor, ...]:
+    _ensure_builtin_executors()
+    return tuple(_executor_map.values())
+
+
+def get_default_executors() -> tuple[Executor, ...]:
+    _ensure_builtin_executors()
+    return tuple(_default_executors)
+
+
+def get_always_executors() -> tuple[Executor, ...]:
+    _ensure_builtin_executors()
+    return tuple(_always_executors)
+
+
+def resolve_executors(executors: Sequence | None) -> tuple[Executor, ...]:
+    if executors is None:
+        return get_default_executors()
+    out = []
+    for e in executors:
+        if isinstance(e, Executor):
+            out.append(e)
+        elif isinstance(e, str):
+            ex = get_executor(e)
+            check(ex is not None, lambda: f"unknown executor {e!r}; known: {list(_executor_map)}")
+            out.append(ex)
+        else:
+            raise TypeError(f"cannot resolve executor from {e!r}")
+    for a in get_always_executors():
+        if a not in out:
+            out.append(a)
+    return tuple(out)
+
+
+_builtins_loaded = False
+
+
+def _ensure_builtin_executors():
+    """Import built-in executors (registers them). Deferred to avoid import cycles."""
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    _builtins_loaded = True
+    from thunder_tpu.executors import eagerjax, xla  # noqa: F401
+
+    try:
+        from thunder_tpu.executors import pallasex  # noqa: F401
+    except Exception:
+        pass

@@ -1,0 +1,360 @@
+"""The eager JAX executor: one ``jax.numpy``/``lax`` implementation per prim.
+
+This is the torchex analog (reference ``thunder/executors/torchex.py``): the
+always-on fallback that can execute *every* prim op-by-op without any
+compilation — which makes every trace directly runnable on CPU or TPU, and
+gives the test suite a ground-truth backend. The XLA fusion executor and the
+Pallas operator executors claim work *above* this one.
+"""
+
+from __future__ import annotations
+
+import operator
+from numbers import Number
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import ThunderTPUError
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.symbol import Symbol
+from thunder_tpu.executors import OperatorExecutor, register_executor
+
+
+class GuardFailure(AssertionError):
+    """Raised by prologue guard prims on cache-entry mismatch."""
+
+
+ex = OperatorExecutor("eagerjax")
+register_executor(ex, always=True)
+
+_impls: dict = {}
+
+
+def impl(prim_id):
+    def deco(fn):
+        _impls[prim_id] = fn
+        return fn
+
+    return deco
+
+
+def get_eager_impl(sym: Symbol):
+    if sym.id in _impls:
+        return _impls[sym.id]
+    return None
+
+
+def has_impl(sym: Symbol) -> bool:
+    return sym.id in _impls or sym.python_impl is not None
+
+
+# -- utility ----------------------------------------------------------------
+
+@impl(PrimIDs.PYTHON_PRINT)
+def _print(*args):
+    print(*args)
+
+
+@impl(PrimIDs.SINK)
+def _sink(*args, **kwargs):
+    return None
+
+
+# -- prologue guards --------------------------------------------------------
+
+def _guard(cond, msg):
+    if not cond:
+        raise GuardFailure(msg)
+
+
+@impl(PrimIDs.UNPACK_TRIVIAL)
+def _unpack_trivial(x=None, *, name=None):
+    return x
+
+
+@impl(PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA)
+def _check_tensor(t, shape, dtype, device_str):
+    _guard(hasattr(t, "shape") and hasattr(t, "dtype"), f"expected an array, got {type(t)}")
+    _guard(tuple(t.shape) == tuple(shape), f"shape changed: expected {shape}, got {tuple(t.shape)}")
+    _guard(jnp.dtype(t.dtype) == dtype.jax, f"dtype changed: expected {dtype}, got {t.dtype}")
+
+
+@impl(PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE)
+def _check_number(n, v):
+    _guard(type(n) is type(v) and n == v, f"number changed: expected {v!r}, got {n!r}")
+
+
+@impl(PrimIDs.CHECK_STRING_VALUE)
+def _check_string(s, v):
+    _guard(s == v, f"string changed: expected {v!r}, got {s!r}")
+
+
+@impl(PrimIDs.CHECK_LITERAL_LIKE)
+def _check_literal(x, v):
+    _guard(type(x) is type(v), f"input type changed: expected {type(v)}, got {type(x)}")
+
+
+# -- dtype / device / sharding ----------------------------------------------
+
+@impl(PrimIDs.CONVERT_ELEMENT_TYPE)
+def _convert_element_type(a, dtype):
+    return lax.convert_element_type(a, dtypes.to_jax(dtype))
+
+
+@impl(PrimIDs.DEVICE_PUT)
+def _device_put(a, device):
+    return jax.device_put(a, device.to_jax())
+
+
+@impl(PrimIDs.SHARDING_CONSTRAINT)
+def _sharding_constraint(a, spec):
+    from thunder_tpu.distributed import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return a
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = tuple(spec) + (None,) * (a.ndim - len(spec))
+    return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+@impl(PrimIDs.DETACH)
+def _detach(a):
+    return lax.stop_gradient(a)
+
+
+# -- creation ----------------------------------------------------------------
+
+@impl(PrimIDs.FULL)
+def _full(shape, fill_value, dtype, device=None):
+    return jnp.full(tuple(shape), fill_value, dtype=dtypes.to_jax(dtype))
+
+
+@impl(PrimIDs.IOTA)
+def _iota(length, *, start=0, step=1, dtype=dtypes.int32, device=None):
+    jd = dtypes.to_jax(dtype)
+    return (jnp.arange(length, dtype=jd) * jnp.asarray(step, jd) + jnp.asarray(start, jd))
+
+
+# -- rng ---------------------------------------------------------------------
+
+@impl(PrimIDs.RNG_KEY)
+def _rng_key(seed):
+    return jax.random.PRNGKey(seed)
+
+
+@impl(PrimIDs.RNG_SPLIT)
+def _rng_split(key):
+    k = jax.random.split(key, 2)
+    return k[0], k[1]
+
+
+@impl(PrimIDs.UNIFORM)
+def _uniform(shape, lo, hi, *, dtype, key):
+    return jax.random.uniform(key, tuple(shape), dtype=dtypes.to_jax(dtype), minval=lo, maxval=hi)
+
+
+@impl(PrimIDs.NORMAL)
+def _normal(shape, *, dtype, key):
+    return jax.random.normal(key, tuple(shape), dtype=dtypes.to_jax(dtype))
+
+
+@impl(PrimIDs.RANDOM_BITS)
+def _random_bits(shape, *, key):
+    return jax.random.bits(key, tuple(shape), dtype=jnp.uint32)
+
+
+# -- shape -------------------------------------------------------------------
+
+@impl(PrimIDs.BROADCAST_IN_DIM)
+def _broadcast_in_dim(a, shape, broadcast_dimensions):
+    return lax.broadcast_in_dim(a, tuple(shape), tuple(broadcast_dimensions))
+
+
+@impl(PrimIDs.CAT)
+def _cat(tensors, dim):
+    return jnp.concatenate(tensors, axis=dim)
+
+
+@impl(PrimIDs.FLIP)
+def _flip(a, dims):
+    return jnp.flip(a, axis=tuple(dims))
+
+
+@impl(PrimIDs.RESHAPE)
+def _reshape(a, shape):
+    return jnp.reshape(a, tuple(shape))
+
+
+@impl(PrimIDs.SLICE)
+def _slice(a, start_indices, end_indices, strides=None):
+    return lax.slice(a, tuple(start_indices), tuple(end_indices),
+                     tuple(strides) if strides is not None else None)
+
+
+@impl(PrimIDs.SQUEEZE)
+def _squeeze(a, dims):
+    return lax.squeeze(a, tuple(dims))
+
+
+@impl(PrimIDs.TRANSPOSE)
+def _transpose(a, permutation):
+    return lax.transpose(a, tuple(permutation))
+
+
+@impl(PrimIDs.PAD)
+def _pad(a, padding_value, padding_config):
+    return lax.pad(a, jnp.asarray(padding_value, a.dtype), tuple(tuple(c) for c in padding_config))
+
+
+@impl(PrimIDs.TAKE)
+def _take(a, indices, dim):
+    return jnp.take(a, indices, axis=dim)
+
+
+@impl(PrimIDs.TAKE_ALONG_AXIS)
+def _take_along_axis(a, indices, dim):
+    return jnp.take_along_axis(a, indices, axis=dim)
+
+
+@impl(PrimIDs.SCATTER_ADD)
+def _scatter_add(a, indices, value, dim):
+    idx = list(jnp.indices(indices.shape, sparse=True))
+    idx[dim] = indices
+    return a.at[tuple(idx)].add(value)
+
+
+@impl(PrimIDs.INDEX_PUT)
+def _index_put(a, indices, values, accumulate):
+    if accumulate:
+        return a.at[tuple(indices)].add(values)
+    return a.at[tuple(indices)].set(values)
+
+
+@impl(PrimIDs.DYNAMIC_SLICE)
+def _dynamic_slice(a, start_indices, slice_sizes):
+    return lax.dynamic_slice(a, tuple(start_indices), tuple(slice_sizes))
+
+
+@impl(PrimIDs.DYNAMIC_UPDATE_SLICE)
+def _dynamic_update_slice(a, update, start_indices):
+    return lax.dynamic_update_slice(a, update, tuple(start_indices))
+
+
+# -- elementwise -------------------------------------------------------------
+
+_EW = {
+    PrimIDs.ABS: jnp.abs, PrimIDs.ACOS: jnp.arccos, PrimIDs.ACOSH: jnp.arccosh,
+    PrimIDs.ASIN: jnp.arcsin, PrimIDs.ASINH: jnp.arcsinh, PrimIDs.ATAN: jnp.arctan,
+    PrimIDs.ATANH: jnp.arctanh, PrimIDs.BITWISE_NOT: jnp.bitwise_not, PrimIDs.CEIL: jnp.ceil,
+    PrimIDs.COS: jnp.cos, PrimIDs.COSH: jnp.cosh, PrimIDs.ERF: lax.erf, PrimIDs.ERFC: lax.erfc,
+    PrimIDs.ERFINV: lax.erf_inv, PrimIDs.EXP: jnp.exp, PrimIDs.EXP2: jnp.exp2,
+    PrimIDs.EXPM1: jnp.expm1, PrimIDs.FLOOR: jnp.floor, PrimIDs.ISFINITE: jnp.isfinite,
+    PrimIDs.ISINF: jnp.isinf, PrimIDs.ISNAN: jnp.isnan, PrimIDs.LGAMMA: lax.lgamma,
+    PrimIDs.LOG: jnp.log, PrimIDs.LOG10: jnp.log10, PrimIDs.LOG1P: jnp.log1p,
+    PrimIDs.LOG2: jnp.log2, PrimIDs.LOGICAL_NOT: jnp.logical_not, PrimIDs.NEG: jnp.negative,
+    PrimIDs.RECIPROCAL: jnp.reciprocal, PrimIDs.ROUND: jnp.round, PrimIDs.RSQRT: lax.rsqrt,
+    PrimIDs.SIGN: jnp.sign, PrimIDs.SIGNBIT: jnp.signbit, PrimIDs.SIN: jnp.sin,
+    PrimIDs.SINH: jnp.sinh, PrimIDs.SQRT: jnp.sqrt, PrimIDs.TAN: jnp.tan, PrimIDs.TANH: jnp.tanh,
+    PrimIDs.TRUNC: jnp.trunc,
+    PrimIDs.ADD: jnp.add, PrimIDs.ATAN2: jnp.arctan2, PrimIDs.BITWISE_AND: jnp.bitwise_and,
+    PrimIDs.BITWISE_OR: jnp.bitwise_or, PrimIDs.BITWISE_XOR: jnp.bitwise_xor,
+    PrimIDs.COPYSIGN: jnp.copysign, PrimIDs.DIV: jnp.true_divide, PrimIDs.EQ: jnp.equal,
+    PrimIDs.FMOD: jnp.fmod, PrimIDs.GE: jnp.greater_equal, PrimIDs.GT: jnp.greater,
+    PrimIDs.LE: jnp.less_equal, PrimIDs.LT: jnp.less, PrimIDs.MAXIMUM: jnp.maximum,
+    PrimIDs.MINIMUM: jnp.minimum, PrimIDs.MUL: jnp.multiply, PrimIDs.NE: jnp.not_equal,
+    PrimIDs.POW: jnp.power, PrimIDs.REMAINDER: jnp.remainder, PrimIDs.SHIFT_LEFT: jnp.left_shift,
+    PrimIDs.SHIFT_RIGHT: jnp.right_shift, PrimIDs.SUB: jnp.subtract,
+    PrimIDs.WHERE: jnp.where,
+}
+_impls.update(_EW)
+
+
+# -- reductions --------------------------------------------------------------
+
+@impl(PrimIDs.SUM)
+def _sum(a, dims):
+    return jnp.sum(a, axis=tuple(dims))
+
+
+@impl(PrimIDs.PROD)
+def _prod(a, dims):
+    return jnp.prod(a, axis=tuple(dims))
+
+
+@impl(PrimIDs.AMAX)
+def _amax(a, dims):
+    return jnp.max(a, axis=tuple(dims))
+
+
+@impl(PrimIDs.AMIN)
+def _amin(a, dims):
+    return jnp.min(a, axis=tuple(dims))
+
+
+@impl(PrimIDs.ARGMAX)
+def _argmax(a, dim):
+    return jnp.argmax(a, axis=dim).astype(jnp.int32)
+
+
+@impl(PrimIDs.ARGMIN)
+def _argmin(a, dim):
+    return jnp.argmin(a, axis=dim).astype(jnp.int32)
+
+
+@impl(PrimIDs.CUMSUM)
+def _cumsum(a, dim):
+    return jnp.cumsum(a, axis=dim)
+
+
+@impl(PrimIDs.SORT)
+def _sort(a, dim, descending):
+    out = jnp.sort(a, axis=dim)
+    return jnp.flip(out, axis=dim) if descending else out
+
+
+@impl(PrimIDs.ARGSORT)
+def _argsort(a, dim, descending):
+    out = jnp.argsort(a, axis=dim).astype(jnp.int32)
+    return jnp.flip(out, axis=dim) if descending else out
+
+
+@impl(PrimIDs.TOPK)
+def _topk(a, k, dim):
+    moved = jnp.moveaxis(a, dim, -1)
+    v, i = lax.top_k(moved, k)
+    return jnp.moveaxis(v, -1, dim), jnp.moveaxis(i.astype(jnp.int32), -1, dim)
+
+
+# -- linalg ------------------------------------------------------------------
+
+@impl(PrimIDs.DOT_GENERAL)
+def _dot_general(a, b, *, contract_dims, batch_dims=((), ()), preferred_element_type=None):
+    pet = dtypes.to_jax(preferred_element_type) if preferred_element_type is not None else None
+    return lax.dot_general(a, b, dimension_numbers=(contract_dims, batch_dims),
+                           preferred_element_type=pet)
+
+
+@impl(PrimIDs.CONVOLUTION)
+def _convolution(a, w, bias, *, stride, padding, dilation, groups):
+    nspatial = a.ndim - 2
+    lhs_spec = "NC" + "DHW"[3 - nspatial:]
+    dn = lax.conv_dimension_numbers(a.shape, w.shape,
+                                    (lhs_spec, "OI" + "DHW"[3 - nspatial:], lhs_spec))
+    out = lax.conv_general_dilated(a, w, window_strides=tuple(stride), padding=tuple(padding),
+                                   rhs_dilation=tuple(dilation), dimension_numbers=dn,
+                                   feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nspatial)
+    return out
+
+
+# -- host --------------------------------------------------------------------
+
+@impl(PrimIDs.ITEM)
+def _item(a):
+    return a.item()

@@ -1,0 +1,129 @@
+"""Trace → executable-trace passes: executor claiming, fusion, del insertion.
+
+Reference parity: ``thunder/executors/passes.py`` (
+``_transform_for_operator_executor_execution`` :34, ``transform_for_execution``
+:136, ``del_last_used`` :290). The claim walk is the same design: each bound
+symbol is offered to the executors in priority order; an executor can
+substitute its own symbol (with a runtime callable) or rewrite via an
+execution transform; unclaimed composites are decomposed into their
+subsymbols and re-offered; unclaimed prims fall back to the eager JAX
+executor. FusionExecutors then run their fusion passes in list order.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import Proxy, Variable
+from thunder_tpu.core.pytree import tree_flatten
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
+from thunder_tpu.core.transform_common import dce
+from thunder_tpu.core.utils import consumed_vars, produced_vars
+from thunder_tpu.executors import Executor, FusionExecutor
+
+
+_PASSTHROUGH_IDS = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL,
+                    PrimIDs.UNPACK_TRIVIAL)
+
+
+def _run_execution_transform(transform, bsym: BoundSymbol, trc: TraceCtx) -> list[BoundSymbol]:
+    tmp = TraceCtx("exec_transform")
+    tmp._names = trc._names  # share the name registry: no collisions
+    tmp._counters = trc._counters
+    with tracectx(tmp):
+        out = transform(*bsym.args, **bsym.kwargs)
+    new_flat, _ = tree_flatten(out)
+    old_flat, _ = tree_flatten(bsym.output)
+    swap = {}
+    for n, o in zip(new_flat, old_flat):
+        if isinstance(n, Proxy) and isinstance(o, Proxy) and n.name != o.name:
+            swap[Variable(n)] = o
+    return [b.from_bsym_swap_proxies(swap) for b in tmp.bound_symbols]
+
+
+def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]:
+    if bsym.sym.id in _PASSTHROUGH_IDS or bsym.sym.executor is not None:
+        return [bsym]
+    for ex in executors:
+        if isinstance(ex, FusionExecutor):
+            continue  # fusion executors run as whole-trace passes afterwards
+        impl = ex.get_impl(bsym)
+        if impl is None or not ex.can_execute(bsym):
+            continue
+        if not getattr(ex, "get_fuel", lambda *_: True)():
+            continue
+        if impl.execution_transform is not None:
+            return _run_execution_transform(impl.execution_transform, bsym, trc)
+        if impl.symbol is not None:
+            return [impl.symbol.bind(*bsym.args, output=bsym.output,
+                                     subsymbols=bsym.subsymbols, **bsym.kwargs)]
+    from thunder_tpu.executors.eagerjax import get_eager_impl
+
+    if bsym.sym.is_prim:
+        check(get_eager_impl(bsym.sym) is not None or bsym.sym.python_impl is not None,
+              lambda: f"no executor can run prim {bsym.sym.name}")
+        return [bsym]
+    check(len(bsym.subsymbols) > 0, lambda: f"unclaimed symbol {bsym.sym.name} has no decomposition")
+    out: list[BoundSymbol] = []
+    for sub in bsym.subsymbols:
+        out.extend(claim_bsym(sub, executors, trc))
+    return out
+
+
+def transform_for_execution(trc: TraceCtx, executors) -> TraceCtx:
+    """Claim pass + fusion passes + DCE (reference ``passes.py:136``)."""
+    ex_bsyms: list[BoundSymbol] = []
+    for bsym in trc.bound_symbols:
+        ex_bsyms.extend(claim_bsym(bsym, executors, trc))
+    new = from_trace(trc)
+    new.bound_symbols = ex_bsyms
+    new.set_provenance("Executor claim pass")
+    for ex in executors:
+        if isinstance(ex, FusionExecutor):
+            new = ex.fusion_pass(new)
+    new = dce(new)
+    new.set_provenance("Transform for execution")
+    return new
+
+
+def del_last_used(trc: TraceCtx) -> TraceCtx:
+    """Insert ``del`` statements after each proxy's last use so the eager
+    path releases buffers promptly (reference ``passes.py:290``)."""
+    from thunder_tpu.core import prims
+
+    out_vars: set[Variable] = set()
+    flat_out, _ = tree_flatten(trc.output)
+    for o in flat_out:
+        if isinstance(o, Proxy):
+            out_vars.add(Variable(o))
+    arg_vars = {Variable(a) for a in trc.args}
+
+    # only names bound at top level of the generated function may be deleted
+    visible: set[Variable] = set(arg_vars)
+    for bsym in trc.bound_symbols:
+        for p in bsym.flat_proxy_outs():
+            visible.add(Variable(p))
+
+    last_use: dict[Variable, int] = {}
+    for i, bsym in enumerate(trc.bound_symbols):
+        for v in consumed_vars(bsym):
+            if v in visible:
+                last_use[v] = i
+
+    dels_at: dict[int, list[Proxy]] = {}
+    for v, i in last_use.items():
+        if v in out_vars or v in arg_vars:
+            continue
+        dels_at.setdefault(i, []).append(v.proxy)
+
+    new = from_trace(trc)
+    bsyms: list[BoundSymbol] = []
+    for i, bsym in enumerate(trc.bound_symbols):
+        bsyms.append(bsym)
+        if i in dels_at and bsym.sym.id is not PrimIDs.PYTHON_RETURN:
+            ps = sorted(dels_at[i], key=lambda p: p.name)
+            bsyms.append(prims.python_del.bind(*ps, output=None))
+    new.bound_symbols = bsyms
+    new.set_provenance("Delete last used")
+    return new

@@ -1,0 +1,143 @@
+"""NN composite operations.
+
+Each composite is a Symbol with a stable ``nn.*`` id and a prim
+decomposition, so operator executors can claim it whole — the Pallas
+flash-attention executor claims ``nn.scaled_dot_product_attention`` exactly
+like the reference's cudnnex/sdpaex claim torch SDPA
+(``thunder/executors/sdpaex.py:239``, ``cudnnex.py:425``), and the fused
+cross-entropy kernel claims ``nn.cross_entropy`` (apex/triton analog).
+"""
+
+from __future__ import annotations
+
+import math
+
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.baseutils import check, canonicalize_dim
+from thunder_tpu.core.proxies import TensorProxy, pyval
+import thunder_tpu.ops as ops
+from thunder_tpu.ops import opsymbol
+
+
+@opsymbol(id="nn.embedding")
+def embedding(ids, weight, padding_idx=None):
+    out = prims.take(weight, ids, 0)
+    return out
+
+
+@opsymbol(id="nn.one_hot")
+def one_hot(ids, num_classes: int):
+    classes = prims.iota(num_classes, dtype=dtypes.int32, device=ids.device)
+    classes = ops.expand_to(classes, ids.shape + (num_classes,))
+    expanded = ops.expand_to(ops.unsqueeze(ids, -1), ids.shape + (num_classes,))
+    return ops.convert_element_type(ops.eq(expanded, classes), dtypes.int32)
+
+
+@opsymbol(id="nn.layer_norm")
+def layer_norm(a, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
+    nd = len(normalized_shape)
+    check(tuple(a.shape[-nd:]) == tuple(normalized_shape),
+          lambda: f"layer_norm: normalized_shape {normalized_shape} != trailing dims of {a.shape}")
+    dims = tuple(range(a.ndim - nd, a.ndim))
+    x = ops.convert_element_type(a, dtypes.float32) if a.dtype in (dtypes.float16, dtypes.bfloat16) else a
+    m = ops.mean(x, dims, keepdim=True)
+    centered = ops.sub(x, m)
+    v = ops.mean(ops.mul(centered, centered), dims, keepdim=True)
+    out = ops.mul(centered, ops.rsqrt(ops.add(v, eps)))
+    if weight is not None:
+        out = ops.mul(out, weight)
+    if bias is not None:
+        out = ops.add(out, bias)
+    return ops.convert_element_type(out, a.dtype)
+
+
+@opsymbol(id="nn.rms_norm")
+def rms_norm(a, weight=None, eps: float = 1e-5, dim: int = -1):
+    d = canonicalize_dim(a.ndim, dim)
+    x = ops.convert_element_type(a, dtypes.float32) if a.dtype in (dtypes.float16, dtypes.bfloat16) else a
+    ms = ops.mean(ops.mul(x, x), d, keepdim=True)
+    out = ops.mul(x, ops.rsqrt(ops.add(ms, eps)))
+    out = ops.convert_element_type(out, a.dtype)
+    if weight is not None:
+        out = ops.mul(out, weight)
+    return out
+
+
+@opsymbol(id="nn.dropout")
+def dropout(a, p: float = 0.5, training: bool = True):
+    p = float(pyval(p))
+    if not training or p == 0.0:
+        return a
+    check(0.0 <= p < 1.0, lambda: f"dropout p={p} out of range")
+    keep = ops.bernoulli(1.0 - p, a.shape, dtype=a.dtype)
+    return ops.mul(ops.mul(a, keep), 1.0 / (1.0 - p))
+
+
+@opsymbol(id="nn.mse_loss")
+def mse_loss(input, target, reduction: str = "mean"):
+    d = ops.sub(input, target)
+    sq = ops.mul(d, d)
+    if reduction == "mean":
+        return ops.mean(sq)
+    if reduction == "sum":
+        return ops.sum(sq)
+    return sq
+
+
+@opsymbol(id="nn.cross_entropy")
+def cross_entropy(logits, target, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", label_smoothing: float = 0.0):
+    """logits: (N, C) or (N, C, ...) float; target: (N, ...) int class ids."""
+    check(weight is None, "cross_entropy: class weights not yet supported")
+    C = logits.shape[1] if logits.ndim > 1 else logits.shape[0]
+    if logits.ndim > 2:
+        # (N, C, d1..) -> (N*d1.., C)
+        perm = (0,) + tuple(range(2, logits.ndim)) + (1,)
+        logits = ops.reshape(ops.transpose(logits, perm), (-1, C))
+        target = ops.reshape(target, (-1,))
+    logp = ops.log_softmax(logits, -1)
+    tgt = ops.convert_element_type(target, dtypes.int32)
+    safe_tgt = ops.where(ops.eq(tgt, ignore_index), ops.zeros_like(tgt), tgt)
+    picked = ops.squeeze(prims.take_along_axis(logp, ops.unsqueeze(safe_tgt, -1), 1), (1,))
+    nll = ops.neg(picked)
+    if label_smoothing > 0.0:
+        smooth = ops.neg(ops.mean(logp, -1))
+        nll = ops.add(ops.mul(nll, 1.0 - label_smoothing), ops.mul(smooth, label_smoothing))
+    valid = ops.ne(tgt, ignore_index)
+    nll = ops.where(valid, nll, ops.zeros_like(nll))
+    if reduction == "none":
+        return nll
+    if reduction == "sum":
+        return ops.sum(nll)
+    count = ops.sum(ops.convert_element_type(valid, dtypes.float32))
+    return ops.true_divide(ops.sum(nll), ops.maximum(count, 1.0))
+
+
+@opsymbol(id="nn.scaled_dot_product_attention")
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
+                                 is_causal: bool = False, scale: float | None = None):
+    """q,k,v: (..., L, E) / (..., S, E). Decomposes to softmax(q k^T / sqrt(E)) v;
+    the Pallas flash-attention executor claims this symbol on TPU."""
+    E = q.shape[-1]
+    L, S = q.shape[-2], k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+    qf = ops.convert_element_type(q, dtypes.float32)
+    kf = ops.convert_element_type(k, dtypes.float32)
+    vf = ops.convert_element_type(v, dtypes.float32)
+    scores = ops.mul(ops.matmul(qf, kf.mT), scale)
+    if is_causal:
+        check(attn_mask is None, "cannot pass both is_causal and attn_mask")
+        causal = ops.tril_mask(L, S, 0, device=q.device)
+        scores = ops.where(ops.expand_to(causal, scores.shape), scores,
+                           ops.full_like(scores, -float("inf")))
+    if attn_mask is not None:
+        if attn_mask.dtype.is_bool:
+            scores = ops.where(ops.expand_to(attn_mask, scores.shape), scores,
+                               ops.full_like(scores, -float("inf")))
+        else:
+            scores = ops.add(scores, attn_mask)
+    probs = ops.softmax(scores, -1)
+    if dropout_p > 0.0:
+        probs = dropout(probs, dropout_p)
+    out = ops.matmul(probs, vf)
+    return ops.convert_element_type(out, q.dtype)
