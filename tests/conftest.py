@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU platform so distributed
+transforms/collectives are testable without TPU hardware (strictly better
+than the reference, which cannot test collectives without GPUs — SURVEY §4)."""
+
+import os
+
+# must run before jax backend initialization
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins JAX_PLATFORMS=axon (remote TPU tunnel); tests
+# must run hermetically on the virtual CPU mesh, so select cpu via config
+# (wins over the env var) and use exact matmuls for numerical comparisons.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

@@ -1,0 +1,355 @@
+"""OpInfo registry: per-op sample generators + jax.numpy references.
+
+Reference parity: ``thunder/tests/opinfos.py`` (197 OpInfos with SampleInput
+generators, reference implementations, dtype lists). Consumed by
+test_ops.py (correctness vs reference) and test_grad.py (VJP vs jax.grad).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+
+
+@dataclass
+class SampleInput:
+    args: tuple
+    kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+class OpInfo:
+    name: str
+    op: Callable
+    ref: Callable  # jax.numpy reference taking the same args
+    sample_generator: Callable[[np.random.RandomState], list[SampleInput]]
+    supports_grad: bool = True
+    grad_sample_filter: Callable[[SampleInput], bool] = lambda s: True
+    atol: float = 1e-5
+    rtol: float = 1e-5
+
+
+opinfos: list[OpInfo] = []
+
+
+def register(opinfo: OpInfo):
+    opinfos.append(opinfo)
+    return opinfo
+
+
+def _t(rng, *shape, lo=-1.0, hi=1.0, dtype=np.float32):
+    if np.issubdtype(dtype, np.integer):
+        return rng.randint(0, 10, size=shape).astype(dtype)
+    if dtype == np.bool_:
+        return rng.rand(*shape) > 0.5
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(dtype)
+
+
+def _unary_samples(lo=-1.0, hi=1.0):
+    def gen(rng):
+        return [
+            SampleInput((_t(rng, 4, 4, lo=lo, hi=hi),)),
+            SampleInput((_t(rng, 3, 1, 5, lo=lo, hi=hi),)),
+            SampleInput((_t(rng, 7, lo=lo, hi=hi),)),
+        ]
+
+    return gen
+
+
+def _binary_samples(lo=-1.0, hi=1.0):
+    def gen(rng):
+        return [
+            SampleInput((_t(rng, 4, 4, lo=lo, hi=hi), _t(rng, 4, 4, lo=lo, hi=hi))),
+            SampleInput((_t(rng, 3, 1, lo=lo, hi=hi), _t(rng, 3, 5, lo=lo, hi=hi))),  # broadcast
+            SampleInput((_t(rng, 4, lo=lo, hi=hi), 2.5)),  # scalar
+        ]
+
+    return gen
+
+
+import jax.numpy as jnp  # noqa: E402
+import jax  # noqa: E402
+
+# -- elementwise unary -------------------------------------------------------
+for name, ref, lo, hi, grad in [
+    ("abs", jnp.abs, -2, 2, True),
+    ("acos", jnp.arccos, -0.9, 0.9, True),
+    ("acosh", jnp.arccosh, 1.1, 3.0, True),
+    ("asin", jnp.arcsin, -0.9, 0.9, True),
+    ("asinh", jnp.arcsinh, -2, 2, True),
+    ("atan", jnp.arctan, -2, 2, True),
+    ("atanh", jnp.arctanh, -0.9, 0.9, True),
+    ("ceil", jnp.ceil, -3, 3, False),
+    ("cos", jnp.cos, -3, 3, True),
+    ("cosh", jnp.cosh, -2, 2, True),
+    ("erf", jax.lax.erf, -2, 2, True),
+    ("erfc", jax.lax.erfc, -2, 2, True),
+    ("exp", jnp.exp, -2, 2, True),
+    ("exp2", jnp.exp2, -2, 2, True),
+    ("expm1", jnp.expm1, -2, 2, True),
+    ("floor", jnp.floor, -3, 3, False),
+    ("isfinite", jnp.isfinite, -2, 2, False),
+    ("isinf", jnp.isinf, -2, 2, False),
+    ("isnan", jnp.isnan, -2, 2, False),
+    ("log", jnp.log, 0.1, 3, True),
+    ("log10", jnp.log10, 0.1, 3, True),
+    ("log1p", jnp.log1p, -0.5, 3, True),
+    ("log2", jnp.log2, 0.1, 3, True),
+    ("neg", jnp.negative, -2, 2, True),
+    ("reciprocal", jnp.reciprocal, 0.3, 3, True),
+    ("round", jnp.round, -3, 3, False),
+    ("rsqrt", jax.lax.rsqrt, 0.3, 3, True),
+    ("sigmoid", jax.nn.sigmoid, -3, 3, True),
+    ("sign", jnp.sign, -2, 2, False),
+    ("sin", jnp.sin, -3, 3, True),
+    ("sinh", jnp.sinh, -2, 2, True),
+    ("sqrt", jnp.sqrt, 0.1, 3, True),
+    ("tan", jnp.tan, -1, 1, True),
+    ("tanh", jnp.tanh, -2, 2, True),
+    ("trunc", jnp.trunc, -3, 3, False),
+    ("relu", jax.nn.relu, -2, 2, True),
+    ("silu", jax.nn.silu, -2, 2, True),
+]:
+    register(OpInfo(name, getattr(ops, name), ref, _unary_samples(lo, hi), supports_grad=grad))
+
+register(OpInfo("gelu", ops.gelu, partial(jax.nn.gelu, approximate=False), _unary_samples(-2, 2)))
+register(OpInfo("gelu_tanh", lambda a: ops.gelu(a, approximate="tanh"),
+                partial(jax.nn.gelu, approximate=True), _unary_samples(-2, 2)))
+
+# -- elementwise binary ------------------------------------------------------
+for name, ref, lo, hi, grad in [
+    ("add", jnp.add, -2, 2, True),
+    ("atan2", jnp.arctan2, 0.2, 2, True),
+    ("eq", jnp.equal, -2, 2, False),
+    ("ge", jnp.greater_equal, -2, 2, False),
+    ("gt", jnp.greater, -2, 2, False),
+    ("le", jnp.less_equal, -2, 2, False),
+    ("lt", jnp.less, -2, 2, False),
+    ("maximum", jnp.maximum, -2, 2, True),
+    ("minimum", jnp.minimum, -2, 2, True),
+    ("mul", jnp.multiply, -2, 2, True),
+    ("ne", jnp.not_equal, -2, 2, False),
+    ("sub", jnp.subtract, -2, 2, True),
+    ("true_divide", jnp.true_divide, 0.3, 3, True),
+    ("pow", jnp.power, 0.3, 2, True),
+    ("fmod", jnp.fmod, 0.5, 3, False),
+    ("remainder", jnp.remainder, 0.5, 3, False),
+    ("copysign", jnp.copysign, -2, 2, False),
+]:
+    register(OpInfo(name, getattr(ops, name), ref, _binary_samples(lo, hi), supports_grad=grad))
+
+
+def _where_samples(rng):
+    return [SampleInput((_t(rng, 4, 4, dtype=np.bool_), _t(rng, 4, 4), _t(rng, 4, 4))),
+            SampleInput((_t(rng, 4, 1, dtype=np.bool_), _t(rng, 1, 5), _t(rng, 4, 5)))]
+
+
+register(OpInfo("where", ops.where, jnp.where, _where_samples))
+register(OpInfo("clamp", ops.clamp, jnp.clip,
+                lambda rng: [SampleInput((_t(rng, 4, 4), -0.5, 0.5))]))
+
+# -- shape ops ---------------------------------------------------------------
+register(OpInfo("reshape", ops.reshape, jnp.reshape,
+                lambda rng: [SampleInput((_t(rng, 4, 6), (3, 8))),
+                             SampleInput((_t(rng, 2, 3, 4), (-1,)))]))
+register(OpInfo("transpose", ops.transpose, jnp.transpose,
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4), (2, 0, 1)))]))
+register(OpInfo("squeeze", ops.squeeze, jnp.squeeze,
+                lambda rng: [SampleInput((_t(rng, 2, 1, 4),))]))
+register(OpInfo("flip", ops.flip, jnp.flip,
+                lambda rng: [SampleInput((_t(rng, 3, 4), (0, 1)))]))
+register(OpInfo("cat", lambda a, b, dim: ops.cat([a, b], dim),
+                lambda a, b, dim: jnp.concatenate([a, b], axis=dim),
+                lambda rng: [SampleInput((_t(rng, 2, 3), _t(rng, 4, 3), 0)),
+                             SampleInput((_t(rng, 2, 3), _t(rng, 2, 5), 1))]))
+register(OpInfo("stack", lambda a, b: ops.stack([a, b], 0),
+                lambda a, b: jnp.stack([a, b], axis=0),
+                lambda rng: [SampleInput((_t(rng, 2, 3), _t(rng, 2, 3)))]))
+register(OpInfo("pad", ops.pad,
+                lambda a, cfg, value=0: jax.lax.pad(a, jnp.asarray(value, a.dtype), cfg),
+                lambda rng: [SampleInput((_t(rng, 3, 4), ((1, 2, 0), (0, 1, 1))))]))
+register(OpInfo("take", ops.take,
+                lambda a, i, dim=0: jnp.take(a, i, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 5, 3), np.array([0, 2, 4, 2]), 0))],
+                grad_sample_filter=lambda s: True))
+register(OpInfo("gather", ops.gather,
+                lambda a, dim, idx: jnp.take_along_axis(a, idx, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 4, 5), 1, rng.randint(0, 5, size=(4, 3))))]))
+register(OpInfo("getitem_slice", lambda a: a[1:3, ::2],
+                lambda a: a[1:3, ::2],
+                lambda rng: [SampleInput((_t(rng, 5, 6),))]))
+register(OpInfo("getitem_int", lambda a: a[2],
+                lambda a: a[2],
+                lambda rng: [SampleInput((_t(rng, 5, 6),))]))
+register(OpInfo("getitem_none", lambda a: a[None, :, 1],
+                lambda a: a[None, :, 1],
+                lambda rng: [SampleInput((_t(rng, 5, 6),))]))
+register(OpInfo("unsqueeze", ops.unsqueeze, lambda a, d: jnp.expand_dims(a, d),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 1))]))
+register(OpInfo("movedim", ops.movedim, jnp.moveaxis,
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4), 0, 2))]))
+register(OpInfo("expand", ops.expand, jnp.broadcast_to,
+                lambda rng: [SampleInput((_t(rng, 1, 4), (3, 4)))]))
+register(OpInfo("roll", ops.roll, jnp.roll,
+                lambda rng: [SampleInput((_t(rng, 4, 5), 2, 1))]))
+register(OpInfo("tril", ops.tril, jnp.tril,
+                lambda rng: [SampleInput((_t(rng, 4, 5),))]))
+register(OpInfo("triu", ops.triu, jnp.triu,
+                lambda rng: [SampleInput((_t(rng, 4, 5),))]))
+
+# -- reductions --------------------------------------------------------------
+register(OpInfo("sum", ops.sum, lambda a, dim=None, keepdim=False: jnp.sum(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4),)),
+                             SampleInput((_t(rng, 3, 4), 1)),
+                             SampleInput((_t(rng, 3, 4), 0, True))]))
+register(OpInfo("mean", ops.mean, lambda a, dim=None, keepdim=False: jnp.mean(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4),)), SampleInput((_t(rng, 3, 4), 1))]))
+register(OpInfo("prod", ops.prod, lambda a, dim=None, keepdim=False: jnp.prod(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4, lo=0.5, hi=1.5), 1))]))
+register(OpInfo("amax", ops.amax, lambda a, dim=None, keepdim=False: jnp.max(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4),)), SampleInput((_t(rng, 3, 4), 1))]))
+register(OpInfo("amin", ops.amin, lambda a, dim=None, keepdim=False: jnp.min(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 0))]))
+register(OpInfo("var", ops.var,
+                lambda a, dim=None, correction=1, keepdim=False: jnp.var(a, axis=dim, ddof=correction, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 1))]))
+register(OpInfo("std", ops.std,
+                lambda a, dim=None, correction=1, keepdim=False: jnp.std(a, axis=dim, ddof=correction, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 1))]))
+register(OpInfo("argmax", ops.argmax, lambda a, dim=None, keepdim=False: jnp.argmax(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 1))], supports_grad=False))
+register(OpInfo("argmin", ops.argmin, lambda a, dim=None, keepdim=False: jnp.argmin(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 1))], supports_grad=False))
+register(OpInfo("cumsum", ops.cumsum, lambda a, dim: jnp.cumsum(a, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 1))], supports_grad=False))
+register(OpInfo("softmax", ops.softmax, jax.nn.softmax,
+                lambda rng: [SampleInput((_t(rng, 3, 4), -1))]))
+register(OpInfo("log_softmax", ops.log_softmax, jax.nn.log_softmax,
+                lambda rng: [SampleInput((_t(rng, 3, 4), -1))]))
+register(OpInfo("topk", lambda a, k: ops.topk(a, k)[0],
+                lambda a, k: jax.lax.top_k(a, k)[0],
+                lambda rng: [SampleInput((_t(rng, 3, 8), 3))], supports_grad=False))
+register(OpInfo("sort", lambda a: ops.sort(a)[0], jnp.sort,
+                lambda rng: [SampleInput((_t(rng, 3, 8),))], supports_grad=False))
+
+# -- linalg ------------------------------------------------------------------
+register(OpInfo("matmul", ops.matmul, jnp.matmul,
+                lambda rng: [SampleInput((_t(rng, 4, 5), _t(rng, 5, 3))),
+                             SampleInput((_t(rng, 7), _t(rng, 7))),
+                             SampleInput((_t(rng, 5), _t(rng, 5, 3))),
+                             SampleInput((_t(rng, 4, 5), _t(rng, 5))),
+                             SampleInput((_t(rng, 2, 3, 4, 5), _t(rng, 5, 3))),
+                             SampleInput((_t(rng, 2, 1, 4, 5), _t(rng, 3, 5, 6)))]))
+register(OpInfo("linear", ops.linear,
+                lambda a, w, b=None: a @ w.T + (0 if b is None else b),
+                lambda rng: [SampleInput((_t(rng, 4, 5), _t(rng, 3, 5))),
+                             SampleInput((_t(rng, 2, 4, 5), _t(rng, 3, 5), _t(rng, 3)))]))
+register(OpInfo("outer", ops.outer, jnp.outer,
+                lambda rng: [SampleInput((_t(rng, 4), _t(rng, 5)))]))
+register(OpInfo("conv2d", ops.conv2d,
+                lambda a, w, b=None, stride=1, padding=0, dilation=1, groups=1:
+                    jax.lax.conv_general_dilated(
+                        a, w,
+                        window_strides=(stride, stride) if isinstance(stride, int) else stride,
+                        padding=[(padding, padding)] * 2 if isinstance(padding, int) else [(p, p) for p in padding],
+                        rhs_dilation=(dilation, dilation) if isinstance(dilation, int) else dilation,
+                        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                        feature_group_count=groups)
+                    + (0 if b is None else b.reshape(1, -1, 1, 1)),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 8, 8), _t(rng, 4, 3, 3, 3))),
+                             SampleInput((_t(rng, 2, 3, 8, 8), _t(rng, 4, 3, 3, 3), _t(rng, 4)),
+                                         {"stride": 2, "padding": 1})],
+                supports_grad=False))
+
+# -- nn ----------------------------------------------------------------------
+register(OpInfo("embedding", ops.embedding,
+                lambda ids, w: w[ids],
+                lambda rng: [SampleInput((rng.randint(0, 10, size=(4, 3)), _t(rng, 10, 5)))]))
+register(OpInfo("layer_norm", ops.layer_norm,
+                lambda a, shape, w=None, b=None, eps=1e-5: _ref_layer_norm(a, shape, w, b, eps),
+                lambda rng: [SampleInput((_t(rng, 4, 6), (6,), _t(rng, 6), _t(rng, 6)))],
+                atol=1e-4))
+register(OpInfo("rms_norm", ops.rms_norm,
+                lambda a, w=None, eps=1e-5, dim=-1: _ref_rms_norm(a, w, eps, dim),
+                lambda rng: [SampleInput((_t(rng, 4, 6), _t(rng, 6)))],
+                atol=1e-4))
+register(OpInfo("mse_loss", ops.mse_loss,
+                lambda i, t, reduction="mean": jnp.mean((i - t) ** 2) if reduction == "mean" else jnp.sum((i - t) ** 2),
+                lambda rng: [SampleInput((_t(rng, 4, 5), _t(rng, 4, 5)))]))
+register(OpInfo("cross_entropy", ops.cross_entropy,
+                lambda logits, tgt, **kw: _ref_cross_entropy(logits, tgt, **kw),
+                lambda rng: [SampleInput((_t(rng, 8, 10, lo=-3, hi=3), rng.randint(0, 10, size=(8,)))),
+                             SampleInput((_t(rng, 8, 10, lo=-3, hi=3),
+                                          np.where(np.arange(8) % 3 == 0, -100, np.arange(8) % 10)))],
+                atol=1e-4))
+register(OpInfo("sdpa", ops.scaled_dot_product_attention,
+                lambda q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None:
+                    _ref_sdpa(q, k, v, attn_mask, is_causal, scale),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4, 8), _t(rng, 2, 3, 4, 8), _t(rng, 2, 3, 4, 8))),
+                             SampleInput((_t(rng, 2, 3, 4, 8), _t(rng, 2, 3, 4, 8), _t(rng, 2, 3, 4, 8)),
+                                         {"is_causal": True})],
+                atol=1e-4))
+register(OpInfo("one_hot", ops.one_hot,
+                lambda ids, n: jax.nn.one_hot(ids, n, dtype=jnp.int32),
+                lambda rng: [SampleInput((rng.randint(0, 6, size=(4, 3)), 6))],
+                supports_grad=False))
+
+
+def _ref_layer_norm(a, shape, w, b, eps):
+    dims = tuple(range(a.ndim - len(shape), a.ndim))
+    m = jnp.mean(a, axis=dims, keepdims=True)
+    v = jnp.var(a, axis=dims, keepdims=True)
+    out = (a - m) / jnp.sqrt(v + eps)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
+def _ref_rms_norm(a, w, eps, dim):
+    ms = jnp.mean(a * a, axis=dim, keepdims=True)
+    out = a / jnp.sqrt(ms + eps)
+    if w is not None:
+        out = out * w
+    return out
+
+
+def _ref_cross_entropy(logits, tgt, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = tgt != ignore_index
+    safe = jnp.where(valid, tgt, 0)
+    nll = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+    if label_smoothing > 0:
+        nll = nll * (1 - label_smoothing) + (-jnp.mean(logp, axis=-1)) * label_smoothing
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    if reduction == "none":
+        return nll
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+
+
+def _ref_sdpa(q, k, v, attn_mask, is_causal, scale):
+    import math as _m
+
+    E = q.shape[-1]
+    s = scale if scale is not None else 1.0 / _m.sqrt(E)
+    scores = (q @ jnp.swapaxes(k, -1, -2)) * s
+    L, S = q.shape[-2], k.shape[-2]
+    if is_causal:
+        mask = jnp.tril(jnp.ones((L, S), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -jnp.inf)
+        else:
+            scores = scores + attn_mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    return probs @ v

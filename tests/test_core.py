@@ -1,0 +1,193 @@
+"""Core IR tests: trace printing/round-trip, DCE, CSE, caching, guards.
+
+Reference parity: ``thunder/tests/test_core.py``.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.trace import TraceCtx, tracectx
+from thunder_tpu.core.transform_common import cse, dce
+import thunder_tpu.ops as ops
+
+
+def _simple_trace():
+    trc = TraceCtx("computation")
+    with tracectx(trc):
+        a = TensorProxy("a", shape=(4,), dtype=dtypes.float32)
+        b = TensorProxy("b", shape=(4,), dtype=dtypes.float32)
+        c = prims.add(a, b)
+        d = prims.mul(c, a)
+        unused = prims.sub(a, b)  # dead
+        prims.python_return(d)
+    trc.args = [a, b]
+    trc.output = d
+    return trc
+
+
+def test_trace_prints_as_python():
+    trc = _simple_trace()
+    src = trc.python()
+    assert "def computation(a, b):" in src
+    assert "add(a, b)" in src
+    assert "return" in src
+    # printed trace compiles
+    compile(src, "<trace>", "exec")
+
+
+def test_trace_executes():
+    trc = _simple_trace()
+    fn = trc.python_callable()
+    a = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    b = np.ones(4, np.float32)
+    np.testing.assert_allclose(fn(a, b), (a + b) * a)
+
+
+def test_dce_removes_dead_code():
+    trc = _simple_trace()
+    n_before = len(trc.bound_symbols)
+    trc2 = dce(trc)
+    assert len(trc2.bound_symbols) == n_before - 1
+    assert "sub" not in trc2.python()
+
+
+def test_cse_dedupes():
+    trc = TraceCtx("computation")
+    with tracectx(trc):
+        a = TensorProxy("a", shape=(4,), dtype=dtypes.float32)
+        x = prims.add(a, a)
+        y = prims.add(a, a)  # duplicate
+        z = prims.mul(x, y)
+        prims.python_return(z)
+    trc.args = [a]
+    trc.output = z
+    trc2 = dce(cse(trc))
+    adds = [b for b in trc2.bound_symbols if b.sym.name == "add"]
+    assert len(adds) == 1
+    fn = trc2.python_callable()
+    av = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    np.testing.assert_allclose(fn(av), (av + av) * (av + av))
+
+
+def test_cache_hit_on_same_metadata():
+    def f(a, b):
+        return a + b
+
+    jf = tt.jit(f)
+    a = np.ones((3, 3), np.float32)
+    jf(a, a)
+    jf(a + 1, a + 2)  # same shapes/dtypes -> hit
+    assert tt.cache_misses(jf) == 1
+    assert tt.cache_hits(jf) == 1
+
+
+def test_cache_miss_on_new_shape():
+    def f(a):
+        return a * 2.0
+
+    jf = tt.jit(f)
+    jf(np.ones((3,), np.float32))
+    jf(np.ones((4,), np.float32))
+    assert tt.cache_misses(jf) == 2
+
+
+def test_cache_miss_on_number_change():
+    """CONSTANT_VALUES semantics: python numbers are baked + guarded."""
+
+    def f(a, scale):
+        return a * scale
+
+    jf = tt.jit(f)
+    a = np.ones((3,), np.float32)
+    np.testing.assert_allclose(jf(a, 2.0), a * 2.0)
+    np.testing.assert_allclose(jf(a, 3.0), a * 3.0)
+    assert tt.cache_misses(jf) == 2
+
+
+def test_prologue_guards_raise_on_mismatch():
+    from thunder_tpu.executors.eagerjax import GuardFailure
+
+    def f(a):
+        return a + 1.0
+
+    jf = tt.jit(f)
+    a = np.ones((3,), np.float32)
+    jf(a)
+    entry = next(iter(jf._cache.values()))
+    with pytest.raises(GuardFailure):
+        entry.prologue_fn(np.ones((4,), np.float32))
+
+
+def test_last_traces_progression():
+    def f(a):
+        return (a * a).sum()
+
+    jf = tt.jit(f)
+    jf(np.ones((3,), np.float32))
+    traces = tt.last_traces(jf)
+    assert len(traces) >= 3
+    assert "Tracing" in traces[0].provenance.pss
+    assert any("fusion" in t.python().lower() or "Transform for execution" in t.provenance.pss
+               for t in traces)
+
+
+def test_number_proxy_static_arithmetic():
+    def f(a, n):
+        m = n * 2 + 1
+        return a * m
+
+    jf = tt.jit(f)
+    a = np.ones((3,), np.float32)
+    np.testing.assert_allclose(jf(a, 3), a * 7)
+
+
+def test_nested_pytree_inputs():
+    def f(params, x):
+        return ops.matmul(x, params["w"]) + params["b"]
+
+    jf = tt.jit(f)
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(4, 3).astype(np.float32), "b": np.zeros(3, np.float32)}
+    x = rng.randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(jf(params, x)), x @ params["w"] + params["b"], atol=1e-6)
+
+
+def test_rng_ops_thread_key():
+    def f(a):
+        return a + ops.rand(*a.shape)
+
+    jf = tt.jit(f)
+    tt.manual_seed(0)
+    a = np.zeros((1000,), np.float32)
+    r1 = np.asarray(jf(a))
+    r2 = np.asarray(jf(a))
+    assert not np.allclose(r1, r2)  # different keys per call
+    assert (r1 >= 0).all() and (r1 <= 1).all()
+    tt.manual_seed(0)
+    r3 = np.asarray(jf(a))
+    np.testing.assert_allclose(r1, r3)  # reproducible after reseed
+
+
+def test_fusion_regions_created():
+    def f(a, b):
+        return ((a + b) * a - b).sum()
+
+    jf = tt.jit(f)
+    jf(np.ones((4,), np.float32), np.ones((4,), np.float32))
+    src = tt.last_execution_trace(jf).python()
+    assert "fusion" in src
+
+
+def test_del_last_used_inserted():
+    def f(a, b):
+        c = a + b
+        d = c * a
+        return d.sum()
+
+    jf = tt.jit(f, executors=["eagerjax"])
+    jf(np.ones((4,), np.float32), np.ones((4,), np.float32))
+    src = tt.last_execution_trace(jf).python()
+    assert "del " in src

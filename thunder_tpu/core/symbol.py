@@ -223,7 +223,25 @@ class BoundSymbol:
         # fall back to the always-on eager JAX executor for unclaimed prims
         from thunder_tpu.executors.eagerjax import get_eager_impl
 
-        return get_eager_impl(self.sym)
+        impl = get_eager_impl(self.sym)
+        if impl is not None or not self.subsymbols:
+            return impl
+        # unclaimed composite: interpret its decomposition
+        bsym = self
+
+        def composite_impl(*args, **kwargs):
+            from thunder_tpu.executors.xla import run_bsyms, _subst
+
+            env: dict = {}
+            spec_flat, _ = tree_flatten((bsym.args, bsym.kwargs))
+            val_flat, _ = tree_flatten((args, kwargs))
+            for spec, val in zip(spec_flat, val_flat):
+                if isinstance(spec, Proxy):
+                    env[spec.name] = val
+            run_bsyms(bsym.subsymbols, env)
+            return _subst(env, bsym.output)
+
+        return composite_impl
 
     def __repr__(self):
         return "\n".join(self.python(indent=0))
