@@ -33,7 +33,13 @@ def prettyprint(x: Any) -> str:
     if isinstance(x, MeshSpec):
         kw = ", ".join(f"{n}={s}" for n, s in zip(x.axis_names, x.axis_sizes))
         return f"devices.MeshSpec.make({kw})"
-    if isinstance(x, (bool, int, float, complex, str, bytes)) or x is None:
+    if isinstance(x, float):
+        import math
+
+        if math.isinf(x) or math.isnan(x):
+            return f'float("{x}")'
+        return repr(x)
+    if isinstance(x, (bool, int, complex, str, bytes)) or x is None:
         return repr(x)
     if x is Ellipsis:
         return "..."

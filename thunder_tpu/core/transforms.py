@@ -450,7 +450,10 @@ def _pow_vjp(a, b):
         ga = ops.mul(g, ops.mul(b, ops.pow(a, ops.sub(b, 1.0)))) if isinstance(a, TensorProxy) else None
         gb = None
         if isinstance(b, TensorProxy):
-            loga = ops.where(ops.gt(a, 0.0), ops.log(ops.maximum(a, 1e-45)), ops.zeros_like(a))
+            if isinstance(a, TensorProxy):
+                loga = ops.where(ops.gt(a, 0.0), ops.log(ops.maximum(a, 1e-45)), ops.zeros_like(a))
+            else:
+                loga = math.log(a) if a > 0 else 0.0
             gb = ops.mul(g, ops.mul(out, loga))
         return _pairs((a, ga), (b, gb))
 

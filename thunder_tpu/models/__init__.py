@@ -1,0 +1,5 @@
+"""Model zoo: self-contained model definitions written against
+``thunder_tpu.ops`` (reference parity: ``thunder/tests/nanogpt_model.py``,
+``litgpt_model.py``, ``llama2_model.py`` — fresh implementations)."""
+
+from thunder_tpu.models import llama  # noqa: F401

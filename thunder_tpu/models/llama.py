@@ -1,0 +1,181 @@
+"""Llama-family transformer (Llama 2 / Llama 3 / tiny configs), written
+functionally against ``thunder_tpu.ops``.
+
+Covers the reference's model-zoo role (``thunder/tests/llama2_model.py``,
+``litgpt`` GPT in ``thunder/tests/litgpt_model.py``) with the BASELINE.md
+configs: tiny-stories Llama (config 1), Llama-2-7B (configs 2-3),
+Llama-3-8B with GQA (config 4). Pure functions over a params pytree — the
+TPU-first shape: the whole train step (fwd+bwd+optimizer) compiles into one
+XLA program, and the distributed transforms shard the params pytree.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    name: str = "tiny"
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int | None = None  # GQA when < n_heads
+    intermediate_size: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: dtypes.dtype = dtypes.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    # llama2.c tiny-stories scale (BASELINE config 1)
+    "tiny": LlamaConfig(name="tiny", vocab_size=512, dim=64, n_layers=4, n_heads=4,
+                        intermediate_size=176, max_seq_len=256),
+    "tiny-gqa": LlamaConfig(name="tiny-gqa", vocab_size=512, dim=64, n_layers=4, n_heads=4,
+                            n_kv_heads=2, intermediate_size=176, max_seq_len=256),
+    "llama2-7b": LlamaConfig(name="llama2-7b", vocab_size=32000, dim=4096, n_layers=32,
+                             n_heads=32, intermediate_size=11008, max_seq_len=4096,
+                             dtype=dtypes.bfloat16),
+    "llama2-7b-bench": LlamaConfig(name="llama2-7b-bench", vocab_size=32000, dim=4096,
+                                   n_layers=32, n_heads=32, intermediate_size=11008,
+                                   max_seq_len=2048, dtype=dtypes.bfloat16),
+    "llama3-8b": LlamaConfig(name="llama3-8b", vocab_size=128256, dim=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, intermediate_size=14336,
+                             max_seq_len=8192, rope_theta=500000.0, dtype=dtypes.bfloat16),
+}
+
+
+def init_params(cfg: LlamaConfig, seed: int = 0, scale_layers: int | None = None):
+    """Initialize a params pytree with jax (host-side; not traced)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_layers = scale_layers if scale_layers is not None else cfg.n_layers
+    key = jax.random.PRNGKey(seed)
+    jd = cfg.dtype.jax
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))).astype(jd)
+
+    keys = iter(jax.random.split(key, 4 + n_layers * 7))
+    params = {
+        "tok_embedding": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "norm_f": jnp.ones((cfg.dim,), jd),
+        "lm_head": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": [],
+    }
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    for _ in range(n_layers):
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.dim,), jd),
+            "wq": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+            "wk": dense(next(keys), (kv_dim, cfg.dim), cfg.dim),
+            "wv": dense(next(keys), (kv_dim, cfg.dim), cfg.dim),
+            "wo": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+            "mlp_norm": jnp.ones((cfg.dim,), jd),
+            "w_gate": dense(next(keys), (cfg.intermediate_size, cfg.dim), cfg.dim),
+            "w_up": dense(next(keys), (cfg.intermediate_size, cfg.dim), cfg.dim),
+            "w_down": dense(next(keys), (cfg.dim, cfg.intermediate_size), cfg.intermediate_size),
+        })
+        # wq..w_down consumed 5 keys; gate/up/down 3 more handled above
+    return params
+
+
+def _rope_cos_sin(cfg: LlamaConfig, T: int, dtype):
+    """cos/sin tables built from iota (fully fusible, no host constants)."""
+    hd = cfg.head_dim
+    pos = ops.convert_element_type(ops.arange(T), dtypes.float32)  # (T,)
+    idx = ops.convert_element_type(ops.arange(hd // 2), dtypes.float32)  # (hd/2,)
+    inv_freq = ops.pow(cfg.rope_theta, ops.true_divide(ops.mul(idx, -2.0), float(hd)))
+    angles = ops.mul(ops.unsqueeze(pos, 1), ops.unsqueeze(inv_freq, 0))  # (T, hd/2)
+    cos = ops.convert_element_type(ops.cos(angles), dtype)
+    sin = ops.convert_element_type(ops.sin(angles), dtype)
+    return cos, sin
+
+
+def _apply_rope(x, cos, sin):
+    """x: (B, H, T, hd); GPT-NeoX half-rotation."""
+    hd = x.shape[-1]
+    x1 = x[..., : hd // 2]
+    x2 = x[..., hd // 2:]
+    # cos/sin: (T, hd/2) -> broadcast over (B, H)
+    rx1 = ops.sub(ops.mul(x1, cos), ops.mul(x2, sin))
+    rx2 = ops.add(ops.mul(x2, cos), ops.mul(x1, sin))
+    return ops.cat([rx1, rx2], -1)
+
+
+def forward(params, tokens, cfg: LlamaConfig):
+    """tokens: (B, T) int32 -> logits (B, T, vocab)."""
+    B, T = tokens.shape
+    h = ops.embedding(tokens, params["tok_embedding"])  # (B, T, D)
+    cos, sin = _rope_cos_sin(cfg, T, h.dtype)
+    n_rep = cfg.n_heads // cfg.kv_heads
+    hd = cfg.head_dim
+
+    for layer in params["layers"]:
+        # attention block
+        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+        q = ops.linear(x, layer["wq"])  # (B, T, D)
+        k = ops.linear(x, layer["wk"])  # (B, T, kv_dim)
+        v = ops.linear(x, layer["wv"])
+        q = ops.transpose(ops.reshape(q, (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
+        k = ops.transpose(ops.reshape(k, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        v = ops.transpose(ops.reshape(v, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        if n_rep > 1:  # GQA: repeat kv heads
+            k = ops.reshape(ops.expand(ops.unsqueeze(k, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                            (B, cfg.n_heads, T, hd))
+            v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                            (B, cfg.n_heads, T, hd))
+        attn = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.dim))
+        h = ops.add(h, ops.linear(attn, layer["wo"]))
+
+        # SwiGLU MLP block
+        x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
+        gate = ops.silu(ops.linear(x, layer["w_gate"]))
+        up = ops.linear(x, layer["w_up"])
+        h = ops.add(h, ops.linear(ops.mul(gate, up), layer["w_down"]))
+
+    h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+    logits = ops.linear(h, params["lm_head"])
+    return logits
+
+
+def loss_fn(params, tokens, targets, cfg: LlamaConfig):
+    logits = forward(params, tokens, cfg)
+    B, T, V = logits.shape
+    logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
+    return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
+
+
+def num_params(cfg: LlamaConfig, n_layers: int | None = None) -> int:
+    n_layers = n_layers if n_layers is not None else cfg.n_layers
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    per_layer = (2 * cfg.dim  # norms
+                 + 2 * cfg.dim * cfg.dim  # wq, wo
+                 + 2 * kv_dim * cfg.dim  # wk, wv
+                 + 3 * cfg.dim * cfg.intermediate_size)  # gate/up/down
+    return (2 * cfg.vocab_size * cfg.dim + cfg.dim + n_layers * per_layer)
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int, n_layers: int | None = None) -> float:
+    """Model FLOPs per token for fwd+bwd (6N + attention terms)."""
+    n = num_params(cfg, n_layers) - 2 * cfg.vocab_size * cfg.dim
+    attn = 2 * 2 * (n_layers or cfg.n_layers) * cfg.dim * seq_len  # qk^T + pv per token
+    return 6 * (n + cfg.vocab_size * cfg.dim) + 3 * attn
