@@ -1,0 +1,169 @@
+"""Distributed transform tests on the 8-device virtual CPU mesh.
+
+Reference parity: ``thunder/tests/distributed/`` (test_ddp.py grad parity,
+test_fsdp.py ZeRO + trace assertions on collective placement,
+test_tensor_parallel.py) — but hermetic: the reference needs 2+ real GPUs
+and NCCL; here collectives run on emulated devices (SURVEY §4 lesson).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.core.devices import MeshSpec
+from thunder_tpu.distributed import ddp, fsdp, tensor_parallel
+from thunder_tpu.models import llama
+from thunder_tpu.optim import AdamW, SGD
+
+N = 8
+
+
+def _make_step(cfg, opt):
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_params, new_state = opt.update(params, grads, opt_state)
+        return loss, new_params, new_state
+
+    return train_step
+
+
+def _data(cfg, batch, seq, seed):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    return tokens, targets
+
+
+def _run_steps(jstep, params, opt_state, tokens, targets, n=3):
+    losses = []
+    for _ in range(n):
+        loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+        losses.append(float(np.asarray(loss)))
+    return losses, params
+
+
+@pytest.mark.parametrize("mode", ["fsdp", "ddp"])
+def test_data_parallel_matches_single_device(eight_devices, mode):
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, N, 16, seed=0)
+
+    # single-device reference
+    ref_losses, ref_params = _run_steps(tt.jit(_make_step(cfg, opt)), params, opt.init(params),
+                                        tokens, targets)
+
+    wrap = fsdp if mode == "fsdp" else ddp
+    jstep = wrap(_make_step(cfg, opt), MeshSpec.make(**{"fsdp" if mode == "fsdp" else "dp": N}))
+    dist_losses, dist_params = _run_steps(jstep, params, opt.init(params), tokens, targets)
+
+    np.testing.assert_allclose(ref_losses, dist_losses, atol=1e-5, rtol=1e-5)
+    # updated params match (gather the distributed result automatically via
+    # jax global arrays)
+    flat_ref, _ = jax.tree_util.tree_flatten(ref_params)
+    flat_dist, _ = jax.tree_util.tree_flatten(dist_params)
+    for r, d in zip(flat_ref, flat_dist):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=1e-5, rtol=1e-4)
+
+
+def test_fsdp_adamw_zero_state_sharding(eight_devices):
+    """AdamW moments are born sharded (ZeRO-1/2) and training still matches
+    the single-device run."""
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=1, scale_layers=2)
+    opt = AdamW(lr=3e-3)
+    tokens, targets = _data(cfg, N, 8, seed=1)
+
+    ref_losses, _ = _run_steps(tt.jit(_make_step(cfg, opt)), params, opt.init(params),
+                               tokens, targets)
+    jstep = fsdp(_make_step(cfg, opt), MeshSpec.make(fsdp=N))
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(3):
+        loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+        losses.append(float(np.asarray(loss)))
+    np.testing.assert_allclose(ref_losses, losses, atol=1e-5, rtol=1e-5)
+    # moment tensors come back sharded across the fsdp axis
+    m_leaf = opt_state["m"]["tok_embedding"]
+    assert len(m_leaf.sharding.device_set) == N
+
+
+def test_fsdp_trace_contains_collectives(eight_devices):
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=2, scale_layers=1)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, N, 8, seed=2)
+    jstep = fsdp(_make_step(cfg, opt), MeshSpec.make(fsdp=N))
+    jstep(params, opt.init(params), tokens, targets)
+    src = tt.last_traces(jstep)[0].python()
+    assert "synchronize" in src  # param all-gather in forward
+    assert "reduce_scatter" in src  # grad reduce-scatter in backward
+    assert "all_reduce" in src  # loss averaging
+
+
+def test_ddp_trace_contains_allreduce(eight_devices):
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=3, scale_layers=1)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, N, 8, seed=3)
+    jstep = ddp(_make_step(cfg, opt), MeshSpec.make(dp=N))
+    jstep(params, opt.init(params), tokens, targets)
+    src = tt.last_traces(jstep)[0].python()
+    assert "synchronize" in src
+    assert "all_reduce" in src
+
+
+def test_tensor_parallel_matches_single_device(eight_devices):
+    cfg = llama.CONFIGS["tiny"]  # 4 heads, intermediate 176 -> tp=4
+    tp_n = 4
+    params = llama.init_params(cfg, seed=4, scale_layers=2)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 2, 8, seed=4)
+
+    ref_losses, ref_params = _run_steps(tt.jit(_make_step(cfg, opt)), params, opt.init(params),
+                                        tokens, targets)
+
+    local_cfg = llama.tp_config(cfg, tp_n)
+    jstep = tensor_parallel(_make_step(local_cfg, opt), MeshSpec.make(tp=tp_n),
+                            column_patterns=llama.TP_COLUMN_PATTERNS,
+                            row_patterns=llama.TP_ROW_PATTERNS)
+    tp_losses, tp_params = _run_steps(jstep, params, opt.init(params), tokens, targets)
+    np.testing.assert_allclose(ref_losses, tp_losses, atol=1e-5, rtol=1e-5)
+    flat_ref, _ = jax.tree_util.tree_flatten(ref_params)
+    flat_tp, _ = jax.tree_util.tree_flatten(tp_params)
+    for r, d in zip(flat_ref, flat_tp):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=1e-5, rtol=1e-4)
+
+
+def test_collective_prims_lower_to_lax(eight_devices):
+    """Direct semantics of the collective prim impls inside shard_map."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from thunder_tpu.distributed import prims as dp
+    from thunder_tpu.executors.eagerjax import get_eager_impl
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("x",))
+    ag = get_eager_impl(dp.all_gather)
+    rs = get_eager_impl(dp.reduce_scatter)
+    ar = get_eager_impl(dp.all_reduce)
+
+    x = np.arange(N * 4, dtype=np.float32).reshape(N, 4)
+
+    def body(xs):
+        g = ag(xs, "x", 0, N)  # (N, 4)
+        s = ar(xs, "x", "sum")
+        r = rs(g, "x", 0, N)
+        return g, s, r
+
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        f = sm(body, mesh=mesh, in_specs=(P("x"),), out_specs=(P(), P("x"), P("x")), check_vma=False)
+    except TypeError:
+        f = sm(body, mesh=mesh, in_specs=(P("x"),), out_specs=(P(), P("x"), P("x")), check_rep=False)
+    g, s, r = f(x)
+    np.testing.assert_allclose(np.asarray(g), x)  # gather reassembles
+    np.testing.assert_allclose(np.asarray(s), np.broadcast_to(x.sum(0, keepdims=True), (N, 4)))
+    np.testing.assert_allclose(np.asarray(r), x * N)  # reduce_scatter of gathered

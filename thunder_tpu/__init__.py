@@ -76,12 +76,13 @@ class CompileStats:
 
 
 class CacheEntry:
-    __slots__ = ("computation_fn", "tensor_indices", "uses_rng", "traces", "prologue_trace",
-                 "prologue_fn", "out_spec")
+    __slots__ = ("computation_fn", "run_fn", "tensor_indices", "uses_rng", "traces",
+                 "prologue_trace", "prologue_fn", "out_spec")
 
     def __init__(self, computation_fn, tensor_indices, uses_rng, traces, prologue_trace,
                  prologue_fn, out_spec):
         self.computation_fn = computation_fn
+        self.run_fn = computation_fn  # may be wrapped (e.g. shard_map) by subclasses
         self.tensor_indices = tensor_indices
         self.uses_rng = uses_rng
         self.traces = traces
@@ -147,7 +148,7 @@ class ThunderTPUFunction:
         inps = [flat[i] for i in entry.tensor_indices]
         if entry.uses_rng:
             inps.append(_next_rng_key())
-        result_flat = entry.computation_fn(*inps)
+        result_flat = entry.run_fn(*inps)
         return result_flat
 
     # -- compilation --------------------------------------------------------
@@ -158,7 +159,7 @@ class ThunderTPUFunction:
             proxies = []
             for i, leaf in enumerate(flat):
                 if _is_arraylike(leaf):
-                    p = TensorProxy(shape=leaf.shape, dtype=dtypes.to_dtype(leaf.dtype))
+                    p = self._make_input_proxy(i, leaf)
                     proxies.append(p)
                     tensor_indices.append(i)
                 else:
@@ -235,9 +236,17 @@ class ThunderTPUFunction:
         uses_rng = getattr(traces[0], "rng_input_proxy", None) is not None
         entry = CacheEntry(computation_fn, tensor_indices, uses_rng, traces, prologue,
                            prologue_fn, None)
+        self._finalize_entry(entry, flat, exec_trc)
         self._stats.last_traces = traces
         self._stats.last_prologue_traces = [prologue]
         return entry
+
+    # -- subclass hooks (distributed wrappers override these) ---------------
+    def _make_input_proxy(self, i: int, leaf) -> TensorProxy:
+        return TensorProxy(shape=leaf.shape, dtype=dtypes.to_dtype(leaf.dtype))
+
+    def _finalize_entry(self, entry: CacheEntry, flat, exec_trc) -> None:
+        pass
 
     # -- introspection ------------------------------------------------------
     @property

@@ -52,6 +52,10 @@ def prettyprint(x: Any) -> str:
         return "[" + ", ".join(prettyprint(i) for i in x) + "]"
     if isinstance(x, dict):
         return "{" + ", ".join(f"{prettyprint(k)}: {prettyprint(v)}" for k, v in x.items()) + "}"
+    from enum import Enum
+
+    if isinstance(x, Enum):
+        return f"{type(x).__name__}.{x.name}"
     if isinstance(x, type):
         return x.__name__
     if callable(x) and hasattr(x, "__name__"):

@@ -135,7 +135,10 @@ class TraceCtx:
         from thunder_tpu.core import devices as _dev
         import thunder_tpu as _tt
 
-        ctx: dict[str, Any] = {"dtypes": _dt, "devices": _dev, "thunder_tpu": _tt}
+        from thunder_tpu.core.proxies import DistParallelType
+
+        ctx: dict[str, Any] = {"dtypes": _dt, "devices": _dev, "thunder_tpu": _tt,
+                               "DistParallelType": DistParallelType}
         for bsym in self.bound_symbols:
             bsym.gather_ctx(ctx)
         ctx.update(self._python_ctx_extra)

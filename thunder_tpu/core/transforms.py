@@ -169,8 +169,10 @@ def backward_pass(records: list[PullbackRecord], grads: dict[Variable, Any]) -> 
     """Walk pullbacks in reverse, accumulating cotangents keyed by Variable."""
     from thunder_tpu import ops
 
+    from thunder_tpu.core.proxies import FutureTensorProxy
+
     def put_grad(p, g):
-        if g is None or not isinstance(p, TensorProxy):
+        if g is None or not isinstance(p, (TensorProxy, FutureTensorProxy)):
             return
         if not p.dtype.is_inexact:
             return
@@ -209,17 +211,38 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
         # share the name registry so replayed proxies don't collide
         inner._names = outer._names
         inner._counters = outer._counters
+    from thunder_tpu.core.proxies import DistParallelType
+
     with tracectx(inner):
         flat, treedef = tree_flatten((args, kwargs))
-        proxies = []
+        proxies = []   # input proxies of the inner trace
+        passed = []    # values the traced fn actually receives
         for leaf in flat:
             if isinstance(leaf, TensorProxy):
-                proxies.append(TensorProxy(shape=leaf.shape, dtype=leaf.dtype, device=leaf.device))
+                p = TensorProxy(shape=leaf.shape, dtype=leaf.dtype, device=leaf.device,
+                                distparallel_type=leaf.distparallel_type)
+                for attr in ("dist_axis", "dist_size"):
+                    if hasattr(leaf, attr):
+                        setattr(p, attr, getattr(leaf, attr))
+                proxies.append(p)
+                # distributed param sync INSIDE the grad scope: FSDP params are
+                # all-gathered here and their VJP reduce-scatters the grads
+                # (reference: synchronize in fwd, prims.py:376-419)
+                if (p.distparallel_type in (DistParallelType.FULLY_SHARDED, DistParallelType.REPLICATED)
+                        and getattr(p, "dist_axis", None) is not None):
+                    from thunder_tpu.distributed import prims as dist_prims
+
+                    passed.append(dist_prims.synchronize(p, p.dist_axis, p.distparallel_type,
+                                                         p.dist_size))
+                else:
+                    passed.append(p)
             elif isinstance(leaf, Proxy):
                 proxies.append(leaf)
+                passed.append(leaf)
             else:
                 proxies.append(leaf)
-        pargs, pkwargs = tree_unflatten(treedef, proxies)
+                passed.append(leaf)
+        pargs, pkwargs = tree_unflatten(treedef, passed)
         out = fn(*pargs, **pkwargs)
         prims.python_return(out)
     inner.output = out

@@ -12,7 +12,20 @@ from __future__ import annotations
 from contextlib import contextmanager
 from typing import Any
 
+from thunder_tpu.core.devices import MeshSpec  # noqa: F401
+
 _mesh_stack: list = []
+
+
+def init_multihost(**kwargs) -> None:
+    """Initialize multi-host JAX (DCN coordination). The TPU replacement for
+    ``torch.distributed.init_process_group`` (reference
+    ``thunder/distributed/__init__.py:74``): afterwards ``jax.devices()``
+    spans all hosts and meshes built from it ride ICI within a slice and DCN
+    across slices."""
+    import jax
+
+    jax.distributed.initialize(**kwargs)
 
 
 def current_mesh():
@@ -28,3 +41,14 @@ def use_mesh(mesh):
         yield mesh
     finally:
         _mesh_stack.pop()
+
+
+# collective prims (registers eager impls + VJP rules) and the parallelism
+# transforms; imported last to keep the dependency order acyclic
+from thunder_tpu.distributed import prims  # noqa: E402,F401
+from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
+    DistributedFunction,
+    ddp,
+    fsdp,
+    tensor_parallel,
+)

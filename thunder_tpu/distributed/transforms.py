@@ -1,0 +1,274 @@
+"""Distributed parallelism transforms: DDP, FSDP (ZeRO), tensor parallel.
+
+Reference parity: ``thunder/distributed/__init__.py`` (``ddp`` :192,
+``fsdp`` :574) and ``thunder/distributed/tensor_parallel/`` — re-architected
+for TPU:
+
+- No process groups: a ``DistributedFunction`` traces the user's train step
+  with *local shard shapes*, marks parameter proxies with their
+  ``DistParallelType``, and the sync collectives appear in the trace as
+  explicit prims (inspectable + testable). Execution wraps the compiled
+  program in ``shard_map`` over a ``jax.sharding.Mesh``; XLA schedules the
+  collectives over ICI/DCN.
+- ZeRO falls out of whole-step compilation: params enter as shards, the
+  ``synchronize`` VJP reduce-scatters grads to shards, and the (traced)
+  optimizer updates shards — optimizer state is born sharded.
+- No bucketing/sort_waits machinery: XLA's combiner thresholds and
+  async-collective scheduler replace ``GradBuckets``/``sort_communication_ops``
+  (reference ``distributed/bucketing.py``, ``distributed/utils.py``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+import jax.tree_util as jtu
+
+import thunder_tpu as tt
+from thunder_tpu import CacheEntry, ThunderTPUFunction
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.devices import MeshSpec
+from thunder_tpu.core.proxies import DistParallelType, TensorProxy
+from thunder_tpu.core.pytree import tree_flatten, tree_map
+
+
+def _shard_map():
+    try:
+        return jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm
+
+
+def _P(*args):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*args)
+
+
+class LeafPlan:
+    """How one flat input leaf participates in the mesh."""
+
+    __slots__ = ("kind", "spec", "mark", "shard_dim")
+
+    def __init__(self, kind: str, spec, mark: DistParallelType = DistParallelType.NONE,
+                 shard_dim: int | None = None):
+        self.kind = kind  # "param_shard" | "data_shard" | "replicate" | "column" | "row"
+        self.spec = spec
+        self.mark = mark
+        self.shard_dim = shard_dim
+
+
+class DistributedFunction(ThunderTPUFunction):
+    def __init__(self, fn, mesh_spec: MeshSpec, *, mode: str, axis: str,
+                 params_argnums: Sequence[int] = (0,), column_patterns=(), row_patterns=(),
+                 shard_data: bool = True, data_argnums: Sequence[int] | None = None,
+                 zero: int = 3, **jit_kwargs):
+        self.data_argnums = tuple(data_argnums) if data_argnums is not None else None
+        self.mesh_spec = mesh_spec
+        self.axis = axis
+        self.size = dict(zip(mesh_spec.axis_names, mesh_spec.axis_sizes))[axis]
+        self.mode = mode
+        self.params_argnums = tuple(params_argnums)
+        self.column_re = re.compile("|".join(column_patterns)) if column_patterns else None
+        self.row_re = re.compile("|".join(row_patterns)) if row_patterns else None
+        self.shard_data = shard_data
+        self.zero = zero
+        self._mesh = None
+        self._plan: list[LeafPlan] = []
+
+        orig_fn = fn
+
+        def wrapped(*args, **kwargs):
+            out = orig_fn(*args, **kwargs)
+            if self.size > 1 and mode in ("fsdp", "ddp"):
+                out = tree_map(self._mean_scalar_across_replicas, out)
+            return out
+
+        wrapped.__name__ = getattr(fn, "__name__", "fn")
+        super().__init__(wrapped, **jit_kwargs)
+        self._orig_fn = fn
+
+    # -- scalar outputs (losses) are averaged across data-parallel ranks -----
+    def _mean_scalar_across_replicas(self, leaf):
+        from thunder_tpu import ops
+        from thunder_tpu.distributed import prims as dist_prims
+
+        if isinstance(leaf, TensorProxy) and leaf.ndim == 0 and leaf.dtype.is_inexact:
+            red = dist_prims.wait(dist_prims.all_reduce(leaf, self.axis, "sum"))
+            return ops.true_divide(red, float(self.size))
+        return leaf
+
+    # -- leaf classification -------------------------------------------------
+    def _build_plan(self, args, kwargs) -> list[LeafPlan]:
+        flat_with_paths, _ = jtu.tree_flatten_with_path((args, kwargs))
+        # leaf ranges per positional arg: path[0] is SequenceKey into (args, kwargs),
+        # path[1] is the index within args
+        plans: list[LeafPlan] = []
+        n = self.size
+        for path, leaf in flat_with_paths:
+            in_params = (len(path) >= 2 and getattr(path[0], "idx", None) == 0
+                         and getattr(path[1], "idx", None) in self.params_argnums)
+            pathstr = jtu.keystr(path)
+            is_array = hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+            if not is_array:
+                plans.append(LeafPlan("const", None))
+                continue
+            shape = tuple(leaf.shape)
+            if self.mode == "tp" and in_params:
+                if self.column_re is not None and self.column_re.search(pathstr) \
+                        and len(shape) >= 1 and shape[0] % n == 0:
+                    plans.append(LeafPlan("column", _P(self.axis), DistParallelType.COLUMN_WISE, 0))
+                    continue
+                if self.row_re is not None and self.row_re.search(pathstr) \
+                        and len(shape) >= 2 and shape[1] % n == 0:
+                    plans.append(LeafPlan("row", _P(None, self.axis), DistParallelType.ROW_WISE, 1))
+                    continue
+                plans.append(LeafPlan("replicate", _P()))
+                continue
+            if self.mode == "fsdp" and in_params:
+                if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
+                    plans.append(LeafPlan("param_shard", _P(self.axis),
+                                          DistParallelType.FULLY_SHARDED, 0))
+                else:
+                    plans.append(LeafPlan("replicate", _P()))
+                continue
+            if self.mode == "ddp" and in_params:
+                plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
+                continue
+            # non-param arrays: shard dim 0 (batch; plus optimizer state under
+            # FSDP — ZeRO state sharding) when divisible
+            import numpy as _np
+
+            if self.data_argnums is not None:
+                in_data = (len(path) >= 2 and getattr(path[0], "idx", None) == 0
+                           and getattr(path[1], "idx", None) in self.data_argnums)
+            elif self.mode == "fsdp":
+                in_data = True
+            elif self.mode == "ddp":
+                # DDP replicates float state (optimizer moments live with the
+                # replicated params); integer arrays are batch data
+                in_data = _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)
+            else:
+                in_data = False
+            if self.shard_data and in_data and self.mode in ("fsdp", "ddp") and len(shape) >= 1 \
+                    and shape[0] % n == 0 and shape[0] >= n:
+                plans.append(LeafPlan("data_shard", _P(self.axis), shard_dim=0))
+            else:
+                plans.append(LeafPlan("replicate", _P()))
+        return plans
+
+    # -- hooks ---------------------------------------------------------------
+    def _compile(self, flat, treedef, args, kwargs) -> CacheEntry:
+        self._plan = self._build_plan(args, kwargs)
+        check(len(self._plan) == len(flat), "leaf plan misaligned with flattened inputs")
+        return super()._compile(flat, treedef, args, kwargs)
+
+    def _make_input_proxy(self, i: int, leaf) -> TensorProxy:
+        plan = self._plan[i]
+        shape = list(leaf.shape)
+        if plan.shard_dim is not None:
+            check(shape[plan.shard_dim] % self.size == 0,
+                  lambda: f"dim {plan.shard_dim} of {tuple(leaf.shape)} not divisible by mesh axis {self.size}")
+            shape[plan.shard_dim] //= self.size
+        p = TensorProxy(shape=tuple(shape), dtype=dtypes.to_dtype(leaf.dtype),
+                        distparallel_type=plan.mark)
+        if plan.mark is not DistParallelType.NONE:
+            p.dist_axis = self.axis
+            p.dist_size = self.size
+        return p
+
+    def _finalize_entry(self, entry: CacheEntry, flat, exec_trc) -> None:
+        if self._mesh is None:
+            self._mesh = self.mesh_spec.build()
+        in_specs = [self._plan[i].spec for i in entry.tensor_indices]
+        if entry.uses_rng:
+            in_specs.append(_P())
+
+        sharded_local_shapes: dict[tuple, Any] = {}
+        for i in entry.tensor_indices:
+            plan = self._plan[i]
+            if plan.shard_dim is not None:
+                shape = list(flat[i].shape)
+                shape[plan.shard_dim] //= self.size
+                sharded_local_shapes[tuple(shape)] = plan.spec
+
+        def out_spec_for(leaf):
+            if isinstance(leaf, TensorProxy):
+                if leaf.shape in sharded_local_shapes:
+                    return sharded_local_shapes[leaf.shape]
+                return _P()
+            return _P()
+
+        out_specs = tree_map(out_spec_for, exec_trc.output)
+
+        sm = _shard_map()
+        try:
+            smapped = sm(entry.computation_fn, mesh=self._mesh, in_specs=tuple(in_specs),
+                         out_specs=out_specs, check_vma=False)
+        except TypeError:
+            smapped = sm(entry.computation_fn, mesh=self._mesh, in_specs=tuple(in_specs),
+                         out_specs=out_specs, check_rep=False)
+        from thunder_tpu.distributed import use_mesh
+
+        jitted = jax.jit(smapped)
+        mesh = self._mesh
+
+        def run(*inps):
+            with use_mesh(mesh):
+                return jitted(*inps)
+
+        entry.run_fn = run
+
+
+# ---------------------------------------------------------------------------
+# public APIs (reference: thunder.distributed.ddp/fsdp, tensor_parallel)
+# ---------------------------------------------------------------------------
+
+def _default_mesh_spec(axis: str) -> MeshSpec:
+    return MeshSpec.make(**{axis: len(jax.devices())})
+
+
+def fsdp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "fsdp",
+         params_argnums: Sequence[int] = (0,), zero: int = 3, **jit_kwargs) -> DistributedFunction:
+    """Fully-sharded data parallel (ZeRO-2/3 semantics; reference
+    ``thunder/distributed/__init__.py:574``).
+
+    Params (argnums ``params_argnums``) are sharded on dim 0 across ``axis``;
+    the trace all-gathers them inside the grad scope, reduce-scatters grads,
+    and the traced optimizer updates shards (optimizer state is born sharded
+    — ZeRO-1 included for free). Whether backward re-gathers (ZeRO-3) or
+    keeps gathered params (ZeRO-2) is XLA's rematerialization choice over the
+    single fused program.
+    """
+    mesh_spec = mesh_spec or _default_mesh_spec(axis)
+    return DistributedFunction(fn, mesh_spec, mode="fsdp", axis=axis,
+                               params_argnums=params_argnums, zero=zero, **jit_kwargs)
+
+
+def ddp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "dp",
+        params_argnums: Sequence[int] = (0,), **jit_kwargs) -> DistributedFunction:
+    """Replicated data parallel (reference ``thunder/distributed/__init__.py:192``):
+    params replicated, batch sharded on ``axis``, grads all-reduce-averaged via
+    the REPLICATED synchronize VJP."""
+    mesh_spec = mesh_spec or _default_mesh_spec(axis)
+    return DistributedFunction(fn, mesh_spec, mode="ddp", axis=axis,
+                               params_argnums=params_argnums, **jit_kwargs)
+
+
+def tensor_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "tp",
+                    column_patterns: Sequence[str] = (), row_patterns: Sequence[str] = (),
+                    params_argnums: Sequence[int] = (0,), **jit_kwargs) -> DistributedFunction:
+    """Megatron-style tensor parallelism (reference
+    ``thunder/distributed/tensor_parallel/``): params matching
+    ``column_patterns`` shard out-features (dim 0), ``row_patterns`` shard
+    in-features (dim 1); ``ops.linear`` inserts the boundary collectives."""
+    mesh_spec = mesh_spec or _default_mesh_spec(axis)
+    return DistributedFunction(fn, mesh_spec, mode="tp", axis=axis,
+                               params_argnums=params_argnums,
+                               column_patterns=column_patterns, row_patterns=row_patterns,
+                               **jit_kwargs)

@@ -31,6 +31,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: dtypes.dtype = dtypes.float32
+    head_dim_override: int | None = None  # set by tensor-parallel local configs
 
     @property
     def kv_heads(self) -> int:
@@ -38,7 +39,7 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
 
 
 CONFIGS = {
@@ -143,7 +144,8 @@ def forward(params, tokens, cfg: LlamaConfig):
             v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, cfg.kv_heads, n_rep, T, hd)),
                             (B, cfg.n_heads, T, hd))
         attn = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
-        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.dim))
+        # width is n_heads*hd (== dim/tp_size under tensor parallelism)
+        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
         h = ops.add(h, ops.linear(attn, layer["wo"]))
 
         # SwiGLU MLP block
@@ -162,6 +164,30 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     B, T, V = logits.shape
     logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
     return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
+
+
+def tp_config(cfg: LlamaConfig, tp_size: int) -> LlamaConfig:
+    """Local (per-shard) config for Megatron-style tensor parallelism:
+    heads and MLP width divided across the tp axis (reference
+    ``thunder/distributed/tensor_parallel/``: the consumer-rewrite visitor;
+    here the model is shape-polymorphic so a local config suffices)."""
+    import dataclasses
+
+    check_ok = (cfg.n_heads % tp_size == 0 and cfg.kv_heads % tp_size == 0
+                and cfg.intermediate_size % tp_size == 0)
+    if not check_ok:
+        raise ValueError(f"config {cfg.name} not divisible by tp={tp_size}")
+    return dataclasses.replace(
+        cfg,
+        n_heads=cfg.n_heads // tp_size,
+        n_kv_heads=cfg.kv_heads // tp_size,
+        intermediate_size=cfg.intermediate_size // tp_size,
+        head_dim_override=cfg.head_dim,
+    )
+
+
+TP_COLUMN_PATTERNS = (r"\['wq'\]", r"\['wk'\]", r"\['wv'\]", r"\['w_gate'\]", r"\['w_up'\]")
+TP_ROW_PATTERNS = (r"\['wo'\]", r"\['w_down'\]")
 
 
 def num_params(cfg: LlamaConfig, n_layers: int | None = None) -> int:

@@ -813,8 +813,27 @@ def matmul(a, b):
 
 @opsymbol(id="nn.linear")
 def linear(a, w, bias=None):
-    """y = a @ w.T (+ bias); w: (out_features, in_features) — torch layout."""
+    """y = a @ w.T (+ bias); w: (out_features, in_features) — torch layout.
+
+    Tensor-parallel aware: a COLUMN_WISE weight (out-features sharded) wraps
+    the input in synchronize_tp_input (identity fwd / all-reduce bwd), a
+    ROW_WISE weight (in-features sharded) all-reduces the partial output —
+    the reference's column/row parallel boundary comms
+    (``thunder/distributed/tensor_parallel/column_wise.py:154``,
+    ``row_wise.py:159``) realized at the op level.
+    """
+    from thunder_tpu.core.proxies import DistParallelType
+
+    dpt = getattr(w, "distparallel_type", DistParallelType.NONE)
+    if dpt is DistParallelType.COLUMN_WISE:
+        from thunder_tpu.distributed import prims as dist_prims
+
+        a = dist_prims.synchronize_tp_input(a, w.dist_axis, w.dist_size)
     out = prims.dot_general(a, w, contract_dims=((a.ndim - 1,), (1,)))
+    if dpt is DistParallelType.ROW_WISE:
+        from thunder_tpu.distributed import prims as dist_prims
+
+        out = dist_prims.synchronize_tp_output(out, w.dist_axis, w.dist_size)
     if bias is not None:
         out = add(out, bias)
     return out
