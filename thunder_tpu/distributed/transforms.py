@@ -119,14 +119,19 @@ class DistributedFunction(ThunderTPUFunction):
                 plans.append(LeafPlan("const", None))
                 continue
             shape = tuple(leaf.shape)
-            if self.mode == "tp" and in_params:
+            if self.mode == "tp":
+                # pattern-match params AND optimizer-state leaves (state pytrees
+                # mirror the param key names, so moments shard with their param)
+                mark_ok = in_params  # only real params get the TP type mark
                 if self.column_re is not None and self.column_re.search(pathstr) \
                         and len(shape) >= 1 and shape[0] % n == 0:
-                    plans.append(LeafPlan("column", _P(self.axis), DistParallelType.COLUMN_WISE, 0))
+                    plans.append(LeafPlan("column", _P(self.axis),
+                                          DistParallelType.COLUMN_WISE if mark_ok else DistParallelType.NONE, 0))
                     continue
                 if self.row_re is not None and self.row_re.search(pathstr) \
                         and len(shape) >= 2 and shape[1] % n == 0:
-                    plans.append(LeafPlan("row", _P(None, self.axis), DistParallelType.ROW_WISE, 1))
+                    plans.append(LeafPlan("row", _P(None, self.axis),
+                                          DistParallelType.ROW_WISE if mark_ok else DistParallelType.NONE, 1))
                     continue
                 plans.append(LeafPlan("replicate", _P()))
                 continue
