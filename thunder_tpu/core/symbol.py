@@ -21,6 +21,42 @@ from thunder_tpu.core.pytree import tree_flatten
 from thunder_tpu.core.trace import get_tracectx
 
 
+def _is_raw_array(x) -> bool:
+    return not isinstance(x, Proxy) and hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _lift_constant_arrays(trc, args, kwargs):
+    """Lift concrete arrays (closure-captured numpy/jax values) into named
+    constant-producing bound symbols, so traces never embed raw arrays."""
+    flat, _ = tree_flatten((args, kwargs))
+    if not any(_is_raw_array(x) for x in flat):
+        return args, kwargs
+
+    def lift(x):
+        if not _is_raw_array(x):
+            return x
+        cache = getattr(trc, "_const_cache", None)
+        if cache is None:
+            cache = trc._const_cache = {}
+        if id(x) in cache:
+            return cache[id(x)]
+        from thunder_tpu.core import dtypes as _dt
+        from thunder_tpu.core.devices import default_device
+
+        idx = getattr(trc, "_const_counter", 0)
+        trc._const_counter = idx + 1
+        out = TensorProxy(shape=x.shape, dtype=_dt.to_dtype(x.dtype), device=default_device())
+        csym = Symbol(f"const_tensor{idx}", None, id=f"const_tensor:{idx}:{id(x)}",
+                      is_prim=True, python_impl=lambda _v=x: _v)
+        trc.add_bound_symbol(csym.bind(output=out))
+        cache[id(x)] = out
+        return out
+
+    from thunder_tpu.core.pytree import tree_map
+
+    return tree_map(lift, (args, kwargs), is_leaf=lambda x: _is_raw_array(x) or isinstance(x, Proxy))
+
+
 class Symbol:
     """A traceable operation.
 
@@ -77,6 +113,7 @@ class Symbol:
             trc is not None,
             lambda: f"symbol {self.name} called outside a trace context; use thunder_tpu.jit",
         )
+        args, kwargs = _lift_constant_arrays(trc, args, kwargs)
         if self.is_prim:
             result = self.meta(*args, **kwargs)
             subsymbols: list = []

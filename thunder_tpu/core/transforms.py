@@ -75,6 +75,13 @@ def eval_trace(trc: TraceCtx, *args):
             break
         if bsym.sym.id in (PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
             continue
+        if bsym.sym.meta is None:  # impl-only symbol: re-emit verbatim
+            cur = get_tracectx()
+            if cur is not None:
+                cur.add_bound_symbol(bsym.from_bsym())
+            for o in bsym.flat_proxy_outs():
+                env.setdefault(Variable(o), o)
+            continue
         out = bsym.sym(*_env_map(env, bsym.args), **_env_map(env, bsym.kwargs))
         _bind_outputs(env, bsym.output, out)
     return result
@@ -142,11 +149,21 @@ def augmented_forward(bsyms: Sequence[BoundSymbol], env: dict) -> list[PullbackR
         sym_id = bsym.sym.id
         if sym_id in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
             continue
+        if bsym.sym.meta is None:  # impl-only symbol (const_tensor): re-emit
+            cur = get_tracectx()
+            if cur is not None:
+                cur.add_bound_symbol(bsym.from_bsym())
+            for o in bsym.flat_proxy_outs():
+                env.setdefault(Variable(o), o)
+            continue
         margs = _env_map(env, bsym.args)
         mkwargs = _env_map(env, bsym.kwargs)
         rule = _vjp_rules.get(sym_id)
-        if rule is not None:
-            out, pullback = rule(*margs, **mkwargs)
+        res = rule(*margs, **mkwargs) if rule is not None else None
+        if res is NotImplemented:  # rule declined (unsupported arg combo)
+            res = None
+        if res is not None:
+            out, pullback = res
             records.append(PullbackRecord(out, pullback))
             _bind_outputs(env, bsym.output, out)
         elif bsym.subsymbols:

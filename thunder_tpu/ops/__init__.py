@@ -18,15 +18,44 @@ from typing import Any, Sequence
 
 from thunder_tpu.core import dtypes, prims
 from thunder_tpu.core.baseutils import check, canonicalize_dim, canonicalize_dims
-from thunder_tpu.core.proxies import NumberProxy, TensorProxy, pyval
+from thunder_tpu.core.proxies import NumberProxy, Proxy, TensorProxy, pyval
 from thunder_tpu.core.symbol import Symbol
 from thunder_tpu.core.trace import get_tracectx
 
 _opsym_registry: dict[str, Symbol] = {}
 
 
+def constant_tensor(value):
+    """Lift a concrete array (e.g. a closure-captured numpy/jax array) into
+    the trace as a named constant producer (the reference bakes such values
+    through its interpreter's provenance machinery; here they become explicit
+    const bsyms that XLA embeds as literals)."""
+    from thunder_tpu.core.devices import default_device
+
+    trc = get_tracectx()
+    check(trc is not None, "constant_tensor requires a trace context")
+    idx = getattr(trc, "_const_counter", 0)
+    trc._const_counter = idx + 1
+    out = TensorProxy(shape=value.shape, dtype=dtypes.to_dtype(value.dtype),
+                      device=default_device())
+    sym = Symbol(f"const_tensor{idx}", None, id=f"const_tensor:{idx}:{id(value)}",
+                 is_prim=True, python_impl=lambda _v=value: _v)
+    trc.add_bound_symbol(sym.bind(output=out))
+    return out
+
+
+def _lift_arrays(x):
+    if isinstance(x, Proxy) or isinstance(x, Number) or x is None:
+        return x
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return constant_tensor(x)
+    return x
+
+
 def opsymbol(fn=None, *, name: str | None = None, id: str | None = None):
-    """Register fn as a traceable composite Symbol with a stable id."""
+    """Register fn as a traceable composite Symbol with a stable id.
+    (Concrete arrays in arguments are lifted to trace constants by
+    ``Symbol.__call__``.)"""
 
     def deco(fn):
         sname = name or fn.__name__

@@ -113,6 +113,46 @@ def cross_entropy(logits, target, weight=None, ignore_index: int = -100,
     return ops.true_divide(ops.sum(nll), ops.maximum(count, 1.0))
 
 
+@opsymbol(id="nn.sdpa_fwd")
+def sdpa_fwd(q, k, v, is_causal: bool = False, scale: float | None = None):
+    """Attention forward that also returns the row logsumexp — the
+    flash-attention forward contract. Claimable by the Pallas executor; the
+    decomposition below is the always-available fallback."""
+    E = q.shape[-1]
+    L, S = q.shape[-2], k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+    qf = ops.convert_element_type(q, dtypes.float32)
+    kf = ops.convert_element_type(k, dtypes.float32)
+    vf = ops.convert_element_type(v, dtypes.float32)
+    scores = ops.mul(ops.matmul(qf, kf.mT), scale)
+    if is_causal:
+        causal = ops.tril_mask(L, S, 0, device=q.device)
+        scores = ops.where(ops.expand_to(causal, scores.shape), scores,
+                           ops.full_like(scores, -float("inf")))
+    m = ops.amax(scores, -1, keepdim=True)
+    e = ops.exp(ops.sub(scores, m))
+    l = ops.sum(e, -1, keepdim=True)
+    out = ops.matmul(ops.true_divide(e, l), vf)
+    lse = ops.add(ops.squeeze(m, -1), ops.log(ops.squeeze(l, -1)))
+    return ops.convert_element_type(out, q.dtype), lse
+
+
+@opsymbol(id="nn.ce_fwd")
+def ce_fwd(logits, target, ignore_index: int = -100):
+    """Per-row negative log-likelihood + logsumexp (fused-CE forward
+    contract; Pallas-claimable). logits: (N, C); target: (N,) int."""
+    lf = ops.convert_element_type(logits, dtypes.float32)
+    m = ops.amax(lf, -1, keepdim=True)
+    lse = ops.add(ops.squeeze(m, -1), ops.log(ops.sum(ops.exp(ops.sub(lf, m)), -1)))
+    tgt = ops.convert_element_type(target, dtypes.int32)
+    safe_tgt = ops.where(ops.eq(tgt, ignore_index), ops.zeros_like(tgt), tgt)
+    picked = ops.squeeze(prims.take_along_axis(lf, ops.unsqueeze(safe_tgt, -1), 1), (1,))
+    nll = ops.sub(lse, picked)
+    valid = ops.ne(tgt, ignore_index)
+    nll = ops.where(valid, nll, ops.zeros_like(nll))
+    return nll, lse
+
+
 @opsymbol(id="nn.scaled_dot_product_attention")
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                                  is_causal: bool = False, scale: float | None = None):
@@ -141,3 +181,87 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0
         probs = dropout(probs, dropout_p)
     out = ops.matmul(probs, vf)
     return ops.convert_element_type(out, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# flash-style custom VJP rules: save (q, k, v, out, lse) and recompute the
+# attention matrix / softmax in backward instead of saving (B,H,L,S) probs.
+# This is the memory contract of the reference's fused-attention executors
+# (sdpaex/cudnnex fwd+bwd pairs, ``thunder/executors/sdpaex.py:239,312``),
+# expressed as a trace-level grad rule; the fwd symbol is Pallas-claimable.
+# ---------------------------------------------------------------------------
+
+from thunder_tpu.core.transforms import register_vjp  # noqa: E402
+from thunder_tpu.core.proxies import TensorProxy  # noqa: E402
+
+
+@register_vjp("nn.scaled_dot_product_attention")
+def _sdpa_vjp(q, k, v, attn_mask=None, dropout_p: float = 0.0, is_causal: bool = False,
+              scale: float | None = None):
+    if attn_mask is not None or dropout_p > 0.0:
+        return NotImplemented  # fall back to differentiating the decomposition
+    E = q.shape[-1]
+    L, S = q.shape[-2], k.shape[-2]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(E)
+    out, lse = sdpa_fwd(q, k, v, is_causal, scale)
+
+    def pullback(g):
+        gf = ops.convert_element_type(g, dtypes.float32)
+        qf = ops.convert_element_type(q, dtypes.float32)
+        kf = ops.convert_element_type(k, dtypes.float32)
+        vf = ops.convert_element_type(v, dtypes.float32)
+        of = ops.convert_element_type(out, dtypes.float32)
+        scores = ops.mul(ops.matmul(qf, kf.mT), scale_v)
+        if is_causal:
+            causal = ops.tril_mask(L, S, 0, device=q.device)
+            scores = ops.where(ops.expand_to(causal, scores.shape), scores,
+                               ops.full_like(scores, -float("inf")))
+        p = ops.exp(ops.sub(scores, ops.unsqueeze(lse, -1)))
+        dv = ops.matmul(p.mT, gf)
+        dp = ops.matmul(gf, vf.mT)
+        delta = ops.sum(ops.mul(gf, of), -1, keepdim=True)  # rowsum(dO * O)
+        ds = ops.mul(ops.mul(p, ops.sub(dp, delta)), scale_v)
+        dq = ops.matmul(ds, kf)
+        dk = ops.matmul(ds.mT, qf)
+        return [(q, ops.convert_element_type(dq, q.dtype)),
+                (k, ops.convert_element_type(dk, k.dtype)),
+                (v, ops.convert_element_type(dv, v.dtype))]
+
+    return out, pullback
+
+
+@register_vjp("nn.cross_entropy")
+def _cross_entropy_vjp(logits, target, weight=None, ignore_index: int = -100,
+                       reduction: str = "mean", label_smoothing: float = 0.0):
+    if weight is not None or label_smoothing > 0.0 or logits.ndim != 2:
+        return NotImplemented
+    nll, lse = ce_fwd(logits, target, ignore_index)
+    tgt = ops.convert_element_type(target, dtypes.int32)
+    valid = ops.ne(tgt, ignore_index)
+    validf = ops.convert_element_type(valid, dtypes.float32)
+    count = ops.maximum(ops.sum(validf), 1.0)
+    if reduction == "mean":
+        loss = ops.true_divide(ops.sum(nll), count)
+    elif reduction == "sum":
+        loss = ops.sum(nll)
+    elif reduction == "none":
+        loss = nll
+    else:
+        return NotImplemented
+
+    def pullback(g):
+        C = logits.shape[-1]
+        lf = ops.convert_element_type(logits, dtypes.float32)
+        p = ops.exp(ops.sub(lf, ops.unsqueeze(lse, -1)))  # softmax rows
+        safe_tgt = ops.where(ops.eq(tgt, ignore_index), ops.zeros_like(tgt), tgt)
+        onehot = ops.convert_element_type(one_hot(safe_tgt, C), dtypes.float32)
+        if reduction == "mean":
+            row_scale = ops.mul(ops.true_divide(validf, count), g)
+        elif reduction == "sum":
+            row_scale = ops.mul(validf, g)
+        else:
+            row_scale = ops.mul(validf, g)
+        dlogits = ops.mul(ops.sub(p, onehot), ops.unsqueeze(row_scale, -1))
+        return [(logits, ops.convert_element_type(dlogits, logits.dtype))]
+
+    return loss, pullback
