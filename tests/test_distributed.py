@@ -167,3 +167,43 @@ def test_collective_prims_lower_to_lax(eight_devices):
     np.testing.assert_allclose(np.asarray(g), x)  # gather reassembles
     np.testing.assert_allclose(np.asarray(s), np.broadcast_to(x.sum(0, keepdims=True), (N, 4)))
     np.testing.assert_allclose(np.asarray(r), x * N)  # reduce_scatter of gathered
+
+
+def test_context_parallel_ring_attention_matches_single(eight_devices):
+    """Ring attention over a 4-way sequence shard reproduces single-device
+    training exactly (NEW capability vs the reference)."""
+    from thunder_tpu.distributed import context_parallel
+
+    cfg = llama.CONFIGS["tiny"]
+    cp_n = 4
+    params = llama.init_params(cfg, seed=6, scale_layers=2)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 2, 32, seed=6)  # T=32 -> 8 per shard
+
+    ref_losses, ref_params = _run_steps(tt.jit(_make_step(cfg, opt)), params, opt.init(params),
+                                        tokens, targets)
+
+    jstep = context_parallel(_make_step(cfg, opt), MeshSpec.make(sp=cp_n))
+    cp_losses, cp_params = _run_steps(jstep, params, opt.init(params), tokens, targets)
+
+    np.testing.assert_allclose(ref_losses, cp_losses, atol=1e-5, rtol=1e-5)
+    flat_ref, _ = jax.tree_util.tree_flatten(ref_params)
+    flat_cp, _ = jax.tree_util.tree_flatten(cp_params)
+    for r, d in zip(flat_ref, flat_cp):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=1e-5, rtol=1e-4)
+
+
+def test_context_parallel_trace_has_ring(eight_devices):
+    from thunder_tpu.distributed import context_parallel
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=7, scale_layers=1)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 2, 32, seed=7)
+    jstep = context_parallel(_make_step(cfg, opt), MeshSpec.make(sp=4))
+    jstep(params, opt.init(params), tokens, targets)
+    src = tt.last_traces(jstep)[0].python()
+    # the ring decomposes through autograd replay: K/V rotation collectives
+    # and rank-dependent masking must be present
+    assert "ppermute" in src
+    assert "axis_index" in src

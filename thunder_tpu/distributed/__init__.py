@@ -43,11 +43,31 @@ def use_mesh(mesh):
         _mesh_stack.pop()
 
 
+_cp_stack: list = []
+
+
+def current_cp():
+    """Active context-parallel (sequence-sharding) config: (axis, size) or
+    None. When set, ``ops.scaled_dot_product_attention`` lowers to ring
+    attention over the axis."""
+    return _cp_stack[-1] if _cp_stack else None
+
+
+@contextmanager
+def context_parallel_ctx(axis: str, size: int):
+    _cp_stack.append((axis, size))
+    try:
+        yield
+    finally:
+        _cp_stack.pop()
+
+
 # collective prims (registers eager impls + VJP rules) and the parallelism
 # transforms; imported last to keep the dependency order acyclic
 from thunder_tpu.distributed import prims  # noqa: E402,F401
 from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
     DistributedFunction,
+    context_parallel,
     ddp,
     fsdp,
     tensor_parallel,

@@ -1,0 +1,89 @@
+"""Ring attention: context/sequence parallelism for long sequences.
+
+NEW capability beyond the reference (SURVEY §5 "Long-context / sequence
+parallelism: ABSENT" — no ring/Ulysses/context-parallel anywhere in the
+reference tree). Design: sequence sharded across a mesh axis; K/V blocks
+rotate around the ring via ``ppermute`` while each device accumulates its
+local queries' attention with flash-style (m, l, acc) online-softmax merges.
+All of it is ordinary trace ops (dist prims + matmuls), so autograd
+differentiates through the ring (ppermute VJP = inverse permutation) and XLA
+overlaps the ppermute DMAs with the block matmuls over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+from thunder_tpu.distributed import prims as dist_prims
+from thunder_tpu.ops import opsymbol
+
+
+@opsymbol(id="nn.ring_attention")
+def ring_attention(q, k, v, axis: str, size: int, is_causal: bool = False,
+                   scale: float | None = None):
+    """q,k,v: (..., T_local, hd) — the local sequence shard on mesh axis
+    ``axis`` (world size ``size``). Returns local attention output over the
+    GLOBAL sequence."""
+    E = q.shape[-1]
+    L = q.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+
+    qf = ops.convert_element_type(q, dtypes.float32)
+    my_idx = dist_prims.axis_index(axis)
+
+    # running accumulators: unnormalized acc, row max m, row sum l
+    acc = ops.zeros(q.shape[:-1] + (E,), dtype=dtypes.float32)
+    m = ops.full(q.shape[:-1], -float("inf"), dtype=dtypes.float32)
+    l = ops.zeros(q.shape[:-1], dtype=dtypes.float32)
+
+    k_cur, v_cur = k, v
+    ring_perm = tuple((i, (i + 1) % size) for i in range(size))  # send to next rank
+
+    for step in range(size):
+        kf = ops.convert_element_type(k_cur, dtypes.float32)
+        vf = ops.convert_element_type(v_cur, dtypes.float32)
+        scores = ops.mul(ops.matmul(qf, kf.mT), scale)  # (..., L, S)
+
+        # after `step` rotations this device holds the K/V block of rank
+        # (my_idx - step) mod size
+        kv_idx = ops.remainder(ops.add(ops.sub(my_idx, step), size * 2), size)
+        if is_causal:
+            within = ops.tril_mask(L, L, 0, device=q.device)  # local causal
+            before = ops.lt(kv_idx, my_idx)  # whole block visible
+            same = ops.eq(kv_idx, my_idx)  # local causal applies
+            block_mask = ops.bitwise_or(
+                ops.expand_to(before, within.shape),
+                ops.bitwise_and(ops.expand_to(same, within.shape), within),
+            )
+            scores = ops.where(ops.expand_to(block_mask, scores.shape), scores,
+                               ops.full_like(scores, -float("inf")))
+
+        m_i = ops.amax(scores, -1)  # (..., L); -inf for fully-masked rows
+        m_i_safe = ops.where(ops.isfinite(m_i), m_i, ops.zeros_like(m_i))
+        e = ops.exp(ops.sub(scores, ops.unsqueeze(m_i_safe, -1)))  # exp(-inf)=0
+        e = ops.where(ops.expand_to(ops.unsqueeze(ops.isfinite(m_i), -1), e.shape),
+                      e, ops.zeros_like(e))
+        l_i = ops.sum(e, -1)
+        acc_i = ops.matmul(e, vf)
+
+        new_m = ops.maximum(m, m_i)
+        new_m_safe = ops.where(ops.isfinite(new_m), new_m, ops.zeros_like(new_m))
+        alpha = ops.exp(ops.sub(ops.where(ops.isfinite(m), m, ops.full_like(m, -float("inf"))),
+                                new_m_safe))
+        alpha = ops.where(ops.isfinite(m), alpha, ops.zeros_like(alpha))
+        beta = ops.exp(ops.sub(m_i_safe, new_m_safe))
+        beta = ops.where(ops.isfinite(m_i), beta, ops.zeros_like(beta))
+
+        acc = ops.add(ops.mul(acc, ops.unsqueeze(alpha, -1)),
+                      ops.mul(acc_i, ops.unsqueeze(beta, -1)))
+        l = ops.add(ops.mul(l, alpha), ops.mul(l_i, beta))
+        m = new_m
+
+        if step < size - 1:  # rotate K/V around the ring
+            k_cur = dist_prims.wait(dist_prims.ppermute(k_cur, axis, ring_perm))
+            v_cur = dist_prims.wait(dist_prims.ppermute(v_cur, axis, ring_perm))
+
+    out = ops.true_divide(acc, ops.unsqueeze(ops.maximum(l, 1e-30), -1))
+    return ops.convert_element_type(out, q.dtype)

@@ -85,7 +85,7 @@ class DistributedFunction(ThunderTPUFunction):
 
         def wrapped(*args, **kwargs):
             out = orig_fn(*args, **kwargs)
-            if self.size > 1 and mode in ("fsdp", "ddp"):
+            if self.size > 1 and mode in ("fsdp", "ddp", "cp"):
                 out = tree_map(self._mean_scalar_across_replicas, out)
             return out
 
@@ -142,8 +142,18 @@ class DistributedFunction(ThunderTPUFunction):
                 else:
                     plans.append(LeafPlan("replicate", _P()))
                 continue
-            if self.mode == "ddp" and in_params:
+            if self.mode in ("ddp", "cp") and in_params:
                 plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
+                continue
+            if self.mode == "cp":
+                # context parallel: shard the sequence dim of batch arrays
+                import numpy as _np
+
+                if (len(shape) >= 2 and shape[1] % n == 0 and shape[1] >= n
+                        and _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)):
+                    plans.append(LeafPlan("data_shard", _P(None, self.axis), shard_dim=1))
+                else:
+                    plans.append(LeafPlan("replicate", _P()))
                 continue
             # non-param arrays: shard dim 0 (batch; plus optimizer state under
             # FSDP — ZeRO state sharding) when divisible
@@ -171,6 +181,11 @@ class DistributedFunction(ThunderTPUFunction):
     def _compile(self, flat, treedef, args, kwargs) -> CacheEntry:
         self._plan = self._build_plan(args, kwargs)
         check(len(self._plan) == len(flat), "leaf plan misaligned with flattened inputs")
+        if self.mode == "cp":
+            from thunder_tpu.distributed import context_parallel_ctx
+
+            with context_parallel_ctx(self.axis, self.size):
+                return super()._compile(flat, treedef, args, kwargs)
         return super()._compile(flat, treedef, args, kwargs)
 
     def _make_input_proxy(self, i: int, leaf) -> TensorProxy:
@@ -262,6 +277,17 @@ def ddp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "dp",
     the REPLICATED synchronize VJP."""
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="ddp", axis=axis,
+                               params_argnums=params_argnums, **jit_kwargs)
+
+
+def context_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "sp",
+                     params_argnums: Sequence[int] = (0,), **jit_kwargs) -> DistributedFunction:
+    """Context/sequence parallelism via ring attention (NEW capability — the
+    reference has none, SURVEY §5): the sequence dim of batch arrays shards
+    across ``axis``; attention lowers to the ring (K/V ppermute rotation with
+    online-softmax merges); params replicate with all-reduced grads."""
+    mesh_spec = mesh_spec or _default_mesh_spec(axis)
+    return DistributedFunction(fn, mesh_spec, mode="cp", axis=axis,
                                params_argnums=params_argnums, **jit_kwargs)
 
 

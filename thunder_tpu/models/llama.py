@@ -96,10 +96,13 @@ def init_params(cfg: LlamaConfig, seed: int = 0, scale_layers: int | None = None
     return params
 
 
-def _rope_cos_sin(cfg: LlamaConfig, T: int, dtype):
-    """cos/sin tables built from iota (fully fusible, no host constants)."""
+def _rope_cos_sin(cfg: LlamaConfig, T: int, dtype, pos_offset=None):
+    """cos/sin tables built from iota (fully fusible, no host constants).
+    ``pos_offset`` shifts positions (context parallelism: local chunk start)."""
     hd = cfg.head_dim
     pos = ops.convert_element_type(ops.arange(T), dtypes.float32)  # (T,)
+    if pos_offset is not None:
+        pos = ops.add(pos, ops.convert_element_type(pos_offset, dtypes.float32))
     idx = ops.convert_element_type(ops.arange(hd // 2), dtypes.float32)  # (hd/2,)
     inv_freq = ops.pow(cfg.rope_theta, ops.true_divide(ops.mul(idx, -2.0), float(hd)))
     angles = ops.mul(ops.unsqueeze(pos, 1), ops.unsqueeze(inv_freq, 0))  # (T, hd/2)
@@ -123,7 +126,15 @@ def forward(params, tokens, cfg: LlamaConfig):
     """tokens: (B, T) int32 -> logits (B, T, vocab)."""
     B, T = tokens.shape
     h = ops.embedding(tokens, params["tok_embedding"])  # (B, T, D)
-    cos, sin = _rope_cos_sin(cfg, T, h.dtype)
+    from thunder_tpu.distributed import current_cp
+
+    cp = current_cp()
+    pos_offset = None
+    if cp is not None:  # sequence sharded: positions start at my_chunk * T_local
+        from thunder_tpu.distributed import prims as dist_prims
+
+        pos_offset = ops.mul(dist_prims.axis_index(cp[0]), T)
+    cos, sin = _rope_cos_sin(cfg, T, h.dtype, pos_offset)
     n_rep = cfg.n_heads // cfg.kv_heads
     hd = cfg.head_dim
 

@@ -157,7 +157,17 @@ def ce_fwd(logits, target, ignore_index: int = -100):
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                                  is_causal: bool = False, scale: float | None = None):
     """q,k,v: (..., L, E) / (..., S, E). Decomposes to softmax(q k^T / sqrt(E)) v;
-    the Pallas flash-attention executor claims this symbol on TPU."""
+    the Pallas flash-attention executor claims this symbol on TPU. Under an
+    active context-parallel scope, lowers to ring attention over the mesh
+    axis (sequence sharded; K/V rotate via ppermute)."""
+    from thunder_tpu.distributed import current_cp
+
+    cp = current_cp()
+    if cp is not None and attn_mask is None and dropout_p == 0.0:
+        from thunder_tpu.distributed.ring import ring_attention
+
+        axis, size = cp
+        return ring_attention(q, k, v, axis, size, is_causal, scale)
     E = q.shape[-1]
     L, S = q.shape[-2], k.shape[-2]
     scale = scale if scale is not None else 1.0 / math.sqrt(E)
@@ -198,7 +208,9 @@ from thunder_tpu.core.proxies import TensorProxy  # noqa: E402
 @register_vjp("nn.scaled_dot_product_attention")
 def _sdpa_vjp(q, k, v, attn_mask=None, dropout_p: float = 0.0, is_causal: bool = False,
               scale: float | None = None):
-    if attn_mask is not None or dropout_p > 0.0:
+    from thunder_tpu.distributed import current_cp
+
+    if attn_mask is not None or dropout_p > 0.0 or current_cp() is not None:
         return NotImplemented  # fall back to differentiating the decomposition
     E = q.shape[-1]
     L, S = q.shape[-2], k.shape[-2]
