@@ -1,0 +1,101 @@
+"""Mixtral MoE model + expert parallelism tests (new capability vs the
+reference — BASELINE config 5)."""
+
+import jax
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.core.devices import MeshSpec
+from thunder_tpu.distributed import expert_parallel
+from thunder_tpu.models import mixtral
+from thunder_tpu.optim import SGD
+
+import dataclasses
+
+
+def _cfg(capacity_factor=8.0, n_layers=2, aux=0.01):
+    return dataclasses.replace(mixtral.CONFIGS["tiny-moe"],
+                               capacity_factor=capacity_factor, n_layers=n_layers,
+                               router_aux_coef=aux)
+
+
+def _data(cfg, batch, seq, seed):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    return tokens, targets
+
+
+def _make_step(cfg, opt):
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: mixtral.loss_fn(p, tokens, targets, cfg))(params)
+        new_params, new_state = opt.update(params, grads, opt_state)
+        return loss, new_params, new_state
+
+    return train_step
+
+
+def test_mixtral_forward_finite_and_routed():
+    cfg = _cfg()
+    params = mixtral.init_params(cfg, seed=0)
+    tokens, _ = _data(cfg, 2, 16, seed=0)
+    logits = np.asarray(tt.jit(lambda p, t: mixtral.forward(p, t, cfg))(params, tokens))
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(logits).all()
+
+
+def test_mixtral_train_step_learns():
+    cfg = _cfg()
+    params = mixtral.init_params(cfg, seed=1)
+    opt = SGD(lr=5e-2)
+    jstep = tt.jit(_make_step(cfg, opt))
+    tokens, targets = _data(cfg, 4, 16, seed=1)
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(10):
+        loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_expert_parallel_matches_single_device(eight_devices):
+    """EP over 8 ranks (capacity high enough that nothing drops) reproduces
+    the single-device run. Aux loss off: its per-device-stats objective
+    legitimately differs from the global-stats one (standard MoE practice)."""
+    cfg = _cfg(capacity_factor=16.0, n_layers=2, aux=0.0)
+    params = mixtral.init_params(cfg, seed=2)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 8, 8, seed=2)
+
+    def run(jstep, params, opt_state, n=3):
+        losses = []
+        for _ in range(n):
+            loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+            losses.append(float(np.asarray(loss)))
+        return losses, params
+
+    ref_losses, ref_params = run(tt.jit(_make_step(cfg, opt)), params, opt.init(params))
+
+    jstep = expert_parallel(_make_step(cfg, opt), MeshSpec.make(ep=8),
+                            expert_patterns=mixtral.EP_PATTERNS)
+    ep_losses, ep_params = run(jstep, params, opt.init(params))
+
+    np.testing.assert_allclose(ref_losses, ep_losses, atol=1e-5, rtol=1e-5)
+    flat_ref, _ = jax.tree_util.tree_flatten(ref_params)
+    flat_ep, _ = jax.tree_util.tree_flatten(ep_params)
+    for r, d in zip(flat_ref, flat_ep):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=2e-5, rtol=1e-3)
+
+
+def test_expert_parallel_trace_has_all_to_all(eight_devices):
+    cfg = _cfg(capacity_factor=4.0, n_layers=1)
+    params = mixtral.init_params(cfg, seed=3)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 8, 8, seed=3)
+    jstep = expert_parallel(_make_step(cfg, opt), MeshSpec.make(ep=8),
+                            expert_patterns=mixtral.EP_PATTERNS)
+    jstep(params, opt.init(params), tokens, targets)
+    src = tt.last_traces(jstep)[0].python()
+    assert "all_to_all" in src

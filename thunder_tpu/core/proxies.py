@@ -30,6 +30,7 @@ class DistParallelType(Enum):
     FULLY_SHARDED = "fully_sharded"  # FSDP: dim-0 sharded
     COLUMN_WISE = "column_wise"  # TP: output-feature sharded
     ROW_WISE = "row_wise"  # TP: input-feature sharded
+    EXPERT_SHARDED = "expert_sharded"  # EP: expert dim sharded, grads local
 
 
 class Variable:

@@ -106,7 +106,7 @@ _NONDIFF = {
     PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
     PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE, PrimIDs.UNPACK_TRIVIAL,
     PrimIDs.PYTHON_PRINT, PrimIDs.COMMENT, PrimIDs.SINK, PrimIDs.DEVICE_PUT,
-    PrimIDs.SHARDING_CONSTRAINT, PrimIDs.SORT, PrimIDs.TOPK, PrimIDs.CUMSUM,
+    PrimIDs.SHARDING_CONSTRAINT, PrimIDs.SORT, PrimIDs.CUMSUM,
 }
 
 
@@ -245,7 +245,9 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
                 # distributed param sync INSIDE the grad scope: FSDP params are
                 # all-gathered here and their VJP reduce-scatters the grads
                 # (reference: synchronize in fwd, prims.py:376-419)
-                if (p.distparallel_type in (DistParallelType.FULLY_SHARDED, DistParallelType.REPLICATED)
+                if (p.distparallel_type in (DistParallelType.FULLY_SHARDED,
+                                            DistParallelType.REPLICATED,
+                                            DistParallelType.EXPERT_SHARDED)
                         and getattr(p, "dist_axis", None) is not None):
                     from thunder_tpu.distributed import prims as dist_prims
 
@@ -786,6 +788,22 @@ def _scatter_add_vjp(a, indices, value, dim):
         return _pairs((a, g), (value, prims.take_along_axis(g, indices, dim)))
 
     return out, pullback
+
+
+@register_vjp(PrimIDs.TOPK)
+def _topk_vjp(a, k, dim):
+    values, indices = prims.topk(a, k, dim)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        g_vals = g[0] if isinstance(g, tuple) else g
+        if g_vals is None:
+            return None
+        zeros = ops.zeros_like(a)
+        return _pairs((a, prims.scatter_add(zeros, indices, g_vals, dim)))
+
+    return (values, indices), pullback
 
 
 @register_vjp(PrimIDs.DOT_GENERAL)

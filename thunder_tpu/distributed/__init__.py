@@ -62,6 +62,24 @@ def context_parallel_ctx(axis: str, size: int):
         _cp_stack.pop()
 
 
+_ep_stack: list = []
+
+
+def current_ep():
+    """Active expert-parallel config: (axis, size) or None. When set, MoE
+    layers route tokens to expert shards via all_to_all."""
+    return _ep_stack[-1] if _ep_stack else None
+
+
+@contextmanager
+def expert_parallel_ctx(axis: str, size: int):
+    _ep_stack.append((axis, size))
+    try:
+        yield
+    finally:
+        _ep_stack.pop()
+
+
 # collective prims (registers eager impls + VJP rules) and the parallelism
 # transforms; imported last to keep the dependency order acyclic
 from thunder_tpu.distributed import prims  # noqa: E402,F401
@@ -69,6 +87,7 @@ from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
     DistributedFunction,
     context_parallel,
     ddp,
+    expert_parallel,
     fsdp,
     tensor_parallel,
 )

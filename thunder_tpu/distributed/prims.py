@@ -123,7 +123,7 @@ def _synchronize_meta(a: TensorProxy, axis: str, parallel_type: DistParallelType
     if parallel_type is DistParallelType.FULLY_SHARDED:
         shape = (a.shape[0] * size,) + a.shape[1:]
         return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
-    if parallel_type is DistParallelType.REPLICATED:
+    if parallel_type in (DistParallelType.REPLICATED, DistParallelType.EXPERT_SHARDED):
         return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
     raise NotImplementedError(f"synchronize for {parallel_type}")
 
@@ -240,6 +240,11 @@ def _synchronize_vjp(a, axis, parallel_type, size):
             # averaged across the data-parallel axis
             gs = wait(reduce_scatter(g, axis, 0, size))
             return [(a, ops.true_divide(gs, float(size)))]
+        if parallel_type is DistParallelType.EXPERT_SHARDED:
+            # expert grads are already complete on the owning rank (cotangents
+            # arrive via the backward all_to_all); only the data-parallel
+            # mean scaling is needed — no collective
+            return [(a, ops.true_divide(g, float(size)))]
         # DDP: grads averaged across replicas
         gr = wait(all_reduce(g, axis, "sum"))
         return [(a, ops.true_divide(gr, float(size)))]

@@ -66,9 +66,11 @@ class LeafPlan:
 class DistributedFunction(ThunderTPUFunction):
     def __init__(self, fn, mesh_spec: MeshSpec, *, mode: str, axis: str,
                  params_argnums: Sequence[int] = (0,), column_patterns=(), row_patterns=(),
-                 shard_data: bool = True, data_argnums: Sequence[int] | None = None,
+                 expert_patterns=(), shard_data: bool = True,
+                 data_argnums: Sequence[int] | None = None,
                  zero: int = 3, **jit_kwargs):
         self.data_argnums = tuple(data_argnums) if data_argnums is not None else None
+        self.expert_re = re.compile("|".join(expert_patterns)) if expert_patterns else None
         self.mesh_spec = mesh_spec
         self.axis = axis
         self.size = dict(zip(mesh_spec.axis_names, mesh_spec.axis_sizes))[axis]
@@ -85,7 +87,7 @@ class DistributedFunction(ThunderTPUFunction):
 
         def wrapped(*args, **kwargs):
             out = orig_fn(*args, **kwargs)
-            if self.size > 1 and mode in ("fsdp", "ddp", "cp"):
+            if self.size > 1 and mode in ("fsdp", "ddp", "cp", "ep"):
                 out = tree_map(self._mean_scalar_across_replicas, out)
             return out
 
@@ -142,6 +144,25 @@ class DistributedFunction(ThunderTPUFunction):
                 else:
                     plans.append(LeafPlan("replicate", _P()))
                 continue
+            if self.mode == "ep":
+                # expert-dim-sharded leaves (params AND their optimizer state)
+                if self.expert_re is not None and self.expert_re.search(pathstr) \
+                        and len(shape) >= 1 and shape[0] % n == 0:
+                    plans.append(LeafPlan(
+                        "expert_shard", _P(self.axis),
+                        DistParallelType.EXPERT_SHARDED if in_params else DistParallelType.NONE, 0))
+                    continue
+                if in_params:
+                    plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
+                    continue
+                import numpy as _np
+
+                if (len(shape) >= 1 and shape[0] % n == 0 and shape[0] >= n
+                        and _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)):
+                    plans.append(LeafPlan("data_shard", _P(self.axis), shard_dim=0))
+                else:
+                    plans.append(LeafPlan("replicate", _P()))
+                continue
             if self.mode in ("ddp", "cp") and in_params:
                 plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
                 continue
@@ -185,6 +206,11 @@ class DistributedFunction(ThunderTPUFunction):
             from thunder_tpu.distributed import context_parallel_ctx
 
             with context_parallel_ctx(self.axis, self.size):
+                return super()._compile(flat, treedef, args, kwargs)
+        if self.mode == "ep":
+            from thunder_tpu.distributed import expert_parallel_ctx
+
+            with expert_parallel_ctx(self.axis, self.size):
                 return super()._compile(flat, treedef, args, kwargs)
         return super()._compile(flat, treedef, args, kwargs)
 
@@ -277,6 +303,20 @@ def ddp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "dp",
     the REPLICATED synchronize VJP."""
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="ddp", axis=axis,
+                               params_argnums=params_argnums, **jit_kwargs)
+
+
+def expert_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "ep",
+                    expert_patterns: Sequence[str] = (), params_argnums: Sequence[int] = (0,),
+                    **jit_kwargs) -> DistributedFunction:
+    """Expert parallelism for MoE models (NEW capability — absent from the
+    reference, SURVEY §2.6): expert-stacked weights (``expert_patterns``)
+    shard their leading expert dim across ``axis``; MoE layers route token
+    slots to expert shards via all_to_all; non-expert params replicate with
+    all-reduced grads; the batch shards on the same axis (dp=ep)."""
+    mesh_spec = mesh_spec or _default_mesh_spec(axis)
+    return DistributedFunction(fn, mesh_spec, mode="ep", axis=axis,
+                               expert_patterns=expert_patterns,
                                params_argnums=params_argnums, **jit_kwargs)
 
 

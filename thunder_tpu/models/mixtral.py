@@ -1,0 +1,206 @@
+"""Mixtral-style mixture-of-experts transformer.
+
+BASELINE config 5 ("Mixtral-8x7B expert-parallel — new capability, absent
+from reference"). Architecture: Llama attention blocks + top-k routed SwiGLU
+experts with GShard-style capacity-based dense dispatch (static shapes for
+XLA): tokens → one-hot dispatch (S, E, C) via cumsum positions → batched
+per-expert matmuls on the MXU → weighted combine. Under an active
+expert-parallel scope the (E, C, d) slot tensor is exchanged with
+``all_to_all`` so each rank runs only its local experts.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.models import llama as _llama
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    name: str = "tiny-moe"
+    vocab_size: int = 512
+    dim: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int | None = None
+    intermediate_size: int = 128
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    max_seq_len: int = 256
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    router_aux_coef: float = 0.01
+    dtype: dtypes.dtype = dtypes.float32
+
+    @property
+    def kv_heads(self):
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def head_dim(self):
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "tiny-moe": MixtralConfig(),
+    "mixtral-8x7b": MixtralConfig(
+        name="mixtral-8x7b", vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, intermediate_size=14336, n_experts=8, top_k=2,
+        max_seq_len=4096, rope_theta=1e6, dtype=dtypes.bfloat16),
+}
+
+EP_PATTERNS = (r"\['we_gate'\]", r"\['we_up'\]", r"\['we_down'\]")
+
+
+def init_params(cfg: MixtralConfig, seed: int = 0, scale_layers: int | None = None):
+    import jax
+    import jax.numpy as jnp
+
+    n_layers = scale_layers if scale_layers is not None else cfg.n_layers
+    jd = cfg.dtype.jax
+    key = jax.random.PRNGKey(seed)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(jd)
+
+    keys = iter(jax.random.split(key, 4 + n_layers * 16))
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    params = {
+        "tok_embedding": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "norm_f": jnp.ones((cfg.dim,), jd),
+        "lm_head": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": [],
+    }
+    E, I, D = cfg.n_experts, cfg.intermediate_size, cfg.dim
+    for _ in range(n_layers):
+        params["layers"].append({
+            "attn_norm": jnp.ones((D,), jd),
+            "wq": dense(next(keys), (D, D), D),
+            "wk": dense(next(keys), (kv_dim, D), D),
+            "wv": dense(next(keys), (kv_dim, D), D),
+            "wo": dense(next(keys), (D, D), D),
+            "mlp_norm": jnp.ones((D,), jd),
+            "router": dense(next(keys), (E, D), D),
+            "we_gate": dense(next(keys), (E, I, D), D),
+            "we_up": dense(next(keys), (E, I, D), D),
+            "we_down": dense(next(keys), (E, D, I), I),
+        })
+    return params
+
+
+def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig):
+    """x: (S, D) flattened tokens. Returns (out (S, D), aux_loss scalar)."""
+    from thunder_tpu.distributed import current_ep
+    from thunder_tpu.distributed import prims as dist_prims
+
+    S, D = x.shape
+    E = router_w.shape[0]
+    k = cfg.top_k
+    C = max(1, int(math.ceil(S * cfg.capacity_factor * k / E)))
+
+    logits = ops.linear(ops.convert_element_type(x, dtypes.float32),
+                        ops.convert_element_type(router_w, dtypes.float32))  # (S, E)
+    probs = ops.softmax(logits, -1)
+    topv, topi = ops.topk(probs, k, -1)  # (S, k)
+    topv = ops.true_divide(topv, ops.sum(topv, -1, keepdim=True))
+
+    # GShard capacity-based dispatch: position of each token in its expert's
+    # slot queue via cumsum; tokens beyond capacity C are dropped
+    counts = ops.zeros((E,), dtype=dtypes.float32)
+    dispatch = None  # (S, E, C)
+    combine = None
+    for j in range(k):
+        m = ops.convert_element_type(ops.one_hot(topi[:, j], E), dtypes.float32)  # (S, E)
+        pos = ops.add(ops.sub(ops.cumsum(m, 0), m), ops.expand_to(counts, m.shape))
+        keep = ops.mul(m, ops.convert_element_type(ops.lt(pos, float(C)), dtypes.float32))
+        counts = ops.add(counts, ops.sum(keep, 0))
+        pos_oh = ops.convert_element_type(
+            ops.one_hot(ops.convert_element_type(pos, dtypes.int32), C), dtypes.float32)  # (S, E, C)
+        disp_j = ops.mul(ops.unsqueeze(keep, -1), pos_oh)
+        comb_j = ops.mul(disp_j, ops.expand_to(ops.reshape(topv[:, j], (S, 1, 1)), disp_j.shape))
+        dispatch = disp_j if dispatch is None else ops.add(dispatch, disp_j)
+        combine = comb_j if combine is None else ops.add(combine, comb_j)
+
+    # load-balancing auxiliary loss (Switch/Mixtral style)
+    frac_tokens = ops.mean(ops.convert_element_type(
+        ops.one_hot(topi[:, 0], E), dtypes.float32), 0)
+    frac_probs = ops.mean(probs, 0)
+    aux = ops.mul(ops.sum(ops.mul(frac_tokens, frac_probs)), float(E) * cfg.router_aux_coef)
+
+    xf = ops.convert_element_type(x, dtypes.float32)
+    expert_in = prims.dot_general(dispatch, xf, contract_dims=((0,), (0,)))  # (E, C, D)
+
+    ep = current_ep()
+    if ep is not None:
+        axis, n = ep
+        # rank-local slots for all experts -> all slots for local experts
+        expert_in = dist_prims.wait(dist_prims.all_to_all(expert_in, axis, 0, 1, n))  # (E/n, C*n, D)
+
+    weg = ops.convert_element_type(we_gate, dtypes.float32)
+    weu = ops.convert_element_type(we_up, dtypes.float32)
+    wed = ops.convert_element_type(we_down, dtypes.float32)
+    gate = ops.silu(prims.dot_general(expert_in, weg, contract_dims=((2,), (2,)),
+                                      batch_dims=((0,), (0,))))  # (E?, C?, I)
+    up = prims.dot_general(expert_in, weu, contract_dims=((2,), (2,)), batch_dims=((0,), (0,)))
+    expert_out = prims.dot_general(ops.mul(gate, up), wed, contract_dims=((2,), (2,)),
+                                   batch_dims=((0,), (0,)))  # (E?, C?, D)
+
+    if ep is not None:
+        axis, n = ep
+        expert_out = dist_prims.wait(dist_prims.all_to_all(expert_out, axis, 1, 0, n))  # (E, C, D)
+
+    out = prims.dot_general(combine, expert_out, contract_dims=(((1, 2)), ((0, 1))))  # (S, D)
+    return ops.convert_element_type(out, x.dtype), aux
+
+
+def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False):
+    B, T = tokens.shape
+    h = ops.embedding(tokens, params["tok_embedding"])
+    cos, sin = _llama._rope_cos_sin(cfg, T, h.dtype)
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.kv_heads
+    aux_total = None
+
+    for layer in params["layers"]:
+        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+        q = ops.linear(x, layer["wq"])
+        kk = ops.linear(x, layer["wk"])
+        v = ops.linear(x, layer["wv"])
+        q = ops.transpose(ops.reshape(q, (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
+        kk = ops.transpose(ops.reshape(kk, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        v = ops.transpose(ops.reshape(v, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        q = _llama._apply_rope(q, cos, sin)
+        kk = _llama._apply_rope(kk, cos, sin)
+        if n_rep > 1:
+            kk = ops.reshape(ops.expand(ops.unsqueeze(kk, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                             (B, cfg.n_heads, T, hd))
+            v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                            (B, cfg.n_heads, T, hd))
+        attn = ops.scaled_dot_product_attention(q, kk, v, is_causal=True)
+        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
+        h = ops.add(h, ops.linear(attn, layer["wo"]))
+
+        x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
+        moe_out, aux = moe_ffn(ops.reshape(x, (B * T, cfg.dim)), layer["router"],
+                               layer["we_gate"], layer["we_up"], layer["we_down"], cfg)
+        h = ops.add(h, ops.reshape(moe_out, (B, T, cfg.dim)))
+        aux_total = aux if aux_total is None else ops.add(aux_total, aux)
+
+    h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+    logits = ops.linear(h, params["lm_head"])
+    if return_aux:
+        return logits, aux_total
+    return logits
+
+
+def loss_fn(params, tokens, targets, cfg: MixtralConfig):
+    logits, aux = forward(params, tokens, cfg, return_aux=True)
+    B, T, V = logits.shape
+    ce = ops.cross_entropy(ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32),
+                           ops.reshape(targets, (B * T,)))
+    return ops.add(ce, aux)
