@@ -330,6 +330,7 @@ def compile_stats(jfn) -> CompileStats:
 
 # re-exports
 from thunder_tpu import ops  # noqa: E402,F401
+from thunder_tpu.ops import autocast  # noqa: E402,F401
 from thunder_tpu.executors import (  # noqa: E402,F401
     get_all_executors,
     get_default_executors,

@@ -168,10 +168,16 @@ class BoundSymbol:
 
     @property
     def rhs(self):
-        """Hashable right-hand-side key for CSE."""
+        """Hashable right-hand-side key for CSE. Output metadata is part of
+        the key: composite symbols can produce different decompositions for
+        identical inputs under trace-affecting contexts (e.g. autocast)."""
+        out_meta = tuple(
+            (p.name, tuple(getattr(p, "shape", ())), getattr(getattr(p, "dtype", None), "name", None))
+            for p in self.flat_proxy_outs())
         return (
             self.sym.id if self.sym.id is not None else self.sym.name,
             tuple(variableify(a) for a in self.flat_args()),
+            tuple(m[1:] for m in out_meta),
         )
 
     # -- rewriting ---------------------------------------------------------

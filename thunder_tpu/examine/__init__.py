@@ -1,0 +1,106 @@
+"""examine: coverage and trace-inspection tooling.
+
+Reference parity: ``thunder/examine/__init__.py`` (``examine()`` coverage
+reporter :49, ``get_fusions`` :190) and ``thunder/examine/memory_caculation.py``
+(``get_alloc_memory`` static peak-memory estimate :121).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any
+
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable
+from thunder_tpu.core.pytree import tree_flatten
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx
+from thunder_tpu.core.utils import consumed_vars
+
+
+def examine(fn, *args, executors=None, **kwargs) -> dict:
+    """Trace ``fn`` and report op usage + executor claims: which symbols were
+    used, which executor claimed each, and which fell back to eager."""
+    import thunder_tpu as tt
+
+    jfn = tt.jit(fn, executors=executors)
+    jfn(*args, **kwargs)
+    interpreted = tt.last_traces(jfn)[0]
+    exec_trc = tt.last_execution_trace(jfn)
+
+    used_ops = Counter()
+
+    def walk(bsyms):
+        for b in bsyms:
+            used_ops[b.sym.name] += 1
+            walk(b.subsymbols)
+
+    walk(interpreted.bound_symbols)
+
+    claims: dict[str, str] = {}
+
+    def walk_exec(bsyms):
+        for b in bsyms:
+            ex = b.sym.executor.name if b.sym.executor is not None else "eagerjax"
+            claims.setdefault(b.sym.name, ex)
+            walk_exec(b.subsymbols)
+
+    walk_exec(exec_trc.bound_symbols)
+
+    report = {
+        "ops_used": dict(used_ops),
+        "executor_claims": claims,
+        "num_fusions": len(get_fusions(exec_trc)),
+        "traces": tt.last_traces(jfn),
+    }
+    return report
+
+
+def get_fusions(trc: TraceCtx) -> list[BoundSymbol]:
+    """Fusion regions of an execution trace (reference ``examine:190``)."""
+    return [b for b in trc.bound_symbols
+            if b.sym.executor is not None and b.sym.name.startswith("fusion")]
+
+
+def get_fusion_symbols(trc: TraceCtx) -> list[str]:
+    out = []
+    for f in get_fusions(trc):
+        out.extend(s.sym.name for s in f.subsymbols)
+    return out
+
+
+def estimate_memory(trc: TraceCtx) -> dict:
+    """Static peak-memory estimate from trace liveness (reference
+    ``memory_caculation.py:121``): tensors become live at their producer and
+    die after their last consumer (or at their ``del``)."""
+    def nbytes(p: TensorProxy) -> int:
+        return p.numel * p.dtype.bytes
+
+    live: dict[Variable, int] = {}
+    for a in trc.args:
+        if isinstance(a, TensorProxy):
+            live[Variable(a)] = nbytes(a)
+    out_flat = [o for o in tree_flatten(trc.output)[0] if isinstance(o, Proxy)]
+    out_vars = {Variable(o) for o in out_flat}
+
+    last_use: dict[Variable, int] = {}
+    for i, bsym in enumerate(trc.bound_symbols):
+        for v in consumed_vars(bsym):
+            last_use[v] = i
+
+    current = sum(live.values())
+    peak = current
+    for i, bsym in enumerate(trc.bound_symbols):
+        for p in bsym.flat_proxy_outs():
+            if isinstance(p, TensorProxy):
+                v = Variable(p)
+                if v not in live:
+                    live[v] = nbytes(p)
+                    current += live[v]
+        peak = max(peak, current)
+        # free tensors whose last use was this bsym
+        for v in list(live):
+            if last_use.get(v, -1) == i and v not in out_vars:
+                current -= live.pop(v)
+    return {"peak_bytes": peak, "output_bytes": sum(
+        p.numel * p.dtype.bytes for p in out_flat if isinstance(p, TensorProxy))}

@@ -2,4 +2,4 @@
 ``thunder_tpu.ops`` (reference parity: ``thunder/tests/nanogpt_model.py``,
 ``litgpt_model.py``, ``llama2_model.py`` — fresh implementations)."""
 
-from thunder_tpu.models import llama  # noqa: F401
+from thunder_tpu.models import llama, mixtral, nanogpt  # noqa: F401

@@ -1,0 +1,111 @@
+"""nanoGPT-style GPT-2 model (model-zoo parity with the reference's
+self-contained ``thunder/tests/nanogpt_model.py`` — fresh functional
+implementation: learned position embeddings, pre-LN blocks, GELU MLP,
+optional weight tying)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    name: str = "gpt2-tiny"
+    vocab_size: int = 512
+    block_size: int = 128
+    n_layer: int = 4
+    n_head: int = 4
+    n_embd: int = 64
+    dropout: float = 0.0
+    dtype: dtypes.dtype = dtypes.float32
+
+
+CONFIGS = {
+    "gpt2-tiny": GPTConfig(),
+    "gpt2": GPTConfig(name="gpt2", vocab_size=50257, block_size=1024, n_layer=12,
+                      n_head=12, n_embd=768),
+    "gpt2-xl": GPTConfig(name="gpt2-xl", vocab_size=50257, block_size=1024, n_layer=48,
+                         n_head=25, n_embd=1600, dtype=dtypes.bfloat16),
+}
+
+
+def init_params(cfg: GPTConfig, seed: int = 0, scale_layers: int | None = None):
+    import jax
+    import jax.numpy as jnp
+
+    n_layer = scale_layers if scale_layers is not None else cfg.n_layer
+    jd = cfg.dtype.jax
+    key = jax.random.PRNGKey(seed)
+    D = cfg.n_embd
+
+    def dense(key, shape, std=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(jd)
+
+    keys = iter(jax.random.split(key, 4 + n_layer * 4))
+    params = {
+        "wte": dense(next(keys), (cfg.vocab_size, D)),
+        "wpe": dense(next(keys), (cfg.block_size, D)),
+        "ln_f": {"w": jnp.ones((D,), jd), "b": jnp.zeros((D,), jd)},
+        "blocks": [],
+    }
+    for _ in range(n_layer):
+        params["blocks"].append({
+            "ln1": {"w": jnp.ones((D,), jd), "b": jnp.zeros((D,), jd)},
+            "attn_qkv": {"w": dense(next(keys), (3 * D, D)), "b": jnp.zeros((3 * D,), jd)},
+            "attn_proj": {"w": dense(next(keys), (D, D)), "b": jnp.zeros((D,), jd)},
+            "ln2": {"w": jnp.ones((D,), jd), "b": jnp.zeros((D,), jd)},
+            "mlp_fc": {"w": dense(next(keys), (4 * D, D)), "b": jnp.zeros((4 * D,), jd)},
+            "mlp_proj": {"w": dense(next(keys), (D, 4 * D)), "b": jnp.zeros((D,), jd)},
+        })
+    return params
+
+
+def forward(params, tokens, cfg: GPTConfig, training: bool = False):
+    B, T = tokens.shape
+    D, H = cfg.n_embd, cfg.n_head
+    hd = D // H
+
+    tok = ops.embedding(tokens, params["wte"])  # (B, T, D)
+    pos = ops.embedding(ops.arange(T), params["wpe"])  # (T, D)
+    h = ops.add(tok, pos)
+    if training and cfg.dropout > 0:
+        h = ops.dropout(h, cfg.dropout)
+
+    for blk in params["blocks"]:
+        x = ops.layer_norm(h, (D,), blk["ln1"]["w"], blk["ln1"]["b"])
+        qkv = ops.linear(x, blk["attn_qkv"]["w"], blk["attn_qkv"]["b"])  # (B, T, 3D)
+        q, k, v = ops.split(qkv, D, dim=-1)
+        q = ops.transpose(ops.reshape(q, (B, T, H, hd)), (0, 2, 1, 3))
+        k = ops.transpose(ops.reshape(k, (B, T, H, hd)), (0, 2, 1, 3))
+        v = ops.transpose(ops.reshape(v, (B, T, H, hd)), (0, 2, 1, 3))
+        att = ops.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=cfg.dropout if training else 0.0)
+        att = ops.reshape(ops.transpose(att, (0, 2, 1, 3)), (B, T, D))
+        att = ops.linear(att, blk["attn_proj"]["w"], blk["attn_proj"]["b"])
+        if training and cfg.dropout > 0:
+            att = ops.dropout(att, cfg.dropout)
+        h = ops.add(h, att)
+
+        x = ops.layer_norm(h, (D,), blk["ln2"]["w"], blk["ln2"]["b"])
+        m = ops.gelu(ops.linear(x, blk["mlp_fc"]["w"], blk["mlp_fc"]["b"]), approximate="tanh")
+        m = ops.linear(m, blk["mlp_proj"]["w"], blk["mlp_proj"]["b"])
+        if training and cfg.dropout > 0:
+            m = ops.dropout(m, cfg.dropout)
+        h = ops.add(h, m)
+
+    h = ops.layer_norm(h, (D,), params["ln_f"]["w"], params["ln_f"]["b"])
+    # weight-tied head (GPT-2)
+    logits = ops.linear(h, params["wte"])
+    return logits
+
+
+def loss_fn(params, tokens, targets, cfg: GPTConfig, training: bool = False):
+    logits = forward(params, tokens, cfg, training=training)
+    B, T, V = logits.shape
+    return ops.cross_entropy(
+        ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32),
+        ops.reshape(targets, (B * T,)))

@@ -814,11 +814,50 @@ def topk(a, k, dim=-1):
 
 
 # ---------------------------------------------------------------------------
+# autocast: downcast matmul-class op inputs inside the context
+# (reference: per-op autocast rules, thunder/core/transforms.py:3757-3960)
+# ---------------------------------------------------------------------------
+
+_autocast_stack: list = []
+
+
+class autocast:
+    """Context manager used *inside traced code*: matmul/linear/conv/SDPA
+    inputs in float32 are downcast to the target dtype while active."""
+
+    def __init__(self, dtype=dtypes.bfloat16):
+        self.dtype = dtypes.to_dtype(dtype)
+
+    def __enter__(self):
+        _autocast_stack.append(self.dtype)
+        return self
+
+    def __exit__(self, *exc):
+        _autocast_stack.pop()
+        return False
+
+
+def _autocast_dtype():
+    return _autocast_stack[-1] if _autocast_stack else None
+
+
+def maybe_autocast(*ts):
+    dt = _autocast_dtype()
+    if dt is None:
+        return ts
+    return tuple(
+        convert_element_type(t, dt)
+        if isinstance(t, TensorProxy) and t.dtype is dtypes.float32 else t
+        for t in ts)
+
+
+# ---------------------------------------------------------------------------
 # linalg — everything decomposes into dot_general (the MXU prim)
 # ---------------------------------------------------------------------------
 
 @opsymbol
 def matmul(a, b):
+    a, b = maybe_autocast(a, b)
     check(isinstance(a, TensorProxy) and isinstance(b, TensorProxy), "matmul expects tensors")
     if a.ndim == 1 and b.ndim == 1:
         return prims.dot_general(a, b, contract_dims=((0,), (0,)))
@@ -853,6 +892,7 @@ def linear(a, w, bias=None):
     """
     from thunder_tpu.core.proxies import DistParallelType
 
+    a, w, bias = maybe_autocast(a, w, bias)
     dpt = getattr(w, "distparallel_type", DistParallelType.NONE)
     if dpt is DistParallelType.COLUMN_WISE:
         from thunder_tpu.distributed import prims as dist_prims
@@ -880,6 +920,8 @@ def dot_general(a, b, contract_dims, batch_dims=((), ()), preferred_element_type
 
 @opsymbol
 def conv2d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    a, w, bias = maybe_autocast(a, w, bias)
+
     def _pair(x):
         return (x, x) if isinstance(x, int) else tuple(x)
 
