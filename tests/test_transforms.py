@@ -1,0 +1,104 @@
+"""Functional transform tests: jvp, vmap, einsum grads (reference parity:
+``thunder/tests/test_transforms.py``, ``test_grad.py`` jvp/vmap sections)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+
+
+def test_jvp_matches_jax():
+    def f(a, b):
+        return ops.sum(ops.tanh(ops.matmul(a, b)))
+
+    def jf(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 5).astype(np.float32)
+    b = rng.randn(5, 3).astype(np.float32)
+    ta = rng.randn(4, 5).astype(np.float32)
+    tb = rng.randn(5, 3).astype(np.float32)
+
+    def run(a, b, ta, tb):
+        return tt.jvp(f)((a, b), (ta, tb))
+
+    out, tangent = tt.jit(run)(a, b, ta, tb)
+    jout, jtangent = jax.jvp(jf, (a, b), (ta, tb))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jout), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(tangent), np.asarray(jtangent), atol=1e-4, rtol=1e-4)
+
+
+def test_jvp_elementwise_and_shape_ops():
+    def f(x):
+        y = ops.exp(ops.reshape(x, (6,)))
+        return ops.sum(ops.mul(y, y))
+
+    def jf(x):
+        y = jnp.exp(x.reshape(6))
+        return (y * y).sum()
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3).astype(np.float32)
+    tx = rng.randn(2, 3).astype(np.float32)
+
+    out, tangent = tt.jit(lambda x, tx: tt.jvp(f)((x,), (tx,)))(x, tx)
+    jout, jtangent = jax.jvp(jf, (x,), (tx,))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jout), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(tangent), np.asarray(jtangent), atol=1e-4, rtol=1e-4)
+
+
+def test_vmap_batches():
+    def per_sample(x, w):
+        return ops.tanh(ops.matmul(w, x))
+
+    rng = np.random.RandomState(2)
+    xs = rng.randn(6, 5).astype(np.float32)  # batch of 6 vectors
+    w = rng.randn(4, 5).astype(np.float32)
+
+    def run(xs, w):
+        return tt.vmap(per_sample, in_axes=(0, None))(xs, w)
+
+    got = np.asarray(tt.jit(run)(xs, w))
+    want = np.tanh(np.einsum("ij,bj->bi", w, xs))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_einsum_matches_jnp():
+    rng = np.random.RandomState(3)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 5).astype(np.float32)
+    got = np.asarray(tt.jit(lambda a, b: ops.einsum("ij,jk->ik", a, b))(a, b))
+    np.testing.assert_allclose(got, a @ b, atol=1e-5, rtol=1e-5)
+
+    c = rng.randn(2, 3, 4).astype(np.float32)
+    d = rng.randn(2, 4, 5).astype(np.float32)
+    got = np.asarray(tt.jit(lambda c, d: ops.einsum("bij,bjk->bik", c, d))(c, d))
+    np.testing.assert_allclose(got, np.einsum("bij,bjk->bik", c, d), atol=1e-5, rtol=1e-5)
+
+
+def test_einsum_grad():
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 5).astype(np.float32)
+
+    def loss(a, b):
+        o = ops.einsum("ij,jk->ik", a, b)
+        return ops.sum(ops.mul(o, o))
+
+    def train(a, b):
+        return tt.value_and_grad(loss, argnums=(0, 1))(a, b)
+
+    lv, (ga, gb) = tt.jit(train)(a, b)
+
+    def jloss(a, b):
+        o = jnp.einsum("ij,jk->ik", a, b)
+        return (o * o).sum()
+
+    jl, (jga, jgb) = jax.value_and_grad(jloss, argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(lv), np.asarray(jl), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(jga), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(jgb), atol=1e-4, rtol=1e-4)

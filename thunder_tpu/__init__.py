@@ -32,6 +32,8 @@ from thunder_tpu.core.transform_common import Transform, cse, dce
 from thunder_tpu.core.transforms import (
     forward_and_backward_from_trace,
     inline_value_and_grad,
+    jvp_call,
+    vmap_call,
 )
 
 __version__ = "0.1.0"
@@ -293,6 +295,22 @@ def grad(fn: Callable, argnums=0) -> Callable:
         return g
 
     return grad_fn
+
+
+def jvp(fn: Callable) -> Callable:
+    """Forward-mode derivative: jvp(fn)(primals, tangents) -> (out, out_tangent).
+    Usable inside a jitted function (reference ``transforms.py:2175``)."""
+
+    def jvp_fn(primals, tangents):
+        return jvp_call(fn, tuple(primals), tuple(tangents))
+
+    return jvp_fn
+
+
+def vmap(fn: Callable, in_axes=0) -> Callable:
+    """Batching transform (reference ``transforms.py:1902``); lowers to an
+    opaque jax.vmap region — opaque to trace-level autograd."""
+    return vmap_call(fn, in_axes=in_axes)
 
 
 # ---------------------------------------------------------------------------

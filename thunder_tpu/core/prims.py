@@ -75,7 +75,7 @@ class PrimIDs(Enum):
     SUM = auto(); PROD = auto(); AMAX = auto(); AMIN = auto(); ARGMAX = auto(); ARGMIN = auto()
     CUMSUM = auto(); SORT = auto(); ARGSORT = auto(); TOPK = auto()
     # linalg / nn
-    DOT_GENERAL = auto(); CONVOLUTION = auto()
+    DOT_GENERAL = auto(); CONVOLUTION = auto(); EINSUM = auto()
     # host interaction
     ITEM = auto()
 
@@ -656,6 +656,18 @@ def _convolution_meta(a: TensorProxy, w: TensorProxy, bias: TensorProxy | None, 
 
 
 convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", _convolution_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _einsum_meta(equation: str, *operands) -> TensorProxy:
+    import jax
+    import jax.numpy as jnp
+
+    shapes = [jax.ShapeDtypeStruct(t.shape, t.dtype.jax) for t in operands]
+    out = jax.eval_shape(lambda *xs: jnp.einsum(equation, *xs), *shapes)
+    return TensorProxy(shape=out.shape, dtype=dtypes.to_dtype(out.dtype), device=operands[0].device)
+
+
+einsum = make_prim(PrimIDs.EINSUM, "einsum", _einsum_meta, tags=(OpTags.MATMUL_OP,))
 
 
 # ---------------------------------------------------------------------------

@@ -790,6 +790,241 @@ def _scatter_add_vjp(a, indices, value, dim):
     return out, pullback
 
 
+# ---------------------------------------------------------------------------
+# forward-mode (jvp) and batching (vmap)
+# ---------------------------------------------------------------------------
+
+# prims linear in their single differentiable tensor argument (arg 0):
+# tangent = op(t, <other args unchanged>)
+_SINGLE_LINEAR_PRIMS = {
+    PrimIDs.NEG, PrimIDs.BROADCAST_IN_DIM, PrimIDs.RESHAPE, PrimIDs.SQUEEZE,
+    PrimIDs.TRANSPOSE, PrimIDs.SLICE, PrimIDs.FLIP, PrimIDs.SUM, PrimIDs.CUMSUM,
+    PrimIDs.TAKE, PrimIDs.TAKE_ALONG_AXIS, PrimIDs.CONVERT_ELEMENT_TYPE,
+}
+
+# bilinear prims: tangent = op(t_a, b) + op(a, t_b)
+_BILINEAR_PRIMS = {PrimIDs.DOT_GENERAL, PrimIDs.MUL}
+
+
+def jvp_call(fn, primals: tuple, tangents: tuple):
+    """Forward-mode derivative, usable under tracing. Elementwise prims reuse
+    their VJP pullbacks (diagonal Jacobian ⇒ Jt == Jᵀt applied elementwise);
+    linear/bilinear prims use structural rules
+    (reference jvp: ``thunder/core/transforms.py:2175``)."""
+    from thunder_tpu import ops
+    from thunder_tpu.core.prims import OpTags
+
+    check(get_tracectx() is not None, "jvp_call must run under tracing")
+    inner, inner_inputs, _ = _trace_subfn(fn, primals, {})
+    flat_p, _ = tree_flatten(primals)
+    flat_t, _ = tree_flatten(tangents)
+    env: dict = {}
+    tan: dict[Variable, Any] = {}
+    j = 0
+    for p, t in zip(flat_p, flat_t):
+        if isinstance(p, Proxy):
+            env[Variable(inner_inputs[j])] = p
+            if t is not None:
+                # key tangents by the OUTER (mapped) proxies — replayed bsym
+                # args are env-mapped before tangent lookup
+                tan[Variable(p)] = t
+            j += 1
+
+    def tangent_of(x):
+        return tan.get(Variable(x)) if isinstance(x, Proxy) else None
+
+    def walk(bsyms):
+        for bsym in bsyms:
+            sym_id = bsym.sym.id
+            if sym_id in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
+                continue
+            if bsym.sym.meta is None:  # const_tensor etc.
+                cur = get_tracectx()
+                if cur is not None:
+                    cur.add_bound_symbol(bsym.from_bsym())
+                for o in bsym.flat_proxy_outs():
+                    env.setdefault(Variable(o), o)
+                continue
+            if not bsym.sym.is_prim and bsym.subsymbols:
+                walk(bsym.subsymbols)
+                out_flat, _ = tree_flatten(bsym.output)
+                for o in out_flat:
+                    if isinstance(o, Proxy) and Variable(o) not in env:
+                        env[Variable(o)] = o
+                continue
+
+            margs = _env_map(env, bsym.args)
+            mkwargs = _env_map(env, bsym.kwargs)
+            flat_margs, adef = tree_flatten(margs)
+            arg_tans = [tangent_of(a) for a in flat_margs]
+            has_tan = any(t is not None for t in arg_tans)
+
+            out = bsym.sym(*margs, **mkwargs)
+            _bind_outputs(env, bsym.output, out)
+            if not has_tan:
+                continue
+
+            def op_with(i, val):
+                sub = list(flat_margs)
+                sub[i] = val
+                return bsym.sym(*tree_unflatten(adef, sub), **mkwargs)
+
+            t_out = None
+            if sym_id in _SINGLE_LINEAR_PRIMS:
+                t_out = op_with(0, arg_tans[0]) if arg_tans[0] is not None else None
+            elif sym_id is PrimIDs.PAD:
+                # pad value is a constant: tangent pads with zero
+                t_out = prims.pad(arg_tans[0], 0.0, bsym.args[2] if len(bsym.args) > 2
+                                  else margs[2])
+            elif sym_id is PrimIDs.ADD:
+                terms = [t for t in arg_tans if t is not None]
+                t_out = terms[0] if len(terms) == 1 else ops.add(*terms)
+            elif sym_id is PrimIDs.SUB:
+                ta, tb = arg_tans[0], arg_tans[1]
+                if ta is not None and tb is not None:
+                    t_out = ops.sub(ta, tb)
+                elif ta is not None:
+                    t_out = ta
+                else:
+                    t_out = ops.neg(tb)
+            elif sym_id is PrimIDs.WHERE:
+                pred, a, b = margs
+                ta = arg_tans[1] if len(arg_tans) > 1 else None
+                tb = arg_tans[2] if len(arg_tans) > 2 else None
+                za = ta if ta is not None else ops.zeros_like(out)
+                zb = tb if tb is not None else ops.zeros_like(out)
+                t_out = prims.where(pred, za, zb)
+            elif sym_id is PrimIDs.CAT:
+                tensors = margs[0]
+                tans = [tangent_of(t) for t in tensors]
+                pieces = [tn if tn is not None else ops.zeros_like(t)
+                          for t, tn in zip(tensors, tans)]
+                t_out = prims.cat(pieces, margs[1])
+            elif sym_id in _BILINEAR_PRIMS:
+                for i, t in enumerate(arg_tans):
+                    if t is None:
+                        continue
+                    term = op_with(i, t)
+                    t_out = term if t_out is None else ops.add(t_out, term)
+            elif sym_id in _vjp_rules and OpTags.ELEMENTWISE_OP in bsym.sym.tags:
+                res = _vjp_rules[sym_id](*margs, **mkwargs)
+                if res is NotImplemented or res is None:
+                    raise NotImplementedError(f"no jvp rule for {bsym.sym.name}")
+                _, pullback = res
+                for i, t in enumerate(arg_tans):
+                    if t is None:
+                        continue
+                    pairs = pullback(t) or []
+                    for p_, g_ in pairs:
+                        if p_ is flat_margs[i]:
+                            t_out = g_ if t_out is None else ops.add(t_out, g_)
+            elif sym_id in _NONDIFF:
+                t_out = None
+            else:
+                raise NotImplementedError(f"no jvp rule for prim {bsym.sym.name}")
+            if t_out is not None:
+                out_proxies = [x for x in tree_flatten(out)[0] if isinstance(x, Proxy)]
+                if out_proxies:
+                    tan[Variable(out_proxies[0])] = t_out
+
+    walk(inner.bound_symbols)
+    out = _env_map(env, inner.output)
+    out_flat = [o for o in tree_flatten(out)[0] if isinstance(o, Proxy)]
+    out_tans = tuple(tan.get(Variable(o)) for o in out_flat)
+    return out, out_tans[0] if len(out_tans) == 1 else out_tans
+
+
+def vmap_call(fn, in_axes=0):
+    """Batching transform. Lowers to an opaque jax.vmap over the traced
+    function's JAX interpretation — correct for all ops, but opaque to
+    trace-level autograd (differentiate outside, or use per-sample ops).
+    Reference: ``thunder/core/transforms.py:1902`` (also partial)."""
+    import jax
+
+    def wrapper(*args):
+        from thunder_tpu.core.proxies import TensorProxy as TP
+        from thunder_tpu.core.symbol import Symbol
+        from thunder_tpu.executors.xla import run_bsyms
+
+        check(get_tracectx() is not None, "vmap_call must run under tracing")
+        axes = in_axes if isinstance(in_axes, (tuple, list)) else (in_axes,) * len(args)
+        check(len(axes) == len(args), "in_axes length must match args")
+        # trace fn at the unbatched rank
+        unbatched = []
+        for a, ax in zip(args, axes):
+            if isinstance(a, TP) and ax is not None:
+                shape = tuple(s for i, s in enumerate(a.shape) if i != ax)
+                unbatched.append(TP(shape=shape, dtype=a.dtype, device=a.device))
+            else:
+                unbatched.append(a)
+        inner, inner_inputs, _ = _trace_subfn(lambda *xs: fn(*xs), tuple(unbatched), {})
+        input_names = [p.name for p in inner_inputs]
+        out_spec = inner.output
+
+        def jax_fn(*vals):
+            env = dict(zip(input_names, vals))
+            run_bsyms(inner.bound_symbols, env)
+
+            def read(x):
+                return env[x.name] if isinstance(x, Proxy) else x
+
+            return tree_map(read, out_spec, is_leaf=lambda x: isinstance(x, Proxy))
+
+        # jax_fn's positional args are exactly the proxy leaves of (args,)
+        proxy_axes = tuple(ax for a, ax in zip(args, axes) if isinstance(a, TP))
+        proxy_args = [a for a in args if isinstance(a, TP)]
+        vmapped = jax.vmap(jax_fn, in_axes=proxy_axes)
+
+        bdim = None
+        for a, ax in zip(args, axes):
+            if isinstance(a, TP) and ax is not None:
+                bdim = a.shape[ax]
+                break
+        check(bdim is not None, "vmap requires at least one batched tensor arg")
+
+        out_metas = tree_map(
+            lambda o: TensorProxy(shape=(bdim,) + o.shape, dtype=o.dtype, device=o.device)
+            if isinstance(o, TensorProxy) else o,
+            out_spec, is_leaf=lambda x: isinstance(x, Proxy))
+
+        trc = get_tracectx()
+        idx = getattr(trc, "_vmap_counter", 0)
+        trc._vmap_counter = idx + 1
+        vsym = Symbol(f"vmap{idx}", None, id=f"vmap:{idx}", is_prim=True, python_impl=vmapped)
+        trc.add_bound_symbol(vsym.bind(*proxy_args, output=out_metas))
+        return out_metas
+
+    return wrapper
+
+
+@register_vjp(PrimIDs.EINSUM)
+def _einsum_vjp(equation, *operands):
+    out = prims.einsum(equation, *operands)
+    eq = equation.replace(" ", "")
+    check("->" in eq and "." not in eq,
+          "einsum grad requires explicit '->' output and no ellipsis")
+    lhs, rhs = eq.split("->")
+    specs = lhs.split(",")
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        pairs = []
+        for i, op in enumerate(operands):
+            if not isinstance(op, TensorProxy):
+                continue
+            other_specs = [specs[j] for j in range(len(specs)) if j != i]
+            others = [operands[j] for j in range(len(specs)) if j != i]
+            gi_eq = ",".join([rhs] + other_specs) + "->" + specs[i]
+            gi = prims.einsum(gi_eq, g, *others)
+            if gi.dtype is not op.dtype:
+                gi = ops.convert_element_type(gi, op.dtype)
+            pairs.append((op, gi))
+        return pairs
+
+    return out, pullback
+
+
 @register_vjp(PrimIDs.TOPK)
 def _topk_vjp(a, k, dim):
     values, indices = prims.topk(a, k, dim)

@@ -339,6 +339,11 @@ def _dot_general(a, b, *, contract_dims, batch_dims=((), ()), preferred_element_
                            preferred_element_type=pet)
 
 
+@impl(PrimIDs.EINSUM)
+def _einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
 @impl(PrimIDs.CONVOLUTION)
 def _convolution(a, w, bias, *, stride, padding, dilation, groups):
     nspatial = a.ndim - 2

@@ -61,7 +61,7 @@ def run_bsyms(bsyms, env: dict):
     from thunder_tpu.executors.eagerjax import get_eager_impl
 
     for b in bsyms:
-        if b.sym.id in (PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
+        if b.sym.id in (PrimIDs.COMMENT, PrimIDs.PYTHON_DEL, PrimIDs.PYTHON_RETURN):
             continue
         impl = b.sym.python_impl or get_eager_impl(b.sym)
         if impl is None:

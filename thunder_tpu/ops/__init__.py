@@ -913,6 +913,11 @@ def outer(a, b):
     return mul(unsqueeze(a, 1), unsqueeze(b, 0))
 
 
+def einsum(equation, *operands):
+    operands = tuple(maybe_autocast(*operands))
+    return prims.einsum(equation, *operands)
+
+
 def dot_general(a, b, contract_dims, batch_dims=((), ()), preferred_element_type=None):
     return prims.dot_general(a, b, contract_dims=contract_dims, batch_dims=batch_dims,
                              preferred_element_type=preferred_element_type)
