@@ -1,0 +1,196 @@
+"""Benchmark harness + workloads.
+
+Reference parity: ``thunder/benchmarks/__init__.py`` (Benchmark/BenchmarkArg/
+BenchmarkRunStatistics harness with median/IQR stats :53-308; nanoGPT/litgpt
+module workloads :963+) re-built for JAX timing semantics
+(``block_until_ready``, compile-time split out).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+
+@dataclass
+class BenchmarkRunStatistics:
+    name: str
+    times_s: list[float]
+    compile_s: float
+
+    @property
+    def median_s(self) -> float:
+        return statistics.median(self.times_s)
+
+    @property
+    def mean_s(self) -> float:
+        return statistics.fmean(self.times_s)
+
+    @property
+    def iqr_s(self) -> float:
+        qs = statistics.quantiles(self.times_s, n=4)
+        return qs[2] - qs[0]
+
+    def summary(self) -> str:
+        return (f"{self.name}: median {self.median_s*1e3:.3f} ms "
+                f"(mean {self.mean_s*1e3:.3f}, iqr {self.iqr_s*1e3:.3f}, "
+                f"compile {self.compile_s:.2f} s, n={len(self.times_s)})")
+
+
+def time_fn(fn: Callable, *args, warmup: int = 2, iters: int = 10, name: str = "fn",
+            **kwargs) -> BenchmarkRunStatistics:
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    for _ in range(max(0, warmup - 1)):
+        jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    return BenchmarkRunStatistics(name, times, compile_s)
+
+
+@dataclass
+class Benchmark:
+    """A workload: produces (fn, args) pairs and derived metrics."""
+
+    name: str
+    make: Callable[[], tuple[Callable, tuple]]
+    tokens_per_iter: int | None = None
+
+    def run(self, *, executors=None, warmup: int = 2, iters: int = 10) -> BenchmarkRunStatistics:
+        import thunder_tpu as tt
+
+        fn, args = self.make()
+        jfn = tt.jit(fn, executors=executors)
+        label = f"{self.name}[{','.join(e if isinstance(e, str) else e.name for e in (executors or ['default']))}]"
+        return time_fn(jfn, *args, warmup=warmup, iters=iters, name=label)
+
+
+# ---------------------------------------------------------------------------
+# workloads (reference: nanoGPT CSA/MLP/Block, litgpt GELU/SDPA, llama2 MLP,
+# cross-entropy microbenchmarks — thunder/benchmarks/__init__.py:963+)
+# ---------------------------------------------------------------------------
+
+def _np_rng(seed=0):
+    import numpy as np
+
+    return np.random.RandomState(seed)
+
+
+def make_sdpa_benchmark(B=8, H=16, T=1024, hd=128, causal=True, dtype="bfloat16") -> Benchmark:
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        rng = _np_rng()
+        mk = lambda: rng.randn(B, H, T, hd).astype(np.float32)
+        q, k, v = mk(), mk(), mk()
+
+        def fn(q, k, v):
+            return ops.scaled_dot_product_attention(q, k, v, is_causal=causal)
+
+        return fn, (q, k, v)
+
+    return Benchmark(f"sdpa_B{B}H{H}T{T}D{hd}", make)
+
+
+def make_cross_entropy_benchmark(N=8192, V=32000) -> Benchmark:
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        rng = _np_rng()
+        logits = rng.randn(N, V).astype(np.float32)
+        tgt = rng.randint(0, V, size=(N,)).astype(np.int32)
+
+        def fn(logits):
+            return ops.cross_entropy(logits, tgt)
+
+        return fn, (logits,)
+
+    return Benchmark(f"cross_entropy_N{N}V{V}", make)
+
+
+def make_llama_mlp_benchmark(B=8, T=1024, D=4096, I=11008) -> Benchmark:
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        rng = _np_rng()
+        x = rng.randn(B, T, D).astype(np.float32)
+        wg = (rng.randn(I, D) / np.sqrt(D)).astype(np.float32)
+        wu = (rng.randn(I, D) / np.sqrt(D)).astype(np.float32)
+        wd = (rng.randn(D, I) / np.sqrt(I)).astype(np.float32)
+
+        def fn(x, wg, wu, wd):
+            return ops.linear(ops.mul(ops.silu(ops.linear(x, wg)), ops.linear(x, wu)), wd)
+
+        return fn, (x, wg, wu, wd)
+
+    return Benchmark(f"llama_mlp_B{B}T{T}D{D}I{I}", make)
+
+
+def make_rmsnorm_benchmark(N=8192, D=4096) -> Benchmark:
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        rng = _np_rng()
+        x = rng.randn(N, D).astype(np.float32)
+        w = rng.randn(D).astype(np.float32)
+
+        def fn(x, w):
+            return ops.rms_norm(x, w)
+
+        return fn, (x, w)
+
+    return Benchmark(f"rms_norm_N{N}D{D}", make)
+
+
+def make_train_step_benchmark(config: str = "tiny", batch: int = 4, seq: int = 256,
+                              n_layers: int | None = None) -> Benchmark:
+    def make():
+        import numpy as np
+
+        import thunder_tpu as tt
+        from thunder_tpu.models import llama
+        from thunder_tpu.optim import AdamW
+
+        cfg = llama.CONFIGS[config]
+        params = llama.init_params(cfg, seed=0, scale_layers=n_layers)
+        opt = AdamW(lr=1e-4)
+        rng = _np_rng()
+        tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+        targets = np.roll(tokens, -1, 1).astype(np.int32)
+
+        def fn(params, opt_state, tokens, targets):
+            loss, grads = tt.value_and_grad(
+                lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+            return loss, *opt.update(params, grads, opt_state)
+
+        return fn, (params, opt.init(params), tokens, targets)
+
+    b = Benchmark(f"llama_{config}_train_B{batch}T{seq}", make)
+    b.tokens_per_iter = batch * seq
+    return b
+
+
+DEFAULT_BENCHMARKS: dict[str, Callable[[], Benchmark]] = {
+    "sdpa": make_sdpa_benchmark,
+    "cross_entropy": make_cross_entropy_benchmark,
+    "llama_mlp": make_llama_mlp_benchmark,
+    "rms_norm": make_rmsnorm_benchmark,
+    "train_step": make_train_step_benchmark,
+}

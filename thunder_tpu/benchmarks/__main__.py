@@ -1,0 +1,34 @@
+"""Benchmark CLI: compare executors on a workload.
+
+Usage:
+  python -m thunder_tpu.benchmarks --workload sdpa --executors pallas,xla xla
+  python -m thunder_tpu.benchmarks --workload train_step
+
+Reference parity: the pytest-benchmark target grid
+(``thunder/benchmarks/targets.py``) as a plain CLI.
+"""
+
+import argparse
+
+from thunder_tpu.benchmarks import DEFAULT_BENCHMARKS
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workload", default="sdpa", choices=sorted(DEFAULT_BENCHMARKS))
+    p.add_argument("--executors", nargs="*", default=["xla", "pallas,xla"],
+                   help="comma-joined executor lists to compare")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    bench = DEFAULT_BENCHMARKS[args.workload]()
+    for exs in args.executors:
+        stats = bench.run(executors=exs.split(","), iters=args.iters)
+        line = stats.summary()
+        if bench.tokens_per_iter:
+            line += f"  ({bench.tokens_per_iter / stats.median_s:.0f} tokens/s)"
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Production-style pretraining throughput CLI.
+
+Reference parity: ``thunder/benchmarks/benchmark_litgpt.py`` — model ×
+parallelism-mode grid reporting tokens/s and model-flops utilization; here
+the optimizer is part of the compiled step (the reference steps eager AdamW,
+SURVEY §3.5 note).
+
+Usage:
+  python -m thunder_tpu.benchmarks.pretrain --model tiny --mode fsdp --steps 10
+  python -m thunder_tpu.benchmarks.pretrain --model llama2-7b-bench --layers 2 --batch 1 --seq 2048
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="tiny", help="llama config name")
+    p.add_argument("--mode", default="single",
+                   choices=["single", "fsdp", "ddp", "tp", "cp"])
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--peak-tflops", type=float, default=197.0,
+                   help="per-chip peak bf16 TFLOP/s (v5e=197, v5p=459)")
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu.core.devices import MeshSpec
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import AdamW
+
+    cfg = llama.CONFIGS[args.model]
+    n_layers = args.layers if args.layers is not None else cfg.n_layers
+    opt = AdamW(lr=args.lr)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        return loss, *opt.update(params, grads, opt_state)
+
+    n_dev = len(jax.devices())
+    if args.mode == "single":
+        jstep = tt.jit(train_step)
+    elif args.mode == "fsdp":
+        from thunder_tpu.distributed import fsdp
+
+        jstep = fsdp(train_step, MeshSpec.make(fsdp=n_dev))
+    elif args.mode == "ddp":
+        from thunder_tpu.distributed import ddp
+
+        jstep = ddp(train_step, MeshSpec.make(dp=n_dev))
+    elif args.mode == "cp":
+        from thunder_tpu.distributed import context_parallel
+
+        jstep = context_parallel(train_step, MeshSpec.make(sp=n_dev))
+    elif args.mode == "tp":
+        from thunder_tpu.distributed import tensor_parallel
+
+        local_cfg = llama.tp_config(cfg, n_dev)
+        cfg = local_cfg
+        jstep = tensor_parallel(train_step, MeshSpec.make(tp=n_dev),
+                                column_patterns=llama.TP_COLUMN_PATTERNS,
+                                row_patterns=llama.TP_ROW_PATTERNS)
+
+    params = llama.init_params(llama.CONFIGS[args.model], seed=0, scale_layers=n_layers)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+
+    t0 = time.perf_counter()
+    loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    base_cfg = llama.CONFIGS[args.model]
+    tokens_per_step = args.batch * args.seq
+    tps = tokens_per_step / dt
+    fpt = llama.flops_per_token(base_cfg, args.seq, n_layers)
+    mfu = tps * fpt / (args.peak_tflops * 1e12 * max(1, n_dev))
+    print(f"model={args.model} layers={n_layers} mode={args.mode} devices={n_dev}")
+    print(f"compile {compile_s:.1f}s | {dt*1e3:.1f} ms/step | {tps:,.0f} tokens/s "
+          f"| MFU {mfu*100:.1f}% | loss {float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Training data pipeline: native memory-mapped token loader.
+
+The C++ library (``native/dataloader.cpp``) mmaps a tokenized binary shard
+and samples (B, T+1) windows with a counter-based RNG; Python binds it via
+ctypes (no pybind11 in this image). A pure-numpy fallback keeps everything
+working where no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_SRC = _REPO_ROOT / "native" / "dataloader.cpp"
+_LIB = _REPO_ROOT / "native" / "libttdata.so"
+
+
+def _build_native() -> Path | None:
+    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB
+    try:
+        subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+                       check=True, capture_output=True)
+        return _LIB
+    except Exception:
+        return None
+
+
+_lib_handle = None
+
+
+def _native_lib():
+    global _lib_handle
+    if _lib_handle is not None:
+        return _lib_handle
+    path = _build_native()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.ttdata_open.restype = ctypes.c_void_p
+    lib.ttdata_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ttdata_close.argtypes = [ctypes.c_void_p]
+    lib.ttdata_num_tokens.restype = ctypes.c_longlong
+    lib.ttdata_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.ttdata_sample_batch.restype = ctypes.c_int
+    lib.ttdata_sample_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint32)]
+    _lib_handle = lib
+    return lib
+
+
+class TokenDataset:
+    """Random-window sampler over a tokenized binary shard.
+
+    ``path``: raw little-endian token file (uint16 default, uint32 with
+    ``dtype_bytes=4``). ``sample(step)`` returns (tokens, targets) int32
+    arrays of shape (batch, seq) — deterministic in (seed, step).
+    """
+
+    def __init__(self, path: str, batch: int, seq: int, *, seed: int = 0, dtype_bytes: int = 2):
+        self.path = str(path)
+        self.batch = batch
+        self.seq = seq
+        self.seed = seed
+        self.dtype_bytes = dtype_bytes
+        self._lib = _native_lib()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.ttdata_open(self.path.encode(), dtype_bytes)
+            if not self._handle:
+                self._lib = None
+        if self._lib is None:  # numpy fallback
+            dt = np.uint16 if dtype_bytes == 2 else np.uint32
+            self._mm = np.memmap(self.path, dtype=dt, mode="r")
+        self._buf = np.empty((batch, seq + 1), np.uint32)
+
+    @property
+    def num_tokens(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ttdata_num_tokens(self._handle))
+        return int(self._mm.shape[0])
+
+    def sample(self, step: int):
+        if self._lib is not None:
+            rc = self._lib.ttdata_sample_batch(
+                self._handle, self.seed, step, self.batch, self.seq,
+                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            if rc != 0:
+                raise RuntimeError("ttdata_sample_batch failed (shard shorter than seq+1?)")
+            window = self._buf
+        else:
+            n = self.num_tokens
+            rng = np.random.RandomState((self.seed * 1_000_003 + step) % (2**31))
+            starts = rng.randint(0, n - self.seq - 1, size=self.batch)
+            window = np.stack([self._mm[s:s + self.seq + 1] for s in starts]).astype(np.uint32)
+        tokens = window[:, :-1].astype(np.int32)
+        targets = window[:, 1:].astype(np.int32)
+        return tokens, targets
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and getattr(self, "_handle", None):
+            try:
+                self._lib.ttdata_close(self._handle)
+            except Exception:
+                pass
+
+
+def write_token_file(path: str, tokens: np.ndarray, dtype_bytes: int = 2) -> None:
+    dt = np.uint16 if dtype_bytes == 2 else np.uint32
+    np.asarray(tokens, dtype=dt).tofile(path)
