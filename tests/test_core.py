@@ -191,3 +191,24 @@ def test_del_last_used_inserted():
     jf(np.ones((4,), np.float32), np.ones((4,), np.float32))
     src = tt.last_execution_trace(jf).python()
     assert "del " in src
+
+
+def test_sharp_edges_detection():
+    import warnings
+
+    captured = np.ones((3,), np.float32)
+
+    def f(a):
+        return a + captured  # closure capture -> sharp edge
+
+    with pytest.raises(RuntimeError, match="sharp edges"):
+        tt.jit(f, sharp_edges="error")(np.ones((3,), np.float32))
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tt.jit(f, sharp_edges="warn")(np.ones((3,), np.float32))
+    assert any("closure-captured" in str(x.message) for x in w)
+
+    # default: allowed silently
+    out = tt.jit(f)(np.ones((3,), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones(3, np.float32))

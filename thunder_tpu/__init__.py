@@ -120,10 +120,14 @@ class ThunderTPUFunction:
 
     def __init__(self, fn: Callable, *, executors=None, cache: str = "constant values",
                  transforms: Sequence[Transform] = (), enable_cse: bool = True,
-                 insert_dels: bool = True, fn_name: str | None = None):
+                 insert_dels: bool = True, sharp_edges: str = "allow",
+                 fn_name: str | None = None):
         from thunder_tpu.executors import resolve_executors
 
         check(cache in _CACHE_OPTIONS, lambda: f"unknown cache option {cache!r}")
+        check(sharp_edges in ("allow", "warn", "error"),
+              lambda: f"unknown sharp_edges option {sharp_edges!r}")
+        self.sharp_edges = sharp_edges
         self.fn = fn
         self.executors = resolve_executors(executors)
         self.cache_option = cache
@@ -208,6 +212,14 @@ class ThunderTPUFunction:
         t0 = time.perf_counter_ns()
         trc, tensor_indices = self._trace(flat, treedef)
         self._stats.last_interpreted_ns = time.perf_counter_ns() - t0
+        if trc.sharp_edges and self.sharp_edges != "allow":
+            msg = "sharp edges detected during tracing (reference SHARP_EDGES_OPTIONS):\n  " \
+                  + "\n  ".join(trc.sharp_edges)
+            if self.sharp_edges == "error":
+                raise RuntimeError(msg)
+            import warnings
+
+            warnings.warn(msg, stacklevel=3)
         traces = [trc]
 
         t1 = time.perf_counter_ns()
@@ -262,7 +274,7 @@ class ThunderTPUFunction:
 
 def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant values",
         transforms: Sequence[Transform] = (), enable_cse: bool = True,
-        insert_dels: bool = True) -> ThunderTPUFunction:
+        insert_dels: bool = True, sharp_edges: str = "allow") -> ThunderTPUFunction:
     """Compile ``fn``: trace → transform → dispatch to executors.
 
     Reference: ``thunder.jit`` (``thunder/__init__.py:262``).
@@ -270,11 +282,13 @@ def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant va
     if fn is None:
         def deco(f):
             return jit(f, executors=executors, cache=cache, transforms=transforms,
-                       enable_cse=enable_cse, insert_dels=insert_dels)
+                       enable_cse=enable_cse, insert_dels=insert_dels,
+                       sharp_edges=sharp_edges)
 
         return deco
     return ThunderTPUFunction(fn, executors=executors, cache=cache, transforms=transforms,
-                              enable_cse=enable_cse, insert_dels=insert_dels)
+                              enable_cse=enable_cse, insert_dels=insert_dels,
+                              sharp_edges=sharp_edges)
 
 
 # ---------------------------------------------------------------------------

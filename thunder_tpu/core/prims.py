@@ -675,7 +675,13 @@ einsum = make_prim(PrimIDs.EINSUM, "einsum", _einsum_meta, tags=(OpTags.MATMUL_O
 # ---------------------------------------------------------------------------
 
 def _item_meta(a: TensorProxy) -> NumberProxy:
+    from thunder_tpu.core.trace import get_tracectx
+
     check(a.numel == 1, "item() requires a 1-element tensor")
+    trc = get_tracectx()
+    if trc is not None:
+        trc.record_sharp_edge(
+            "item() forces a device->host sync and a static value in the trace")
     py = float if a.dtype.is_float else (bool if a.dtype.is_bool else int)
     return NumberProxy(py(0), python_type=py)
 

@@ -45,6 +45,9 @@ def _lift_constant_arrays(trc, args, kwargs):
 
         idx = getattr(trc, "_const_counter", 0)
         trc._const_counter = idx + 1
+        trc.record_sharp_edge(
+            f"closure-captured array (shape {tuple(x.shape)}) baked into the trace as "
+            f"const_tensor{idx}; changes to it will NOT retrigger compilation")
         out = TensorProxy(shape=x.shape, dtype=_dt.to_dtype(x.dtype), device=default_device())
         csym = Symbol(f"const_tensor{idx}", None, id=f"const_tensor:{idx}:{id(x)}",
                       is_prim=True, python_impl=lambda _v=x: _v)

@@ -68,6 +68,12 @@ class TraceCtx:
         self.fused_index = 0  # counter for fusion names
         self._python_ctx_extra: dict[str, Any] = {}
         self.tags: set[str] = set()
+        # sharp-edge events recorded during tracing (closure captures, host
+        # syncs, …); the driver reports them per its sharp_edges option
+        self.sharp_edges: list[str] = []
+
+    def record_sharp_edge(self, msg: str) -> None:
+        self.sharp_edges.append(msg)
 
     # -- names -------------------------------------------------------------
     def make_name(self, prefix: str = "t") -> str:
