@@ -207,3 +207,62 @@ def test_context_parallel_trace_has_ring(eight_devices):
     # and rank-dependent masking must be present
     assert "ppermute" in src
     assert "axis_index" in src
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism (NEW capability — SURVEY §2.6: PP absent upstream)
+# ---------------------------------------------------------------------------
+
+def _make_pp_step(cfg, opt, n_microbatches):
+    from thunder_tpu.distributed import make_pipeline_loss
+
+    embed, stage, head = llama.pipeline_fns(cfg)
+    ploss = make_pipeline_loss(embed, stage, head, n_microbatches=n_microbatches)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(lambda p: ploss(p, tokens, targets))(params)
+        new_params, new_state = opt.update(params, grads, opt_state)
+        return loss, new_params, new_state
+
+    return train_step
+
+
+def test_pipeline_parallel_matches_single_device(eight_devices):
+    from thunder_tpu.distributed import pipeline_parallel
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.stack_layers(llama.init_params(cfg, seed=0))  # 4 stacked layers
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 8, 16, seed=0)
+    step = _make_pp_step(cfg, opt, n_microbatches=4)
+
+    ref_losses, ref_params = _run_steps(tt.jit(step), params, opt.init(params), tokens, targets)
+    # microbatched pipeline loss == plain whole-batch loss
+    plain = tt.jit(_make_step(cfg, opt))(
+        llama.init_params(cfg, seed=0), opt.init(llama.init_params(cfg, seed=0)), tokens, targets)
+    np.testing.assert_allclose(ref_losses[0], float(np.asarray(plain[0])), atol=1e-4, rtol=1e-5)
+
+    jstep = pipeline_parallel(step, MeshSpec.make(pp=4), stage_patterns=llama.PP_STAGE_PATTERNS)
+    pp_losses, pp_params = _run_steps(jstep, params, opt.init(params), tokens, targets)
+
+    np.testing.assert_allclose(ref_losses, pp_losses, atol=1e-5, rtol=1e-5)
+    flat_ref, _ = jax.tree_util.tree_flatten(ref_params)
+    flat_pp, _ = jax.tree_util.tree_flatten(pp_params)
+    for r, d in zip(flat_ref, flat_pp):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=2e-5, rtol=1e-3)
+
+
+def test_pipeline_trace_contains_ppermute(eight_devices):
+    from thunder_tpu.distributed import pipeline_parallel
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.stack_layers(llama.init_params(cfg, seed=0))
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 8, 16, seed=0)
+    jstep = pipeline_parallel(_make_pp_step(cfg, opt, 4), MeshSpec.make(pp=4),
+                              stage_patterns=llama.PP_STAGE_PATTERNS)
+    jstep(params, opt.init(params), tokens, targets)
+    src = tt.last_traces(jstep)[0].python()
+    assert "ppermute" in src, "pipeline schedule should rotate activations via ppermute"
+    assert "all_reduce" in src, "replicated embed/head grads should be sum-reduced"
+    assert "axis_index" in src

@@ -31,6 +31,7 @@ class DistParallelType(Enum):
     COLUMN_WISE = "column_wise"  # TP: output-feature sharded
     ROW_WISE = "row_wise"  # TP: input-feature sharded
     EXPERT_SHARDED = "expert_sharded"  # EP: expert dim sharded, grads local
+    PIPELINE_REPLICATED = "pipeline_replicated"  # PP: replicated, grads psum-summed (not averaged)
 
 
 class Variable:

@@ -247,7 +247,8 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
                 # (reference: synchronize in fwd, prims.py:376-419)
                 if (p.distparallel_type in (DistParallelType.FULLY_SHARDED,
                                             DistParallelType.REPLICATED,
-                                            DistParallelType.EXPERT_SHARDED)
+                                            DistParallelType.EXPERT_SHARDED,
+                                            DistParallelType.PIPELINE_REPLICATED)
                         and getattr(p, "dist_axis", None) is not None):
                     from thunder_tpu.distributed import prims as dist_prims
 

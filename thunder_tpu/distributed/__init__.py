@@ -80,6 +80,25 @@ def expert_parallel_ctx(axis: str, size: int):
         _ep_stack.pop()
 
 
+_pp_stack: list = []
+
+
+def current_pp():
+    """Active pipeline-parallel config: (axis, size) or None. When set,
+    ``make_pipeline_loss`` loss functions run the GPipe schedule over the
+    axis (stage-sharded stacked layers, ppermute activation rotation)."""
+    return _pp_stack[-1] if _pp_stack else None
+
+
+@contextmanager
+def pipeline_ctx(axis: str, size: int):
+    _pp_stack.append((axis, size))
+    try:
+        yield
+    finally:
+        _pp_stack.pop()
+
+
 # collective prims (registers eager impls + VJP rules) and the parallelism
 # transforms; imported last to keep the dependency order acyclic
 from thunder_tpu.distributed import prims  # noqa: E402,F401
@@ -89,5 +108,7 @@ from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
     ddp,
     expert_parallel,
     fsdp,
+    pipeline_parallel,
     tensor_parallel,
 )
+from thunder_tpu.distributed.pipeline import make_pipeline_loss  # noqa: E402,F401

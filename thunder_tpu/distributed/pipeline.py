@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style SPMD schedule over a mesh axis.
+
+NEW capability — the reference has **no** pipeline parallelism (SURVEY §2.6:
+"SP/CP/ring-attention/Ulysses, PP, EP — ABSENT"). TPU-native design: no
+point-to-point send/recv runtime — the schedule is an ordinary SPMD trace
+inside ``shard_map``:
+
+- Per-layer ("stage") params are *stacked* on a leading layer dim and sharded
+  across the ``pp`` axis, so each device holds a contiguous chunk of layers.
+- At every tick each device runs its layer chunk on its current activation
+  buffer; activations rotate to the next stage with ``ppermute`` (ICI
+  neighbor exchange — the cheapest possible collective on a TPU torus).
+- Stage 0 injects microbatch ``t`` at tick ``t`` (a ``where`` on
+  ``axis_index``); the last stage computes the loss head for microbatch
+  ``t-(S-1)``, masked elsewhere, and losses are ``psum``-reduced so every
+  device finishes with the identical scalar.
+- The whole schedule is traced, so trace-level autograd differentiates it:
+  the ``ppermute`` VJP rotates cotangents backward (the 1F1B-style reverse
+  flow falls out of the transform — no hand-written backward schedule), and
+  grads of stage-sharded params stay local to the owning device.
+
+Warmup/drain ("bubble") ticks process zero buffers whose results never reach
+a loss term — the alignment ``arrival_tick = inject_tick + (S-1)`` guarantees
+garbage never meets a valid microbatch, so masking is only needed at the two
+ends of the pipe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from thunder_tpu.core.baseutils import check
+
+
+def make_pipeline_loss(embed_fn: Callable, stage_fn: Callable, head_loss_fn: Callable,
+                       *, n_microbatches: int) -> Callable:
+    """Build ``loss_fn(params, tokens, targets)`` running the GPipe schedule.
+
+    - ``embed_fn(params, tokens_mb) -> h``: token embedding (stage-0 work).
+    - ``stage_fn(params, h) -> h``: applies this device's (stacked, locally
+      sharded) layer chunk; reads the chunk length from the local shape.
+    - ``head_loss_fn(params, h, targets_mb) -> scalar``: final norm + LM head
+      + loss (last-stage work).
+
+    Under ``pipeline_parallel`` (``current_pp()`` set) this expands to the
+    SPMD pipeline; on a single device it degrades to sequential microbatching
+    (identical numerics — used by the parity tests).
+    """
+
+    def loss_fn(params, tokens, targets):
+        from thunder_tpu import ops
+        from thunder_tpu.distributed import current_pp
+        from thunder_tpu.distributed import prims as dist_prims
+
+        M = n_microbatches
+        B = tokens.shape[0]
+        check(B % M == 0, lambda: f"batch {B} not divisible by n_microbatches {M}")
+        mb = B // M
+        toks = [tokens[m * mb:(m + 1) * mb] for m in range(M)]
+        tgts = [targets[m * mb:(m + 1) * mb] for m in range(M)]
+
+        pp = current_pp()
+        if pp is None or pp[1] == 1:
+            # degenerate single-stage pipeline: plain microbatch accumulation
+            total = None
+            for m in range(M):
+                l = head_loss_fn(params, stage_fn(params, embed_fn(params, toks[m])), tgts[m])
+                total = l if total is None else ops.add(total, l)
+            return ops.true_divide(total, float(M))
+
+        axis, S = pp
+        idx = dist_prims.axis_index(axis)
+        is_first = ops.eq(idx, 0)
+        is_last = ops.eq(idx, S - 1)
+
+        embeds = [embed_fn(params, toks[m]) for m in range(M)]
+        zero_h = ops.zeros_like(embeds[0])
+        fwd_perm = tuple((s, (s + 1) % S) for s in range(S))
+
+        h = zero_h  # activation buffer rotating through the pipe
+        losses = []
+        for t in range(M + S - 1):
+            inj = embeds[t] if t < M else zero_h
+            h_in = ops.where(is_first, inj, h)
+            h_out = stage_fn(params, h_in)
+            m = t - (S - 1)
+            if 0 <= m < M:
+                l = head_loss_fn(params, h_out, tgts[m])
+                losses.append(ops.where(is_last, l, ops.zeros_like(l)))
+            if t < M + S - 2:  # no rotation needed after the last tick
+                h = dist_prims.wait(dist_prims.ppermute(h_out, axis, fwd_perm))
+
+        total = losses[0]
+        for l in losses[1:]:
+            total = ops.add(total, l)
+        # only the last stage holds real loss terms; psum replicates the total
+        total = dist_prims.wait(dist_prims.all_reduce(total, axis, "sum"))
+        return ops.true_divide(total, float(M))
+
+    return loss_fn

@@ -123,7 +123,8 @@ def _synchronize_meta(a: TensorProxy, axis: str, parallel_type: DistParallelType
     if parallel_type is DistParallelType.FULLY_SHARDED:
         shape = (a.shape[0] * size,) + a.shape[1:]
         return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
-    if parallel_type in (DistParallelType.REPLICATED, DistParallelType.EXPERT_SHARDED):
+    if parallel_type in (DistParallelType.REPLICATED, DistParallelType.EXPERT_SHARDED,
+                         DistParallelType.PIPELINE_REPLICATED):
         return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
     raise NotImplementedError(f"synchronize for {parallel_type}")
 
@@ -245,6 +246,11 @@ def _synchronize_vjp(a, axis, parallel_type, size):
             # arrive via the backward all_to_all); only the data-parallel
             # mean scaling is needed — no collective
             return [(a, ops.true_divide(g, float(size)))]
+        if parallel_type is DistParallelType.PIPELINE_REPLICATED:
+            # pipeline stages each hold the TRUE partial grad (nonzero only on
+            # the stage that computes with the param: embed on stage 0, head on
+            # the last stage); the sum — not the mean — is the full grad
+            return [(a, wait(all_reduce(g, axis, "sum")))]
         # DDP: grads averaged across replicas
         gr = wait(all_reduce(g, axis, "sum"))
         return [(a, ops.true_divide(gr, float(size)))]

@@ -66,11 +66,12 @@ class LeafPlan:
 class DistributedFunction(ThunderTPUFunction):
     def __init__(self, fn, mesh_spec: MeshSpec, *, mode: str, axis: str,
                  params_argnums: Sequence[int] = (0,), column_patterns=(), row_patterns=(),
-                 expert_patterns=(), shard_data: bool = True,
+                 expert_patterns=(), stage_patterns=(), shard_data: bool = True,
                  data_argnums: Sequence[int] | None = None,
                  zero: int = 3, **jit_kwargs):
         self.data_argnums = tuple(data_argnums) if data_argnums is not None else None
         self.expert_re = re.compile("|".join(expert_patterns)) if expert_patterns else None
+        self.stage_re = re.compile("|".join(stage_patterns)) if stage_patterns else None
         self.mesh_spec = mesh_spec
         self.axis = axis
         self.size = dict(zip(mesh_spec.axis_names, mesh_spec.axis_sizes))[axis]
@@ -163,6 +164,23 @@ class DistributedFunction(ThunderTPUFunction):
                 else:
                     plans.append(LeafPlan("replicate", _P()))
                 continue
+            if self.mode == "pp":
+                # stacked per-layer params (and their optimizer state, whose
+                # pytree paths mirror the param names) shard the layer dim;
+                # each device owns its layer chunk — grads stay local
+                if self.stage_re is not None and self.stage_re.search(pathstr) \
+                        and len(shape) >= 1 and shape[0] % n == 0:
+                    plans.append(LeafPlan("stage_shard", _P(self.axis),
+                                          DistParallelType.NONE, 0))
+                    continue
+                if in_params:
+                    # embed/head/final-norm params: replicated; each stage
+                    # holds the true partial grad, summed by the synchronize VJP
+                    plans.append(LeafPlan("pp_param", _P(),
+                                          DistParallelType.PIPELINE_REPLICATED))
+                    continue
+                plans.append(LeafPlan("replicate", _P()))
+                continue
             if self.mode in ("ddp", "cp") and in_params:
                 plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
                 continue
@@ -211,6 +229,11 @@ class DistributedFunction(ThunderTPUFunction):
             from thunder_tpu.distributed import expert_parallel_ctx
 
             with expert_parallel_ctx(self.axis, self.size):
+                return super()._compile(flat, treedef, args, kwargs)
+        if self.mode == "pp":
+            from thunder_tpu.distributed import pipeline_ctx
+
+            with pipeline_ctx(self.axis, self.size):
                 return super()._compile(flat, treedef, args, kwargs)
         return super()._compile(flat, treedef, args, kwargs)
 
@@ -328,6 +351,22 @@ def context_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "sp",
     online-softmax merges); params replicate with all-reduced grads."""
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="cp", axis=axis,
+                               params_argnums=params_argnums, **jit_kwargs)
+
+
+def pipeline_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "pp",
+                      stage_patterns: Sequence[str] = (), params_argnums: Sequence[int] = (0,),
+                      **jit_kwargs) -> DistributedFunction:
+    """Pipeline parallelism (NEW capability — absent from the reference,
+    SURVEY §2.6). Stacked per-layer params matching ``stage_patterns`` shard
+    their leading layer dim across ``axis`` (one layer chunk per device); the
+    train step's loss must be built with
+    ``thunder_tpu.distributed.pipeline.make_pipeline_loss``, which expands to
+    the GPipe microbatch schedule with ``ppermute`` activation rotation.
+    Non-stage params replicate with sum-synchronized grads."""
+    mesh_spec = mesh_spec or _default_mesh_spec(axis)
+    return DistributedFunction(fn, mesh_spec, mode="pp", axis=axis,
+                               stage_patterns=stage_patterns,
                                params_argnums=params_argnums, **jit_kwargs)
 
 

@@ -122,6 +122,38 @@ def _apply_rope(x, cos, sin):
     return ops.cat([rx1, rx2], -1)
 
 
+def _block(h, layer, cfg: LlamaConfig, cos, sin):
+    """One decoder layer: RMSNorm → GQA attention → RMSNorm → SwiGLU MLP."""
+    B, T = h.shape[0], h.shape[1]
+    n_rep = cfg.n_heads // cfg.kv_heads
+    hd = cfg.head_dim
+
+    x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+    q = ops.linear(x, layer["wq"])  # (B, T, D)
+    k = ops.linear(x, layer["wk"])  # (B, T, kv_dim)
+    v = ops.linear(x, layer["wv"])
+    q = ops.transpose(ops.reshape(q, (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
+    k = ops.transpose(ops.reshape(k, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+    v = ops.transpose(ops.reshape(v, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    if n_rep > 1:  # GQA: repeat kv heads
+        k = ops.reshape(ops.expand(ops.unsqueeze(k, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                        (B, cfg.n_heads, T, hd))
+        v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                        (B, cfg.n_heads, T, hd))
+    attn = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
+    # width is n_heads*hd (== dim/tp_size under tensor parallelism)
+    attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
+    h = ops.add(h, ops.linear(attn, layer["wo"]))
+
+    # SwiGLU MLP block
+    x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
+    gate = ops.silu(ops.linear(x, layer["w_gate"]))
+    up = ops.linear(x, layer["w_up"])
+    return ops.add(h, ops.linear(ops.mul(gate, up), layer["w_down"]))
+
+
 def forward(params, tokens, cfg: LlamaConfig):
     """tokens: (B, T) int32 -> logits (B, T, vocab)."""
     B, T = tokens.shape
@@ -139,31 +171,7 @@ def forward(params, tokens, cfg: LlamaConfig):
     hd = cfg.head_dim
 
     for layer in params["layers"]:
-        # attention block
-        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
-        q = ops.linear(x, layer["wq"])  # (B, T, D)
-        k = ops.linear(x, layer["wk"])  # (B, T, kv_dim)
-        v = ops.linear(x, layer["wv"])
-        q = ops.transpose(ops.reshape(q, (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
-        k = ops.transpose(ops.reshape(k, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
-        v = ops.transpose(ops.reshape(v, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
-        if n_rep > 1:  # GQA: repeat kv heads
-            k = ops.reshape(ops.expand(ops.unsqueeze(k, 2), (B, cfg.kv_heads, n_rep, T, hd)),
-                            (B, cfg.n_heads, T, hd))
-            v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, cfg.kv_heads, n_rep, T, hd)),
-                            (B, cfg.n_heads, T, hd))
-        attn = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
-        # width is n_heads*hd (== dim/tp_size under tensor parallelism)
-        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
-        h = ops.add(h, ops.linear(attn, layer["wo"]))
-
-        # SwiGLU MLP block
-        x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
-        gate = ops.silu(ops.linear(x, layer["w_gate"]))
-        up = ops.linear(x, layer["w_up"])
-        h = ops.add(h, ops.linear(ops.mul(gate, up), layer["w_down"]))
+        h = _block(h, layer, cfg, cos, sin)
 
     h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
     logits = ops.linear(h, params["lm_head"])
@@ -175,6 +183,49 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     B, T, V = logits.shape
     logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
     return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
+
+
+def stack_layers(params):
+    """Convert the per-layer list-of-dicts into stacked arrays with a leading
+    layer dim — the layout pipeline parallelism shards across the ``pp``
+    axis (each device receives a contiguous layer chunk)."""
+    import jax.numpy as jnp
+
+    stacked = dict(params)
+    layers = params["layers"]
+    stacked["layers"] = {k: jnp.stack([l[k] for l in layers]) for k in layers[0]}
+    return stacked
+
+
+def pipeline_fns(cfg: LlamaConfig):
+    """(embed_fn, stage_fn, head_loss_fn) for
+    ``thunder_tpu.distributed.make_pipeline_loss``. ``stage_fn`` reads its
+    layer-chunk length from the local (sharded) stacked shape, so the same
+    trace works for any pp degree."""
+
+    def embed_fn(params, tokens):
+        return ops.embedding(tokens, params["tok_embedding"])
+
+    def stage_fn(params, h):
+        T = h.shape[1]
+        cos, sin = _rope_cos_sin(cfg, T, h.dtype)
+        n_local = params["layers"]["attn_norm"].shape[0]
+        for i in range(n_local):
+            layer = {k: v[i] for k, v in params["layers"].items()}
+            h = _block(h, layer, cfg, cos, sin)
+        return h
+
+    def head_loss_fn(params, h, targets):
+        h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+        logits = ops.linear(h, params["lm_head"])
+        B, T, V = logits.shape
+        logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
+        return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
+
+    return embed_fn, stage_fn, head_loss_fn
+
+
+PP_STAGE_PATTERNS = (r"\['layers'\]",)
 
 
 def tp_config(cfg: LlamaConfig, tp_size: int) -> LlamaConfig:
