@@ -1,0 +1,172 @@
+"""Rematerialization tests: min-cut saved-for-backward optimization and
+trace-level activation checkpointing.
+
+Reference parity: ``thunder/tests/test_nvfuser_remat.py`` (the reference's
+remat tests are nvFuser-bound; these are IR-level and run on CPU).
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+from thunder_tpu.core import prims as P
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.rematerialization import (
+    checkpoint,
+    find_cut,
+    rematerialize_forward_and_backward,
+)
+from thunder_tpu.core.trace import TraceCtx, tracectx
+from thunder_tpu.core.transforms import forward_and_backward_from_trace
+from thunder_tpu.executors import resolve_executors
+from thunder_tpu.executors.passes import transform_for_execution
+
+
+def _split_exec(trc):
+    fwd, bwd, saved = forward_and_backward_from_trace(trc)
+    return fwd, bwd, saved
+
+
+def _build_mlp_trace():
+    trc = TraceCtx("computation")
+    with tracectx(trc):
+        x = TensorProxy("x", shape=(4, 16), dtype=dtypes.float32)
+        w = TensorProxy("w", shape=(16, 16), dtype=dtypes.float32)
+        h = ops.tanh(ops.matmul(x, w))
+        y = ops.sum(ops.mul(h, h))
+        P.python_return(y)
+    trc.args = [x, w]
+    trc.output = y
+    return trc, x, w
+
+
+def test_min_cut_prefers_cheap_recompute():
+    """Elementwise chains recompute from inputs; the matmul output is saved
+    (recompute forbidden for MXU-heavy ops)."""
+    trc, x, w = _build_mlp_trace()
+    fwd, bwd, saved = _split_exec(trc)
+    nf, nb = rematerialize_forward_and_backward(fwd, bwd)
+    new_saved = nf.output[1]
+    old_bytes = sum(np.prod(s.shape) * s.dtype.bytes for s in saved)
+    new_bytes = sum(np.prod(s.shape) * s.dtype.bytes for s in new_saved)
+    assert new_bytes <= old_bytes
+    # inputs are free sources, so they shouldn't count as expensive saves;
+    # at minimum the tanh output (recomputable) is no longer saved
+    names = {p.name for p in new_saved}
+    assert len(names) <= len({p.name for p in saved})
+
+
+def test_remat_split_matches_unrematerialized():
+    trc, _, _ = _build_mlp_trace()
+    fwd, bwd, _ = _split_exec(trc)
+    nf, nb = rematerialize_forward_and_backward(fwd, bwd)
+
+    exes = resolve_executors(None)
+    x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+    w = np.random.RandomState(3).randn(16, 16).astype(np.float32)
+
+    f0 = transform_for_execution(fwd, exes).python_callable()
+    b0 = transform_for_execution(bwd, exes).python_callable()
+    f1 = transform_for_execution(nf, exes).python_callable()
+    b1 = transform_for_execution(nb, exes).python_callable()
+
+    out0, sv0 = f0(x, w)
+    out1, sv1 = f1(x, w)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1), rtol=1e-6)
+    ct = np.float32(1.0)
+    g0 = b0(*sv0, ct)
+    g1 = b1(*sv1, ct)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_find_cut_saves_expensive_outputs():
+    trc, x, w = _build_mlp_trace()
+    fwd, bwd, saved = _split_exec(trc)
+    required = [p for p in bwd.args if p.name in {s.name for s in saved}]
+    cut = find_cut(fwd, required)
+    # the dot_general output must be saved or substituted by something
+    # downstream of it — never recomputed; inputs may appear (free)
+    assert isinstance(cut, set) and len(cut) >= 1
+
+
+def test_checkpoint_matches_plain_and_recomputes():
+    W1 = np.random.RandomState(0).randn(16, 16).astype(np.float32)
+    W2 = np.random.RandomState(1).randn(16, 16).astype(np.float32)
+    x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+
+    def block(x, w1, w2):
+        return ops.linear(ops.tanh(ops.linear(x, w1)), w2)
+
+    def make(lossfn):
+        def f(x, w1, w2):
+            return tt.value_and_grad(lambda ws: lossfn(x, ws[0], ws[1]))((w1, w2))
+        return tt.jit(f)
+
+    plain = make(lambda x, a, b: ops.sum(ops.sigmoid(block(x, a, b))))
+    ck = make(lambda x, a, b: ops.sum(ops.sigmoid(checkpoint(block)(x, a, b))))
+
+    l0, g0 = plain(x, W1, W2)
+    l1, g1 = ck(x, W1, W2)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+    src = tt.last_traces(ck)[0].python()
+    # forward appears as one opaque checkpoint region; the recompute emits
+    # the region's ops again at top level (dot_general + tanh)
+    assert "checkpoint(" in src
+    assert src.count("tanh(") >= 1 and "dot_general(" in src
+
+
+def test_checkpoint_per_layer_llama():
+    """checkpoint() composes with a real model block and the traced optimizer."""
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import SGD
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    opt = SGD(lr=1e-2)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+
+    def loss_ckpt(p):
+        B, T = tokens.shape
+        h = ops.embedding(tokens, p["tok_embedding"])
+        cos, sin = llama._rope_cos_sin(cfg, T, h.dtype)
+        for layer in p["layers"]:
+            h = checkpoint(lambda h_, *ws: llama._block(
+                h_, dict(zip(sorted(layer), ws)), cfg, cos, sin))(
+                    h, *[layer[k] for k in sorted(layer)])
+        h = ops.rms_norm(h, p["norm_f"], eps=cfg.norm_eps)
+        logits = ops.linear(h, p["lm_head"])
+        BT = B * T
+        return ops.cross_entropy(
+            ops.convert_element_type(ops.reshape(logits, (BT, logits.shape[-1])), dtypes.float32),
+            ops.reshape(targets, (BT,)))
+
+    def step(params, opt_state):
+        loss, grads = tt.value_and_grad(loss_ckpt)(params)
+        new_p, new_s = opt.update(params, grads, opt_state)
+        return loss, new_p, new_s
+
+    ref = tt.jit(lambda p, s: _plain_step(p, s, cfg, opt, tokens, targets))
+    l_ref, p_ref, _ = ref(params, opt.init(params))
+    jstep = tt.jit(step)
+    l_ck, p_ck, _ = jstep(params, opt.init(params))
+    np.testing.assert_allclose(np.asarray(l_ref), np.asarray(l_ck), rtol=1e-5)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref), jax.tree_util.tree_leaves(p_ck)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+
+def _plain_step(params, opt_state, cfg, opt, tokens, targets):
+    from thunder_tpu.models import llama
+
+    loss, grads = tt.value_and_grad(lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+    new_p, new_s = opt.update(params, grads, opt_state)
+    return loss, new_p, new_s

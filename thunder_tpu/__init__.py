@@ -35,6 +35,10 @@ from thunder_tpu.core.transforms import (
     jvp_call,
     vmap_call,
 )
+from thunder_tpu.core.rematerialization import (
+    checkpoint,
+    rematerialize_forward_and_backward,
+)
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,275 @@
+"""Rematerialization: recompute-vs-save optimization for backward passes.
+
+Reference parity: ``thunder/core/rematerialization.py`` — min-cut (max-flow)
+choice of saved-for-backward between the forward and backward traces
+(``find_cut`` :233, ``rematerialize_forward_and_backward`` :572) — rebuilt
+for this IR, plus a capability the reference lacks entirely (SURVEY §2.2):
+**activation checkpointing** as a trace-level transform (``checkpoint``),
+where the pullback re-traces the forward region so the backward recomputes
+activations instead of saving them (keyed functional RNG makes random ops
+recompute deterministically — the reference's ``replace_uniform`` philox
+trick :659 falls out for free).
+
+TPU note: when the whole train step compiles into one XLA program
+(``inline_value_and_grad``), XLA's scheduler already fuses and the explicit
+``checkpoint`` regions bound peak HBM; the min-cut pass matters for the
+torch-style split path where fwd/bwd are separate programs and the saved
+list is a real host-visible tensor transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from thunder_tpu.core import prims
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import NumberProxy, Proxy, TensorProxy, Variable
+from thunder_tpu.core.pytree import tree_flatten
+from thunder_tpu.core.symbol import BoundSymbol, Symbol
+from thunder_tpu.core.trace import TraceCtx, from_trace, get_tracectx, tracectx
+
+_SKIP_IDS = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL)
+
+# ops whose recomputation in backward is forbidden: the MXU-heavy ops where
+# recompute costs real FLOPs (the min-cut must save their outputs or
+# something cheaper downstream). Keyed RNG ops recompute deterministically,
+# so they are NOT in this set.
+_EXPENSIVE_IDS = {
+    PrimIDs.DOT_GENERAL, PrimIDs.CONVOLUTION,
+}
+_EXPENSIVE_NAMES = {"matmul", "linear", "conv1d", "conv2d",
+                    "scaled_dot_product_attention", "cross_entropy"}
+
+
+def _is_expensive(bsym: BoundSymbol) -> bool:
+    if bsym.sym.id in _EXPENSIVE_IDS or bsym.sym.name in _EXPENSIVE_NAMES:
+        return True
+    if OpTags.COLLECTIVE_OP in bsym.sym.tags:
+        return True
+    # composites containing expensive subsymbols are expensive to recompute
+    return any(_is_expensive(s) for s in bsym.subsymbols)
+
+
+def _save_cost(p: Proxy) -> float:
+    if isinstance(p, TensorProxy):
+        numel = 1
+        for d in p.shape:
+            numel *= int(d)
+        return float(max(numel, 1)) * p.dtype.bytes
+    return 1e-6  # numbers/strings are free to save
+
+
+def find_cut(fwd: TraceCtx, required: list[Proxy]) -> set[str]:
+    """Min-cut over the forward dataflow graph between the trace inputs
+    (free sources — params/inputs stay alive through backward anyway) and
+    the values the backward requires. Returns names of proxies to SAVE;
+    everything else the backward recomputes from them.
+
+    Reference: ``find_cut`` (``thunder/core/rematerialization.py:233``,
+    networkx max-flow); same formulation — node-split capacities = tensor
+    bytes, ∞ dataflow edges, ∞ source edges into unrecomputable outputs.
+    """
+    import networkx as nx
+
+    INF = float("inf")
+    g = nx.DiGraph()
+    arg_names = {p.name for p in fwd.args if isinstance(p, Proxy)}
+
+    def n_in(name):
+        return ("in", name)
+
+    def n_out(name):
+        return ("out", name)
+
+    produced: dict[str, BoundSymbol] = {}
+    for bsym in fwd.bound_symbols:
+        if bsym.sym.id in _SKIP_IDS:
+            continue
+        for o in bsym.flat_proxy_outs():
+            produced[o.name] = bsym
+
+    # node-split every relevant proxy: cutting (in->out) == saving it
+    def add_proxy(p: Proxy, free: bool = False):
+        cap = 1e-6 if free else _save_cost(p)
+        g.add_edge(n_in(p.name), n_out(p.name), capacity=cap)
+
+    for p in fwd.args:
+        if isinstance(p, Proxy):
+            add_proxy(p, free=True)
+            g.add_edge("SRC", n_in(p.name), capacity=INF)
+
+    for bsym in fwd.bound_symbols:
+        if bsym.sym.id in _SKIP_IDS:
+            continue
+        expensive = _is_expensive(bsym)
+        for o in bsym.flat_proxy_outs():
+            add_proxy(o)
+            if expensive:
+                # not recomputable: the cut must fall at o or downstream
+                g.add_edge("SRC", n_in(o.name), capacity=INF)
+            for a in bsym.flat_proxy_args():
+                if a.name in produced or a.name in arg_names:
+                    g.add_edge(n_out(a.name), n_in(o.name), capacity=INF)
+
+    for r in required:
+        if isinstance(r, Proxy) and (r.name in produced or r.name in arg_names):
+            g.add_edge(n_out(r.name), "SNK", capacity=INF)
+
+    if "SRC" not in g or "SNK" not in g or not nx.has_path(g, "SRC", "SNK"):
+        return {r.name for r in required if isinstance(r, Proxy)}
+
+    _, (src_side, _snk_side) = nx.minimum_cut(g, "SRC", "SNK")
+    saved: set[str] = set()
+    for name in {n[1] for n in g.nodes if isinstance(n, tuple)}:
+        if n_in(name) in src_side and n_out(name) not in src_side:
+            saved.add(name)
+    return saved
+
+
+def rematerialize_forward_and_backward(fwd: TraceCtx, bwd: TraceCtx) -> tuple[TraceCtx, TraceCtx]:
+    """Jointly minimize saved-for-backward bytes: run ``find_cut``, shrink
+    the forward's saved list to the cut, and prepend recompute bound symbols
+    to the backward (reference ``rematerialize_forward_and_backward``
+    ``thunder/core/rematerialization.py:572``)."""
+    from thunder_tpu.core.transform_common import dce
+
+    # current contract: fwd returns (out, saved); bwd.args = saved + cotangents
+    out, old_saved = fwd.output
+    old_saved_names = {p.name for p in old_saved if isinstance(p, Proxy)}
+    cotangents = [p for p in bwd.args if p.name not in old_saved_names]
+    required = [p for p in bwd.args if p.name in old_saved_names]
+
+    saved_names = find_cut(fwd, required)
+    produced: dict[str, BoundSymbol] = {}
+    for bsym in fwd.bound_symbols:
+        if bsym.sym.id in _SKIP_IDS:
+            continue
+        for o in bsym.flat_proxy_outs():
+            produced[o.name] = bsym
+
+    name_to_proxy: dict[str, Proxy] = {}
+    for bsym in fwd.bound_symbols:
+        for o in bsym.flat_proxy_outs():
+            name_to_proxy[o.name] = o
+    for p in fwd.args:
+        if isinstance(p, Proxy):
+            name_to_proxy[p.name] = p
+
+    new_saved = [name_to_proxy[n] for n in sorted(saved_names) if n in name_to_proxy]
+
+    # --- recompute plan: emit producers (in fwd order) for every required
+    # value not saved, transitively ---------------------------------------
+    needed_bsyms: list[BoundSymbol] = []
+    have = set(saved_names)
+    want = [r.name for r in required if r.name not in have]
+    visiting: set[str] = set()
+
+    def resolve(name: str):
+        if name in have or name in visiting:
+            return
+        visiting.add(name)
+        bsym = produced.get(name)
+        check(bsym is not None, lambda: f"remat: {name} has no producer and is not saved")
+        for a in bsym.flat_proxy_args():
+            if a.name not in have:
+                resolve(a.name)
+        if name not in have:
+            needed_bsyms.append(bsym)
+            for o in bsym.flat_proxy_outs():
+                have.add(o.name)
+
+    for w in want:
+        resolve(w)
+
+    # --- rebuild forward: same compute, smaller return --------------------
+    new_fwd = from_trace(fwd)
+    new_fwd.bound_symbols = [b for b in fwd.bound_symbols if b.sym.id is not PrimIDs.PYTHON_RETURN]
+    ret = prims.python_return.bind((out, tuple(new_saved)), output=None)
+    new_fwd.bound_symbols.append(ret)
+    new_fwd.output = (out, tuple(new_saved))
+    new_fwd = dce(new_fwd)
+    new_fwd.set_provenance("Augmented forward (rematerialized)")
+
+    # --- rebuild backward: recompute prologue + original body -------------
+    new_bwd = from_trace(bwd)
+    new_bwd.args = list(new_saved) + list(cotangents)
+    new_bwd.bound_symbols = [b.from_bsym() for b in needed_bsyms] + list(bwd.bound_symbols)
+    new_bwd.output = bwd.output
+    new_bwd.set_provenance("Backward (rematerialized)")
+    return new_fwd, new_bwd
+
+
+# ---------------------------------------------------------------------------
+# activation checkpointing (NEW capability — absent upstream, SURVEY §2.2)
+# ---------------------------------------------------------------------------
+
+_ckpt_counter = 0
+
+
+def checkpoint(fn):
+    """Activation checkpointing as a trace transform: ``checkpoint(fn)``
+    called inside traced code runs ``fn`` normally in the forward, but its
+    VJP *re-traces the forward region* inside the backward, so intermediates
+    inside ``fn`` are recomputed rather than saved. Saves exactly the
+    region's inputs. Works in both autograd modes (inline whole-step and
+    torch-style fwd/bwd split)."""
+    from thunder_tpu.core.transforms import (
+        _env_map, _trace_subfn, augmented_forward, backward_pass, register_vjp,
+    )
+
+    def wrapped(*args):
+        global _ckpt_counter
+
+        check(get_tracectx() is not None,
+              "checkpoint(fn) must be called inside traced code (under thunder_tpu.jit)")
+        inner, inner_inputs, _ = _trace_subfn(fn, args, {})
+        # closure-captured outer proxies (e.g. precomputed rope tables) become
+        # explicit region inputs, so dataflow (DCE, saved-set analysis) sees them
+        from thunder_tpu.core.utils import free_vars
+
+        input_set = {Variable(p) for p in inner_inputs}
+        frees = [v.proxy for v in free_vars(inner.bound_symbols) if v not in input_set]
+        inner_inputs = list(inner_inputs) + frees
+        inner.args = inner_inputs
+        sid = f"checkpoint_{_ckpt_counter}"
+        _ckpt_counter += 1
+
+        def meta(*ps):
+            from thunder_tpu.core.transforms import eval_trace
+
+            return eval_trace(inner, *[p for p in ps])
+
+        sym = Symbol("checkpoint", meta, id=sid)
+
+        @register_vjp(sid)
+        def _ckpt_vjp(*rargs):
+            out = sym(*rargs)
+
+            def pullback(g):
+                # recompute: replay the region's forward collecting pullbacks
+                env: dict = {}
+                for p, leaf in zip(inner_inputs, rargs):
+                    env[Variable(p)] = leaf
+                records = augmented_forward(inner.bound_symbols, env)
+                re_out = _env_map(env, inner.output)
+                out_flat = [o for o in tree_flatten(re_out)[0]
+                            if isinstance(o, TensorProxy) and o.dtype.is_inexact]
+                g_flat = list(g) if isinstance(g, (tuple, list)) else [g]
+                grads: dict[Variable, Any] = {}
+                for o, ct in zip(out_flat, g_flat):
+                    if ct is not None:
+                        grads[Variable(o)] = ct
+                backward_pass(records, grads)
+                return [(leaf, grads.get(Variable(leaf)))
+                        for leaf in rargs if isinstance(leaf, TensorProxy)]
+
+            return out, pullback
+
+        # emit the composite (subsymbols = the region's ops via eval_trace);
+        # only proxy leaves are symbol args — constants are baked into the
+        # inner trace
+        proxy_args = [a for a in tree_flatten(args)[0] if isinstance(a, Proxy)] + frees
+        return sym(*proxy_args)
+
+    return wrapped
