@@ -126,6 +126,125 @@ def _sdpa_checker(q, k, v, is_causal=False, scale=None):
 
 
 # ---------------------------------------------------------------------------
+# flash attention backward (dq kernel + dkv kernel; probs never materialized
+# outside a VMEM tile — the sdpaex/cudnnex backward analog,
+# reference thunder/executors/sdpaex.py:312, cudnnex.py:721)
+# ---------------------------------------------------------------------------
+
+def _sdpa_dq_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dq_ref,
+                    *, scale: float, causal: bool, bq: int):
+    qi = pl.program_id(1)
+    g = g_ref[0].astype(jnp.float32)      # (bq, hd)
+    q = q_ref[0].astype(jnp.float32)      # (bq, hd)
+    k = k_ref[0].astype(jnp.float32)      # (S, hd)
+    v = v_ref[0].astype(jnp.float32)      # (S, hd)
+    o = o_ref[0].astype(jnp.float32)      # (bq, hd)
+    lse = lse_ref[0].astype(jnp.float32)  # (bq,)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # (bq, S)
+    if causal:
+        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(row >= col, s, -jnp.inf)
+    p = jnp.exp(s - lse[:, None])                       # (bq, S)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bq, S)
+    delta = jnp.sum(g * o, axis=-1, keepdims=True)      # (bq, 1)
+    ds = p * (dp - delta) * scale
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _sdpa_dkv_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dk_ref, dv_ref,
+                     *, scale: float, causal: bool, bk: int):
+    kj = pl.program_id(1)
+    g = g_ref[0].astype(jnp.float32)      # (T, hd)
+    q = q_ref[0].astype(jnp.float32)      # (T, hd)
+    k = k_ref[0].astype(jnp.float32)      # (bk, hd)
+    v = v_ref[0].astype(jnp.float32)      # (bk, hd)
+    o = o_ref[0].astype(jnp.float32)      # (T, hd)
+    lse = lse_ref[0].astype(jnp.float32)  # (T,)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # (T, bk)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(row >= col, s, -jnp.inf)
+    p = jnp.exp(s - lse[:, None])                       # (T, bk)
+    dv = jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bk, hd)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (T, bk)
+    delta = jnp.sum(g * o, axis=-1, keepdims=True)      # (T, 1)
+    ds = p * (dp - delta) * scale                       # (T, bk)
+    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bk, hd)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
+    orig_shape = q.shape
+    T, hd = q.shape[-2], q.shape[-1]
+    S = k.shape[-2]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(hd)
+    bh = int(functools.reduce(lambda a, b: a * b, q.shape[:-2], 1))
+    g3 = g.reshape(bh, T, hd)
+    q3 = q.reshape(bh, T, hd)
+    k3 = k.reshape(bh, S, hd)
+    v3 = v.reshape(bh, S, hd)
+    o3 = out.reshape(bh, T, hd)
+    lse3 = lse.reshape(bh, T)
+    bq = T if T <= 256 else max(b for b in (256, 128, 64) if T % b == 0)
+    bk = S if S <= 256 else max(b for b in (256, 128, 64) if S % b == 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_sdpa_dq_kernel, scale=scale_v, causal=bool(is_causal), bq=bq),
+        grid=(bh, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, T, hd), q.dtype),
+        interpret=_interpret(),
+    )(g3, q3, k3, v3, o3, lse3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_sdpa_dkv_kernel, scale=scale_v, causal=bool(is_causal), bk=bk),
+        grid=(bh, S // bk),
+        in_specs=[
+            pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, S, hd), k.dtype),
+            jax.ShapeDtypeStruct((bh, S, hd), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(g3, q3, k3, v3, o3, lse3)
+
+    return (dq.reshape(orig_shape), dk.reshape(k.shape), dv.reshape(v.shape))
+
+
+def _sdpa_bwd_checker(g, q, k, v, out, lse, is_causal=False, scale=None):
+    return _sdpa_checker(q, k, v, is_causal, scale)
+
+
+# ---------------------------------------------------------------------------
 # fused cross-entropy forward
 # ---------------------------------------------------------------------------
 
@@ -237,14 +356,17 @@ def _rms_checker(a, weight=None, eps=1e-5, dim=-1):
 
 if PALLAS_AVAILABLE:
     _sdpa_sym = get_op("nn.sdpa_fwd")
+    _sdpa_bwd_sym = get_op("nn.sdpa_bwd")
     _ce_sym = get_op("nn.ce_fwd")
     _rms_sym = get_op("nn.rms_norm")
 
     sdpa_fwd_op = ex.register_operator("sdpa_fwd", meta=_sdpa_sym.meta, fn=pallas_sdpa_fwd)
+    sdpa_bwd_op = ex.register_operator("sdpa_bwd", meta=_sdpa_bwd_sym.meta, fn=pallas_sdpa_bwd)
     ce_fwd_op = ex.register_operator("ce_fwd", meta=_ce_sym.meta, fn=pallas_ce_fwd)
     rms_norm_op = ex.register_operator("rms_norm", meta=_rms_sym.meta, fn=pallas_rms_norm)
 
     ex.register_implementation("nn.sdpa_fwd", sdpa_fwd_op, checker=_sdpa_checker)
+    ex.register_implementation("nn.sdpa_bwd", sdpa_bwd_op, checker=_sdpa_bwd_checker)
     ex.register_implementation("nn.ce_fwd", ce_fwd_op, checker=_ce_checker)
     ex.register_implementation("nn.rms_norm", rms_norm_op, checker=_rms_checker)
 

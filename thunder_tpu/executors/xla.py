@@ -84,6 +84,11 @@ class XLAFusionExecutor(FusionExecutor):
             return False
         if OpTags.DEVICE_SYNC_OP in bsym.sym.tags:
             return False
+        # ops claimed by another executor (e.g. Pallas kernels) stay out of
+        # fusion regions, exactly like cudnn-claimed ops stay outside nvFuser
+        # regions in the reference (thunder/executors/passes.py:136 ordering)
+        if bsym.sym.executor is not None and bsym.sym.executor is not self:
+            return False
         if bsym.sym.python_impl is not None:
             return True
         from thunder_tpu.executors.eagerjax import get_eager_impl

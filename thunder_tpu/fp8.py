@@ -1,0 +1,175 @@
+"""FP8 mixed precision with delayed scaling — the TransformerEngine analog.
+
+Reference parity: ``thunder/executors/transformer_engineex.py`` — there,
+``prims.linear`` is swapped for ``te_linear`` under fp8 autocast and the
+mutable amax/scale state is synchronized by a pass stitched into the
+backward trace (``_transformer_engine_bwd_fp8_meta_sync`` :585). TPU-first
+re-design: **the fp8 state is explicit and functional** — a pytree the user
+threads through the train step exactly like optimizer state, so the whole
+step (including the delayed-scaling update) compiles into one XLA program
+and sharding transforms see the state like any other input.
+
+Usage::
+
+    import thunder_tpu as tt
+    from thunder_tpu import fp8
+
+    state = fp8.init_state(n_slots=fp8.count_linears(loss_fn, params, batch))
+
+    def train_step(params, opt_state, fp8_state, tokens, targets):
+        with fp8.autocast(fp8_state) as ctx:
+            loss, grads = tt.value_and_grad(lambda p: loss_fn(p, tokens, targets))(params)
+        new_params, new_opt = opt.update(params, grads, opt_state)
+        return loss, new_params, new_opt, ctx.updated_state()
+
+With ``state=None`` (or plain ``fp8.autocast()``), scaling is just-in-time
+(per-tensor amax computed in-graph) — no state to thread, slightly more
+compute. Delayed scaling uses the rolling amax-history maximum, matching
+TE's recipe (history window, margin).
+
+Quantization recipe (TE default): activations/weights in e4m3 (max 448),
+gradients in e5m2 (max 57344), compute in f32 accumulation via
+``dot_general(..., preferred_element_type=f32)`` — on fp8-capable TPUs XLA
+maps this onto native fp8 MXU ops; elsewhere it upcasts (storage stays fp8,
+halving HBM traffic for weights/activations).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.proxies import TensorProxy
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+_fp8_stack: list = []
+
+
+def current_fp8():
+    return _fp8_stack[-1] if _fp8_stack else None
+
+
+def init_state(n_slots: int, history: int = 16, amax_init: float = 1.0):
+    """Per-linear-slot rolling amax history for activations and weights."""
+    import jax.numpy as jnp
+
+    return {
+        "x_hist": jnp.full((n_slots, history), amax_init, jnp.float32),
+        "w_hist": jnp.full((n_slots, history), amax_init, jnp.float32),
+    }
+
+
+def count_linears(fn, *args, **kwargs) -> int:
+    """Trace ``fn`` once (throwaway) counting fp8-eligible linears."""
+    import thunder_tpu as tt
+
+    class _Counter(autocast):
+        def __init__(self):
+            super().__init__(None)
+            self.count = 0
+
+        def linear(self, a, w, bias):
+            self.count += 1
+            from thunder_tpu import ops
+
+            out = ops.prims.dot_general(a, w, contract_dims=((a.ndim - 1,), (1,)))
+            return out if bias is None else ops.add(out, bias)
+
+    ctr = _Counter()
+    _fp8_stack.append(ctr)
+    try:
+        tt.jit(fn, cache="no caching")(*args, **kwargs)
+    finally:
+        _fp8_stack.pop()
+    return ctr.count
+
+
+class autocast:
+    """Trace-time context: while active, eligible ``ops.linear`` calls lower
+    to fp8 quantize → dot_general → dequantize with delayed (or JIT)
+    scaling, and per-slot amaxes are collected for the state update."""
+
+    def __init__(self, state: dict | None = None, *, margin: float = 0.0,
+                 min_dim_multiple: int = 8):
+        self.state = state
+        self.margin = margin
+        self.min_dim_multiple = min_dim_multiple
+        self._slot = 0
+        self._amaxes: dict[int, tuple] = {}  # slot -> (amax_x, amax_w); last write wins
+
+    def _record(self, slot: int, amax_x, amax_w) -> None:
+        """Called from the ``nn.fp8_linear`` meta on every (re)trace, so the
+        recorded amax proxies are always the live ones (autograd replay /
+        checkpoint recompute re-emit the composite with fresh proxies)."""
+        self._amaxes[slot] = (amax_x, amax_w)
+
+    # -- context -----------------------------------------------------------
+    def __enter__(self):
+        self._slot = 0
+        self._amaxes = {}
+        _fp8_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _fp8_stack.pop()
+        return False
+
+    # -- eligibility -------------------------------------------------------
+    def eligible(self, a, w) -> bool:
+        if not isinstance(a, TensorProxy) or not isinstance(w, TensorProxy):
+            return False
+        if w.ndim != 2 or not a.dtype.is_inexact or not w.dtype.is_inexact:
+            return False
+        m = self.min_dim_multiple
+        return w.shape[0] % m == 0 and w.shape[1] % m == 0
+
+    # -- the fp8 linear ----------------------------------------------------
+    def linear(self, a, w, bias):
+        from thunder_tpu.ops import nn
+
+        slot = self._slot
+        self._slot += 1
+        if self.state is not None:
+            check(slot < self.state["x_hist"].shape[0],
+                  lambda: f"fp8 state has {self.state['x_hist'].shape[0]} slots but "
+                          f"the program contains more linears; re-run init_state/count_linears")
+            sx = _scale_from_hist(self.state["x_hist"][slot], E4M3_MAX, self.margin)
+            sw = _scale_from_hist(self.state["w_hist"][slot], E4M3_MAX, self.margin)
+        else:
+            sx = sw = None
+        out, _, _ = nn.fp8_linear(a, w, sx, sw, bias, slot)
+        return out
+
+    # -- state update ------------------------------------------------------
+    def updated_state(self):
+        """New state pytree: histories shifted with this step's amaxes
+        (the delayed-scaling recipe — TE's amax-history roll, computed
+        in-graph instead of by a mutable sync pass)."""
+        if self.state is None:
+            return None
+        from thunder_tpu import ops
+
+        n = self.state["x_hist"].shape[0]
+        amap = self._amaxes
+        x_rows, w_rows = [], []
+        for i in range(n):
+            xh = self.state["x_hist"][i]
+            wh = self.state["w_hist"][i]
+            if i in amap:
+                ax, aw = amap[i]
+                xh = ops.cat([ops.reshape(ax, (1,)), xh[:-1]], 0)
+                wh = ops.cat([ops.reshape(aw, (1,)), wh[:-1]], 0)
+            x_rows.append(xh)
+            w_rows.append(wh)
+        return {"x_hist": ops.stack(x_rows, 0), "w_hist": ops.stack(w_rows, 0)}
+
+
+def _scale_from_hist(hist, fmax: float, margin: float):
+    from thunder_tpu import ops
+
+    amax = ops.amax(hist, 0)
+    amax = ops.maximum(amax, 1e-12)
+    return ops.true_divide(fmax / (2.0 ** margin), amax)

@@ -891,7 +891,12 @@ def linear(a, w, bias=None):
     ``row_wise.py:159``) realized at the op level.
     """
     from thunder_tpu.core.proxies import DistParallelType
+    from thunder_tpu.fp8 import current_fp8
 
+    fp8_ctx = current_fp8()
+    if (fp8_ctx is not None and fp8_ctx.eligible(a, w)
+            and getattr(w, "distparallel_type", DistParallelType.NONE) is DistParallelType.NONE):
+        return fp8_ctx.linear(a, w, bias)
     a, w, bias = maybe_autocast(a, w, bias)
     dpt = getattr(w, "distparallel_type", DistParallelType.NONE)
     if dpt is DistParallelType.COLUMN_WISE:

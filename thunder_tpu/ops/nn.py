@@ -153,6 +153,73 @@ def ce_fwd(logits, target, ignore_index: int = -100):
     return nll, lse
 
 
+@opsymbol(id="nn.sdpa_bwd")
+def sdpa_bwd(g, q, k, v, out, lse, is_causal: bool = False, scale: float | None = None):
+    """Flash-attention backward contract: recompute probabilities from
+    (q, k, lse), produce (dq, dk, dv). Claimable by the Pallas executor;
+    this decomposition is the always-available fallback."""
+    E = q.shape[-1]
+    L, S = q.shape[-2], k.shape[-2]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(E)
+    gf = ops.convert_element_type(g, dtypes.float32)
+    qf = ops.convert_element_type(q, dtypes.float32)
+    kf = ops.convert_element_type(k, dtypes.float32)
+    vf = ops.convert_element_type(v, dtypes.float32)
+    of = ops.convert_element_type(out, dtypes.float32)
+    scores = ops.mul(ops.matmul(qf, kf.mT), scale_v)
+    if is_causal:
+        causal = ops.tril_mask(L, S, 0, device=q.device)
+        scores = ops.where(ops.expand_to(causal, scores.shape), scores,
+                           ops.full_like(scores, -float("inf")))
+    p = ops.exp(ops.sub(scores, ops.unsqueeze(lse, -1)))
+    dv = ops.matmul(p.mT, gf)
+    dp = ops.matmul(gf, vf.mT)
+    delta = ops.sum(ops.mul(gf, of), -1, keepdim=True)  # rowsum(dO * O)
+    ds = ops.mul(ops.mul(p, ops.sub(dp, delta)), scale_v)
+    dq = ops.matmul(ds, kf)
+    dk = ops.matmul(ds.mT, qf)
+    return (ops.convert_element_type(dq, q.dtype),
+            ops.convert_element_type(dk, k.dtype),
+            ops.convert_element_type(dv, v.dtype))
+
+
+@opsymbol(id="nn.fp8_linear")
+def fp8_linear(a, w, x_scale=None, w_scale=None, bias=None, slot: int = -1):
+    """FP8 linear (TransformerEngine analog, reference
+    ``thunder/executors/transformer_engineex.py:181,351``): e4m3 quantized
+    ``a @ w.T`` with f32 accumulation, dequantized by the scale product.
+    Returns ``(out, amax_x, amax_w)`` — the amaxes feed the caller's
+    delayed-scaling state update (``thunder_tpu.fp8``). ``x_scale``/
+    ``w_scale`` of None selects just-in-time scaling."""
+    from thunder_tpu.fp8 import E4M3_MAX
+
+    amax_x = ops.amax(ops.abs(a))
+    amax_w = ops.amax(ops.abs(w))
+    sx = x_scale if x_scale is not None else ops.true_divide(E4M3_MAX, ops.maximum(amax_x, 1e-12))
+    sw = w_scale if w_scale is not None else ops.true_divide(E4M3_MAX, ops.maximum(amax_w, 1e-12))
+    aq = ops.convert_element_type(
+        ops.clamp(ops.mul(ops.convert_element_type(a, dtypes.float32), sx), -E4M3_MAX, E4M3_MAX),
+        dtypes.float8_e4m3fn)
+    wq = ops.convert_element_type(
+        ops.clamp(ops.mul(ops.convert_element_type(w, dtypes.float32), sw), -E4M3_MAX, E4M3_MAX),
+        dtypes.float8_e4m3fn)
+    out = prims.dot_general(aq, wq, contract_dims=((a.ndim - 1,), (1,)),
+                            preferred_element_type=dtypes.float32)
+    out = ops.true_divide(out, ops.mul(sx, sw))
+    out = ops.convert_element_type(out, a.dtype)
+    if bias is not None:
+        out = ops.add(out, bias)
+    # every (re)trace of this composite — initial emission, autograd replay,
+    # checkpoint recompute — re-records its live amax proxies with the active
+    # delayed-scaling context (last write wins)
+    from thunder_tpu.fp8 import current_fp8
+
+    ctx = current_fp8()
+    if ctx is not None and slot >= 0:
+        ctx._record(slot, amax_x, amax_w)
+    return out, amax_x, amax_w
+
+
 @opsymbol(id="nn.scaled_dot_product_attention")
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                                  is_causal: bool = False, scale: float | None = None):
@@ -212,34 +279,57 @@ def _sdpa_vjp(q, k, v, attn_mask=None, dropout_p: float = 0.0, is_causal: bool =
 
     if attn_mask is not None or dropout_p > 0.0 or current_cp() is not None:
         return NotImplemented  # fall back to differentiating the decomposition
-    E = q.shape[-1]
-    L, S = q.shape[-2], k.shape[-2]
-    scale_v = scale if scale is not None else 1.0 / math.sqrt(E)
     out, lse = sdpa_fwd(q, k, v, is_causal, scale)
 
     def pullback(g):
-        gf = ops.convert_element_type(g, dtypes.float32)
-        qf = ops.convert_element_type(q, dtypes.float32)
-        kf = ops.convert_element_type(k, dtypes.float32)
-        vf = ops.convert_element_type(v, dtypes.float32)
-        of = ops.convert_element_type(out, dtypes.float32)
-        scores = ops.mul(ops.matmul(qf, kf.mT), scale_v)
-        if is_causal:
-            causal = ops.tril_mask(L, S, 0, device=q.device)
-            scores = ops.where(ops.expand_to(causal, scores.shape), scores,
-                               ops.full_like(scores, -float("inf")))
-        p = ops.exp(ops.sub(scores, ops.unsqueeze(lse, -1)))
-        dv = ops.matmul(p.mT, gf)
-        dp = ops.matmul(gf, vf.mT)
-        delta = ops.sum(ops.mul(gf, of), -1, keepdim=True)  # rowsum(dO * O)
-        ds = ops.mul(ops.mul(p, ops.sub(dp, delta)), scale_v)
-        dq = ops.matmul(ds, kf)
-        dk = ops.matmul(ds.mT, qf)
-        return [(q, ops.convert_element_type(dq, q.dtype)),
-                (k, ops.convert_element_type(dk, k.dtype)),
-                (v, ops.convert_element_type(dv, v.dtype))]
+        dq, dk, dv = sdpa_bwd(g, q, k, v, out, lse, is_causal, scale)
+        return [(q, dq), (k, dk), (v, dv)]
 
     return out, pullback
+
+
+@register_vjp("nn.fp8_linear")
+def _fp8_linear_vjp(a, w, x_scale=None, w_scale=None, bias=None, slot: int = -1):
+    """TE-recipe backward (reference ``transformer_engineex.py:397-447``):
+    dgrad = e5m2-quantized cotangent x e4m3 weight; wgrad accumulated in
+    f32 from unquantized operands (TE's higher-precision wgrad default)."""
+    from thunder_tpu.fp8 import E4M3_MAX, E5M2_MAX
+
+    out, amax_x, amax_w = fp8_linear(a, w, x_scale, w_scale, bias, slot)
+    sw = w_scale if w_scale is not None else ops.true_divide(E4M3_MAX, ops.maximum(amax_w, 1e-12))
+
+    def pullback(g):
+        gy = g[0] if isinstance(g, (tuple, list)) else g
+        if gy is None:
+            return []
+        gf = ops.convert_element_type(gy, dtypes.float32)
+        # dgrad in fp8: e5m2 cotangent (JIT scale) x e4m3 weight
+        amax_g = ops.amax(ops.abs(gf))
+        sg = ops.true_divide(E5M2_MAX, ops.maximum(amax_g, 1e-12))
+        gq = ops.convert_element_type(
+            ops.clamp(ops.mul(gf, sg), -E5M2_MAX, E5M2_MAX), dtypes.float8_e5m2)
+        wq = ops.convert_element_type(
+            ops.clamp(ops.mul(ops.convert_element_type(w, dtypes.float32), sw),
+                      -E4M3_MAX, E4M3_MAX), dtypes.float8_e4m3fn)
+        da = prims.dot_general(gq, wq, contract_dims=((gy.ndim - 1,), (0,)),
+                               preferred_element_type=dtypes.float32)
+        da = ops.true_divide(da, ops.mul(sg, sw))
+        # wgrad in f32: flatten leading dims, g2^T @ a2
+        N = 1
+        for d in gy.shape[:-1]:
+            N *= d
+        g2 = ops.reshape(gf, (N, gy.shape[-1]))
+        a2 = ops.reshape(ops.convert_element_type(a, dtypes.float32), (N, a.shape[-1]))
+        dw = prims.dot_general(g2, a2, contract_dims=((0,), (0,)),
+                               preferred_element_type=dtypes.float32)
+        pairs = [(a, ops.convert_element_type(da, a.dtype)),
+                 (w, ops.convert_element_type(dw, w.dtype))]
+        if bias is not None and isinstance(bias, TensorProxy):
+            db = ops.sum(g2, 0)
+            pairs.append((bias, ops.convert_element_type(db, bias.dtype)))
+        return pairs
+
+    return (out, amax_x, amax_w), pullback
 
 
 @register_vjp("nn.cross_entropy")
