@@ -1,0 +1,80 @@
+"""Quantization + materialization transform tests (reference:
+``thunder/tests/test_jit_general.py`` quantization cases and
+``MaterializationTransform`` usage)."""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.models import llama
+from thunder_tpu.transforms import (
+    Deferred,
+    dequantize_tree,
+    materialize,
+    quantize_tree,
+)
+
+
+def test_int8_roundtrip_error_small():
+    rng = np.random.RandomState(0)
+    w = rng.randn(32, 64).astype(np.float32)
+    q = quantize_tree({"w": w}, patterns=[r"\['w'\]"], mode="int8")
+    assert q["w"]["__quant__"] == "int8"
+    assert np.asarray(q["w"]["q"]).dtype == np.int8
+
+    def f(qp):
+        return dequantize_tree(qp)["w"]
+
+    deq = np.asarray(tt.jit(f)(q))
+    assert np.abs(deq - w).max() <= np.abs(w).max() / 127 + 1e-6
+
+
+def test_nf4_roundtrip_error_reasonable():
+    rng = np.random.RandomState(1)
+    w = (rng.randn(16, 64) * 0.02).astype(np.float32)
+    q = quantize_tree({"w": w}, patterns=[r"\['w'\]"], mode="nf4", block_size=64)
+    # 4-bit storage: packed bytes = numel/2
+    assert np.asarray(q["w"]["q"]).size == w.size // 2
+
+    def f(qp):
+        return dequantize_tree(qp)["w"]
+
+    deq = np.asarray(tt.jit(f)(q))
+    # nf4 is ~1.5 bits of mantissa; blockwise absmax keeps rel error moderate
+    rel = np.abs(deq - w).max() / np.abs(w).max()
+    assert rel < 0.2
+
+
+def test_quantized_llama_forward_close():
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    qparams = quantize_tree(
+        params, patterns=[r"\['w[qkov]'\]", r"\['w_(gate|up|down)'\]"], mode="int8")
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+
+    ref = np.asarray(tt.jit(lambda p, t: llama.forward(p, t, cfg))(params, tokens))
+
+    def qf(qp, t):
+        return llama.forward(dequantize_tree(qp), t, cfg)
+
+    got = np.asarray(tt.jit(qf)(qparams, tokens))
+    # weight-only int8: logits stay close
+    assert np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6) < 0.1
+
+
+def test_materialize_deferred():
+    tree = {
+        "a": Deferred((8, 4)),
+        "b": Deferred((4,), init=lambda k, s, d: __import__("jax").numpy.ones(s, d)),
+        "c": np.float32(3.0),
+    }
+    out = materialize(tree, seed=0)
+    assert out["a"].shape == (8, 4)
+    np.testing.assert_allclose(np.asarray(out["b"]), np.ones(4, np.float32))
+    assert out["c"] == np.float32(3.0)
+    # deterministic in seed
+    out2 = materialize(tree, seed=0)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.asarray(out2["a"]))

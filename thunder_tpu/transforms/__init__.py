@@ -1,0 +1,21 @@
+"""User-facing transform package.
+
+Reference parity: ``thunder/transforms/`` — ``MaterializationTransform``
+(``materialization.py:13``) and ``BitsAndBytesLinearQuant4bit``
+(``quantization.py:87``) — re-designed for the functional params-pytree
+world: quantization rewrites the *params tree* (int8 / nf4 storage with
+trace-visible dequant ops that XLA fuses into the consumer matmul), and
+materialization defers parameter initialization into the compiled program.
+"""
+
+from thunder_tpu.transforms.quantization import (  # noqa: F401
+    dequantize_tree,
+    nf4_dequantize,
+    nf4_quantize,
+    quantize_tree,
+)
+from thunder_tpu.transforms.materialization import (  # noqa: F401
+    Deferred,
+    deferred_like,
+    materialize,
+)

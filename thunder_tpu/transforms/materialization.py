@@ -1,0 +1,79 @@
+"""Deferred parameter materialization — the MaterializationTransform analog.
+
+Reference: ``thunder/transforms/materialization.py:13`` (init meta-device
+modules on first run). Functional re-design: a params pytree may contain
+``Deferred`` leaves (shape/dtype/init-fn, no storage); ``materialize``
+builds the real arrays — under an active mesh with shardings, each device
+initializes only its shard (no host-side full-size tensor ever exists,
+which is what meta-device init buys the reference).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+from thunder_tpu.core import dtypes as _dt
+
+
+class Deferred:
+    """A parameter that knows how to initialize itself but holds no storage."""
+
+    __slots__ = ("shape", "dtype", "init")
+
+    def __init__(self, shape, dtype=_dt.float32, init: Callable | None = None):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = _dt.to_dtype(dtype)
+        self.init = init  # (key, shape, jax_dtype) -> array; None = zeros
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"Deferred(shape={self.shape}, dtype={self.dtype.name})"
+
+
+def deferred_like(x, init: Callable | None = None) -> Deferred:
+    return Deferred(x.shape, _dt.to_dtype(x.dtype), init)
+
+
+def _default_init(key, shape, jdt):
+    import jax
+
+    if not shape:
+        return jax.numpy.zeros(shape, jdt)
+    fan_in = shape[-1] if len(shape) > 1 else shape[0]
+    return (jax.random.normal(key, shape, jax.numpy.float32)
+            / math.sqrt(max(fan_in, 1))).astype(jdt)
+
+
+def materialize(tree, seed: int = 0, shardings=None):
+    """Replace every ``Deferred`` leaf with a real, initialized array.
+
+    ``shardings``: optional pytree (matching ``tree``) of
+    ``jax.sharding.NamedSharding`` — when given, each init is jit-compiled
+    with that out-sharding so every device materializes only its shard.
+    """
+    import jax
+    import jax.tree_util as jtu
+
+    is_leaf = lambda x: isinstance(x, Deferred)
+    leaves, treedef = jtu.tree_flatten(tree, is_leaf=is_leaf)
+    n_def = sum(1 for l in leaves if isinstance(l, Deferred))
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), max(n_def, 1)))
+    shard_leaves = (jtu.tree_flatten(shardings, is_leaf=lambda x: x is None or not isinstance(x, (dict, list, tuple)))[0]
+                    if shardings is not None else [None] * len(leaves))
+
+    out = []
+    for leaf, shard in zip(leaves, shard_leaves):
+        if not isinstance(leaf, Deferred):
+            out.append(leaf)
+            continue
+        init = leaf.init or _default_init
+        key = next(keys)
+        fn = lambda k, _init=init, _l=leaf: _init(k, _l.shape, _l.dtype.jax)
+        if shard is not None:
+            fn = jax.jit(fn, out_shardings=shard)
+        out.append(fn(key))
+    return jtu.tree_unflatten(treedef, out)

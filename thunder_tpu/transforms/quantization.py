@@ -1,0 +1,141 @@
+"""Weight-only quantization: int8 (per-row scale) and NF4 (4-bit
+normal-float, double-packed) — the BitsAndBytes analog.
+
+Reference: ``thunder/transforms/quantization.py:87``
+(``BitsAndBytesLinearQuant4bit`` swaps nn.Module weights and registers a
+quantized-linear executor). TPU-first re-design: quantization is a *pytree
+rewrite* — matched param leaves become ``{"__quant__", q, scale, ...}``
+sub-trees stored in int8/uint8 (4x/8x HBM saving for frozen weights);
+``dequantize_tree`` inside the traced function emits the dequant ops, which
+XLA fuses into the consuming matmul (the dequant never materializes in HBM
+at full precision for fused consumers).
+
+NF4 uses the standard 16-entry normal-float codebook (QLoRA); two 4-bit
+codes pack per uint8 byte, unpacked in-graph with shift/mask ops.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import numpy as np
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check
+
+QUANT_KEY = "__quant__"
+
+# QLoRA NF4 codebook: quantiles of N(0,1) normalized to [-1, 1]
+NF4_CODE = np.array([
+    -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+    -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+    0.07958029955625534, 0.16093020141124725, 0.24611230194568634, 0.33791524171829224,
+    0.44070982933044434, 0.5626170039176941, 0.7229568362236023, 1.0,
+], dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# host-side quantize
+# ---------------------------------------------------------------------------
+
+def int8_quantize(w) -> dict:
+    """Per-row (output-channel) symmetric int8."""
+    import jax.numpy as jnp
+
+    w = np.asarray(w, np.float32)
+    check(w.ndim >= 1, "int8_quantize expects an array")
+    amax = np.max(np.abs(w), axis=-1, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return {QUANT_KEY: "int8", "q": jnp.asarray(q), "scale": jnp.asarray(scale),
+            "dtype": "float32"}
+
+
+def nf4_quantize(w, block_size: int = 64) -> dict:
+    """Blockwise absmax NF4: codes packed two-per-byte."""
+    import jax.numpy as jnp
+
+    w = np.asarray(w, np.float32)
+    orig_shape = w.shape
+    flat = w.reshape(-1)
+    n = flat.size
+    check(n % block_size == 0, lambda: f"numel {n} not divisible by block_size {block_size}")
+    check((n // block_size) % 2 == 0 or block_size % 2 == 0, "pack alignment")
+    blocks = flat.reshape(-1, block_size)
+    absmax = np.max(np.abs(blocks), axis=-1, keepdims=True)
+    absmax = np.where(absmax > 0, absmax, 1.0).astype(np.float32)
+    normed = blocks / absmax  # [-1, 1]
+    idx = np.argmin(np.abs(normed[..., None] - NF4_CODE[None, None, :]), axis=-1).astype(np.uint8)
+    idx = idx.reshape(-1)
+    packed = (idx[0::2] << 4) | idx[1::2]
+    return {QUANT_KEY: "nf4", "q": jnp.asarray(packed.astype(np.uint8)),
+            "absmax": jnp.asarray(absmax[:, 0]), "block_size": block_size,
+            "shape": tuple(orig_shape), "dtype": "float32"}
+
+
+def quantize_tree(params, patterns: Sequence[str], mode: str = "int8", **kwargs):
+    """Rewrite param leaves whose pytree path matches ``patterns`` into
+    quantized sub-trees. Unmatched leaves pass through untouched."""
+    import jax.tree_util as jtu
+
+    rx = re.compile("|".join(patterns))
+    quant = int8_quantize if mode == "int8" else nf4_quantize
+    flat, treedef = jtu.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        pathstr = jtu.keystr(path)
+        if rx.search(pathstr) and hasattr(leaf, "shape"):
+            out.append(quant(leaf, **kwargs))
+        else:
+            out.append(leaf)
+    return jtu.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# traced dequantize
+# ---------------------------------------------------------------------------
+
+def _is_quant_leaf(x) -> bool:
+    return isinstance(x, dict) and QUANT_KEY in x
+
+
+def int8_dequantize(q, scale, out_dtype=dtypes.float32):
+    from thunder_tpu import ops
+
+    return ops.mul(ops.convert_element_type(q, out_dtype), scale)
+
+
+def nf4_dequantize(q, absmax, block_size: int, shape, out_dtype=dtypes.float32):
+    """Unpack two 4-bit codes per byte, look up the codebook, rescale."""
+    from thunder_tpu import ops
+
+    hi = ops.shift_right(q, 4)  # uint8 logical shift
+    lo = ops.bitwise_and(q, 0x0F)
+    idx = ops.reshape(ops.stack([hi, lo], -1), (-1,))  # interleave -> original order
+    table = ops.constant_tensor(NF4_CODE)
+    vals = ops.take(table, ops.convert_element_type(idx, dtypes.int32), 0)
+    vals = ops.reshape(vals, (-1, block_size))
+    vals = ops.mul(vals, ops.reshape(absmax, (-1, 1)))
+    return ops.convert_element_type(ops.reshape(vals, shape), out_dtype)
+
+
+def dequantize_tree(qparams):
+    """Inside traced code: rebuild the full-precision params pytree, emitting
+    dequant ops for quantized leaves (XLA fuses them into consumers)."""
+    def walk(x):
+        if _is_quant_leaf(x):
+            out_dtype = getattr(dtypes, x["dtype"]) if isinstance(x["dtype"], str) else x["dtype"]
+            if x[QUANT_KEY] == "int8":
+                return int8_dequantize(x["q"], x["scale"], out_dtype)
+            if x[QUANT_KEY] == "nf4":
+                return nf4_dequantize(x["q"], x["absmax"], x["block_size"], x["shape"], out_dtype)
+            raise ValueError(f"unknown quant mode {x[QUANT_KEY]}")
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            t = type(x)
+            return t(walk(v) for v in x)
+        return x
+
+    return walk(qparams)
