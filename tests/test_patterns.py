@@ -1,0 +1,90 @@
+"""Pattern-matcher tests (reference ``thunder/core/patterns.py`` role:
+executor-driven fusion-like rewrites on bsym subsequences)."""
+
+import numpy as np
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+from thunder_tpu.core import prims as P
+from thunder_tpu.core.patterns import Pattern, rewrite
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.symbol import Symbol
+from thunder_tpu.core.trace import TraceCtx, tracectx
+
+
+def _mul_add_trace():
+    trc = TraceCtx("computation")
+    with tracectx(trc):
+        x = TensorProxy("x", shape=(4,), dtype=dtypes.float32)
+        y = TensorProxy("y", shape=(4,), dtype=dtypes.float32)
+        m = ops.mul(x, y)
+        o = ops.add(m, y)
+        P.python_return(o)
+    trc.args = [x, y]
+    trc.output = o
+    return trc
+
+
+def test_pattern_match_and_rewrite_to_fma():
+    trc = _mul_add_trace()
+    p = Pattern("fma").match_op("ops.mul").match_op("ops.add")
+
+    def build(trc_, matched, env):
+        mul_b, add_b = matched
+        a, b = mul_b.args
+        c = [x for x in add_b.args if x is not mul_b.output][0]
+        fma = Symbol("fma", None, id="test.fma", is_prim=True,
+                     python_impl=lambda a, b, c: a * b + c)
+        return [fma.bind(a, b, c, output=add_b.output)]
+
+    new = rewrite(trc, p, build)
+    src = new.python()
+    assert "fma(" in src and "mul(" not in src
+    fn = new.python_callable()
+    x = np.arange(4, dtype=np.float32)
+    y = np.full(4, 2.0, np.float32)
+    np.testing.assert_allclose(np.asarray(fn(x, y)), x * y + y)
+
+
+def test_pattern_skips_when_intermediate_escapes():
+    trc = TraceCtx("computation")
+    with tracectx(trc):
+        x = TensorProxy("x", shape=(4,), dtype=dtypes.float32)
+        y = TensorProxy("y", shape=(4,), dtype=dtypes.float32)
+        m = ops.mul(x, y)
+        o = ops.add(m, y)
+        o2 = ops.add(o, m)  # m escapes the mul->add chain
+        P.python_return(o2)
+    trc.args = [x, y]
+    trc.output = o2
+
+    p = Pattern("fma").match_op("ops.mul").match_op("ops.add")
+    called = []
+
+    def build(trc_, matched, env):
+        called.append(1)
+        return None
+
+    new = rewrite(trc, p, build)
+    # the first mul->add candidate has an escaping intermediate; the matcher
+    # must not fuse it (the second add->... chain doesn't match mul first)
+    assert "mul(" in new.python()
+
+
+def test_pattern_env_capture():
+    trc = _mul_add_trace()
+    p = Pattern("cap")
+
+    def cap_mul(b, env):
+        if b.sym.id == "ops.mul":
+            env["mul_out"] = b.output
+            return True
+        return False
+
+    p.step(cap_mul).match_op("ops.add")
+    matches = p.find(trc)
+    assert len(matches) == 1
+    idxs, env = matches[0]
+    assert "mul_out" in env and isinstance(env["mul_out"], TensorProxy)

@@ -1,0 +1,167 @@
+"""Pattern matching over traces: declarative bsym-subsequence rewrites.
+
+Reference parity: ``thunder/core/patterns.py`` (``Pattern`` :99 — matching
+bound-symbol subsequences for fusion-like rewrites by executors). Same role
+here: executors and transforms describe an op chain (dataflow-connected, not
+necessarily adjacent) plus per-step predicates; ``rewrite`` splices in a
+replacement when the intermediate values don't escape the matched chain.
+
+Example::
+
+    p = Pattern()
+    p.step(lambda b, env: b.sym.id is PrimIDs.MUL)          # a * b
+    p.step(lambda b, env: b.sym.id is PrimIDs.ADD)          # (a*b) + c
+    def build(trc, matched):                                 # -> fused bsym list
+        ...
+    new_trc = rewrite(trc, p, build)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import Proxy, Variable
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx, from_trace
+
+
+class Pattern:
+    """An ordered chain of predicates over bound symbols. Step ``i+1`` must
+    consume at least one output of step ``i`` (dataflow-connected). Each
+    predicate receives ``(bsym, env)`` — ``env`` is a per-candidate binding
+    dict the predicates may fill (e.g. capture proxies for the builder)."""
+
+    def __init__(self, name: str = "pattern"):
+        self.name = name
+        self.steps: list[Callable[[BoundSymbol, dict], bool]] = []
+
+    def step(self, pred: Callable[[BoundSymbol, dict], bool]) -> "Pattern":
+        self.steps.append(pred)
+        return self
+
+    def match_op(self, op_id) -> "Pattern":
+        """Convenience: step matching on ``sym.id``."""
+        return self.step(lambda b, env, _id=op_id: b.sym.id == _id)
+
+    # -- matching ----------------------------------------------------------
+    def find(self, trc: TraceCtx) -> list[tuple[list[int], dict]]:
+        """All non-overlapping matches, each as (bsym indices, env)."""
+        bsyms = trc.bound_symbols
+        n = len(bsyms)
+        taken: set[int] = set()
+        matches: list[tuple[list[int], dict]] = []
+
+        producers: dict[Variable, int] = {}
+        for i, b in enumerate(bsyms):
+            for o in b.flat_proxy_outs():
+                producers[Variable(o)] = i
+
+        for start in range(n):
+            if start in taken:
+                continue
+            env: dict = {}
+            if not self._try(bsyms, start, 0, env_chain := [start], env, taken):
+                continue
+            idxs = env_chain
+            if any(i in taken for i in idxs):
+                continue
+            matches.append((idxs, env))
+            taken.update(idxs)
+        return matches
+
+    def _try(self, bsyms, idx: int, step: int, chain: list[int], env: dict, taken) -> bool:
+        b = bsyms[idx]
+        if b.sym.id in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
+            return False
+        try:
+            ok = self.steps[step](b, env)
+        except Exception:
+            ok = False
+        if not ok:
+            return False
+        if step == len(self.steps) - 1:
+            del chain[step + 1:]
+            return True
+        # successor: a later bsym consuming one of this bsym's outputs
+        out_vars = {Variable(o) for o in b.flat_proxy_outs()}
+        for j in range(idx + 1, len(bsyms)):
+            if j in taken:
+                continue
+            nxt = bsyms[j]
+            if any(Variable(a) in out_vars for a in nxt.flat_proxy_args()):
+                chain[step + 1:] = [j]
+                saved = dict(env)
+                if self._try(bsyms, j, step + 1, chain, env, taken):
+                    return True
+                env.clear()
+                env.update(saved)
+        return False
+
+
+def _escapees(bsyms: list[BoundSymbol], idxs: list[int], trc: TraceCtx) -> set[Variable]:
+    """Vars produced inside the match and consumed outside it (or returned)."""
+    inside = set(idxs)
+    produced: set[Variable] = set()
+    for i in idxs:
+        for o in bsyms[i].flat_proxy_outs():
+            produced.add(Variable(o))
+    escaped: set[Variable] = set()
+    for j, b in enumerate(bsyms):
+        if j in inside:
+            continue
+        for a in b.flat_proxy_args():
+            v = Variable(a)
+            if v in produced:
+                escaped.add(v)
+    from thunder_tpu.core.pytree import tree_flatten
+
+    for o in tree_flatten(trc.output)[0]:
+        if isinstance(o, Proxy) and Variable(o) in produced:
+            escaped.add(Variable(o))
+    return escaped
+
+
+def rewrite(trc: TraceCtx, pattern: Pattern,
+            builder: Callable[[TraceCtx, list[BoundSymbol], dict], list[BoundSymbol]],
+            allow_escaping_last: bool = True) -> TraceCtx:
+    """Replace each match with ``builder(trc, matched_bsyms, env)``'s bsyms.
+
+    A match is rewritten only if no *intermediate* value escapes the chain —
+    the final step's outputs may escape (``allow_escaping_last``); the
+    builder's replacement must produce those same output proxies.
+    """
+    matches = pattern.find(trc)
+    if not matches:
+        return trc
+    bsyms = list(trc.bound_symbols)
+    to_replace: dict[int, list[BoundSymbol]] = {}
+    dropped: set[int] = set()
+    for idxs, env in matches:
+        last = idxs[-1]
+        esc = _escapees(bsyms, idxs, trc)
+        last_outs = {Variable(o) for o in bsyms[last].flat_proxy_outs()}
+        inner_escapes = esc - (last_outs if allow_escaping_last else set())
+        if inner_escapes:
+            continue  # intermediates used elsewhere: unsafe to fuse
+        matched = [bsyms[i] for i in idxs]
+        replacement = builder(trc, matched, env)
+        if replacement is None:
+            continue
+        to_replace[last] = replacement
+        dropped.update(i for i in idxs if i != last)
+    if not to_replace:
+        return trc
+    new = from_trace(trc)
+    out: list[BoundSymbol] = []
+    for i, b in enumerate(bsyms):
+        if i in dropped:
+            continue
+        if i in to_replace:
+            out.extend(to_replace[i])
+        else:
+            out.append(b)
+    new.bound_symbols = out
+    new.set_provenance(f"Pattern rewrite ({pattern.name})")
+    return new
