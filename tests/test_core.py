@@ -212,3 +212,41 @@ def test_sharp_edges_detection():
     # default: allowed silently
     out = tt.jit(f)(np.ones((3,), np.float32))
     np.testing.assert_allclose(np.asarray(out), 2 * np.ones(3, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# symbolic-values caching (reference CACHE_OPTIONS.SYMBOLIC_VALUES,
+# thunder/core/options.py:95)
+# ---------------------------------------------------------------------------
+
+def test_symbolic_values_cache_numbers_are_runtime_inputs():
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    import numpy as np
+
+    def f(x, s):
+        return ops.add(ops.mul(x, s), 1.0)
+
+    jf = tt.jit(f, cache="symbolic values")
+    x = np.ones(4, np.float32)
+    np.testing.assert_allclose(np.asarray(jf(x, 2.0)), np.full(4, 3.0))
+    np.testing.assert_allclose(np.asarray(jf(x, 5.0)), np.full(4, 6.0))
+    assert tt.cache_misses(jf) == 1 and tt.cache_hits(jf) == 1
+    # a TYPE change is a new cache entry (int vs float)
+    jf(x, 3)
+    assert tt.cache_misses(jf) == 2
+    # prologue guards type, not value
+    src = tt.last_prologue_traces(jf)[0].python()
+    assert "check_number_type(" in src
+
+
+def test_constant_values_cache_recompiles_on_number_change():
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    import numpy as np
+
+    jf = tt.jit(lambda x, s: ops.mul(x, s))
+    x = np.ones(4, np.float32)
+    jf(x, 2.0)
+    jf(x, 5.0)
+    assert tt.cache_misses(jf) == 2

@@ -42,7 +42,7 @@ from thunder_tpu.core.rematerialization import (
 
 __version__ = "0.1.0"
 
-_CACHE_OPTIONS = ("constant values", "no caching")
+_CACHE_OPTIONS = ("constant values", "symbolic values", "no caching")
 
 
 # ---------------------------------------------------------------------------
@@ -143,10 +143,21 @@ class ThunderTPUFunction:
         self._stats = CompileStats()
         self.__name__ = f"thunder_tpu.jit({self.fn_name})"
 
+    def _leaf_cache_key(self, leaf):
+        # symbolic values: non-bool numbers become runtime inputs guarded by
+        # type only (reference SYMBOLIC_VALUES, thunder/core/options.py:95) —
+        # tensor SHAPES stay static: XLA compiles static programs, so shape
+        # polymorphism on TPU is handled by data-pipeline bucketing instead
+        if (self.cache_option == "symbolic values" and isinstance(leaf, Number)
+                and not isinstance(leaf, bool)):
+            return ("N", type(leaf).__name__)
+        return _leaf_key(leaf)
+
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         flat, treedef = tree_flatten((args, kwargs))
-        key = (treedef, tuple(_leaf_key(l) for l in flat)) if self.cache_option == "constant values" else None
+        key = (treedef, tuple(self._leaf_cache_key(l) for l in flat)) \
+            if self.cache_option != "no caching" else None
         entry = self._cache.get(key) if key is not None else None
         if entry is None:
             self._stats.cache_misses += 1
@@ -167,9 +178,15 @@ class ThunderTPUFunction:
         tensor_indices: list[int] = []
         with tracectx(trc):
             proxies = []
+            symbolic_numbers = self.cache_option == "symbolic values"
             for i, leaf in enumerate(flat):
                 if _is_arraylike(leaf):
                     p = self._make_input_proxy(i, leaf)
+                    proxies.append(p)
+                    tensor_indices.append(i)
+                elif (symbolic_numbers and isinstance(leaf, Number)
+                      and not isinstance(leaf, bool)):
+                    p = NumberProxy(leaf)  # value is a runtime input, not baked
                     proxies.append(p)
                     tensor_indices.append(i)
                 else:
@@ -196,7 +213,11 @@ class ThunderTPUFunction:
                     returns.append(p)
                 elif isinstance(leaf, Number):
                     p = NumberProxy(leaf, f"arg{i}")
-                    prims.check_number_type_and_value(p, leaf)
+                    if self.cache_option == "symbolic values" and not isinstance(leaf, bool):
+                        prims.check_number_type(p, type(leaf).__name__)
+                        returns.append(p)
+                    else:
+                        prims.check_number_type_and_value(p, leaf)
                 elif isinstance(leaf, str):
                     p = StringProxy(leaf, f"arg{i}")
                     prims.check_string_value(p, leaf)

@@ -45,6 +45,7 @@ class PrimIDs(Enum):
     # prologue check/unpack
     UNPACK_TRIVIAL = auto(); CHECK_TENSOR_SHAPE_AND_METADATA = auto()
     CHECK_NUMBER_TYPE_AND_VALUE = auto(); CHECK_STRING_VALUE = auto(); CHECK_LITERAL_LIKE = auto()
+    CHECK_NUMBER_TYPE = auto()
     # dtype/device/sharding
     CONVERT_ELEMENT_TYPE = auto(); DEVICE_PUT = auto(); SHARDING_CONSTRAINT = auto(); DETACH = auto()
     # creation
@@ -206,6 +207,15 @@ check_string_value = make_prim(
 
 check_literal_like = make_prim(
     PrimIDs.CHECK_LITERAL_LIKE, "check_literal_like", lambda x, v: None,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+# symbolic-values caching: numbers are guarded by TYPE only — their value is
+# a runtime input, not a recompile trigger (reference CACHE_OPTIONS
+# SYMBOLIC_VALUES, thunder/core/options.py:95; NumberProxy CONSTRAINT
+# machinery, proxies.py:624-1003)
+check_number_type = make_prim(
+    PrimIDs.CHECK_NUMBER_TYPE, "check_number_type", lambda n, t: None,
     tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
 )
 

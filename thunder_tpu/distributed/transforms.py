@@ -93,6 +93,9 @@ class DistributedFunction(ThunderTPUFunction):
             return out
 
         wrapped.__name__ = getattr(fn, "__name__", "fn")
+        check(jit_kwargs.get("cache", "constant values") != "symbolic values",
+              "symbolic-values caching is not supported under distributed transforms "
+              "(leaf plans and shard specs are built per concrete call)")
         super().__init__(wrapped, **jit_kwargs)
         self._orig_fn = fn
 

@@ -97,6 +97,11 @@ def _check_literal(x, v):
     _guard(type(x) is type(v), f"input type changed: expected {type(v)}, got {type(x)}")
 
 
+@impl(PrimIDs.CHECK_NUMBER_TYPE)
+def _check_number_type(n, tname):
+    _guard(type(n).__name__ == tname, f"number type changed: expected {tname}, got {type(n).__name__}")
+
+
 # -- dtype / device / sharding ----------------------------------------------
 
 @impl(PrimIDs.CONVERT_ELEMENT_TYPE)
