@@ -3,3 +3,4 @@
 ``litgpt_model.py``, ``llama2_model.py`` — fresh implementations)."""
 
 from thunder_tpu.models import llama, mixtral, nanogpt  # noqa: F401
+from thunder_tpu.models import gpt  # noqa: F401

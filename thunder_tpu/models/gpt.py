@@ -1,0 +1,248 @@
+"""Configurable multi-family transformer — the litgpt model-zoo analog.
+
+Reference parity: the reference's model zoo is the ``litgpt`` GPT consumed
+through ``thunder/tests/litgpt_model.py`` (one configurable architecture
+spanning GPT-2/Pythia/Falcon/Gemma/Phi/Llama via config flags). Same design
+here, functional: one ``forward`` parameterized by
+
+- ``norm``: "layernorm" | "rmsnorm"
+- ``mlp``: "gelu" (GPT-2/Pythia/Phi), "swiglu" (Llama), "geglu" (Gemma)
+- ``pos``: "rope" | "learned"; ``rotary_pct`` for partial rotary (NeoX/Phi)
+- ``parallel_residual`` (NeoX/Falcon): attn and MLP read the same norm
+- ``n_kv_heads``: MQA (Falcon) / GQA (Llama-3, Gemma)
+- ``tie_embedding``: lm_head shares the token embedding (GPT-2, Gemma)
+- ``emb_scale``: sqrt(dim) embedding scaling (Gemma)
+
+Named configs carry the published geometries; tiny variants drive tests.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+
+@dataclass(frozen=True)
+class Config:
+    name: str = "tiny"
+    vocab_size: int = 512
+    dim: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int | None = None
+    intermediate_size: int | None = None  # default 4*dim (gelu) / computed (glu)
+    max_seq_len: int = 256
+    norm: str = "layernorm"          # "layernorm" | "rmsnorm"
+    mlp: str = "gelu"                # "gelu" | "swiglu" | "geglu"
+    pos: str = "rope"                # "rope" | "learned"
+    rotary_pct: float = 1.0
+    parallel_residual: bool = False
+    tie_embedding: bool = False
+    emb_scale: bool = False          # gemma: h *= sqrt(dim)
+    qkv_bias: bool = False
+    mlp_bias: bool = False
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: dtypes.dtype = dtypes.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        return 4 * self.dim
+
+
+CONFIGS = {
+    # tests
+    "tiny": Config(),
+    "tiny-neox": Config(name="tiny-neox", parallel_residual=True, rotary_pct=0.25),
+    "tiny-falcon": Config(name="tiny-falcon", parallel_residual=True, n_kv_heads=1),
+    "tiny-gemma": Config(name="tiny-gemma", norm="rmsnorm", mlp="geglu", tie_embedding=True,
+                         emb_scale=True, intermediate_size=128),
+    "tiny-phi": Config(name="tiny-phi", rotary_pct=0.5, qkv_bias=True, mlp_bias=True),
+    # published geometries (reference litgpt configs, litgpt_model.py:7-118)
+    "pythia-410m": Config(name="pythia-410m", vocab_size=50304, dim=1024, n_layers=24,
+                          n_heads=16, parallel_residual=True, rotary_pct=0.25,
+                          max_seq_len=2048, dtype=dtypes.bfloat16),
+    "falcon-7b": Config(name="falcon-7b", vocab_size=65024, dim=4544, n_layers=32,
+                        n_heads=71, n_kv_heads=1, parallel_residual=True,
+                        max_seq_len=2048, dtype=dtypes.bfloat16),
+    "gemma-2b": Config(name="gemma-2b", vocab_size=256000, dim=2048, n_layers=18,
+                       n_heads=8, n_kv_heads=1, norm="rmsnorm", mlp="geglu",
+                       intermediate_size=16384, tie_embedding=True, emb_scale=True,
+                       max_seq_len=8192, dtype=dtypes.bfloat16),
+    "phi-1.5": Config(name="phi-1.5", vocab_size=50304, dim=2048, n_layers=24,
+                      n_heads=32, rotary_pct=0.5, qkv_bias=True, mlp_bias=True,
+                      max_seq_len=2048, dtype=dtypes.bfloat16),
+    "gpt2-medium": Config(name="gpt2-medium", vocab_size=50257, dim=1024, n_layers=24,
+                          n_heads=16, pos="learned", tie_embedding=True,
+                          max_seq_len=1024, dtype=dtypes.bfloat16),
+}
+
+
+def init_params(cfg: Config, seed: int = 0, scale_layers: int | None = None):
+    import jax
+    import jax.numpy as jnp
+
+    n_layers = scale_layers if scale_layers is not None else cfg.n_layers
+    jd = cfg.dtype.jax
+    D, F = cfg.dim, cfg.ffn_dim
+    kv_dim = cfg.kv_heads * cfg.head_dim
+
+    key = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(key, 8 + n_layers * 8))
+
+    def dense(shape, fan_in):
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(jd)
+
+    def norm_params():
+        p = {"w": jnp.ones((D,), jd)}
+        if cfg.norm == "layernorm":
+            p["b"] = jnp.zeros((D,), jd)
+        return p
+
+    params = {"wte": dense((cfg.vocab_size, D), D), "norm_f": norm_params(), "layers": []}
+    if cfg.pos == "learned":
+        params["wpe"] = dense((cfg.max_seq_len, D), D)
+    if not cfg.tie_embedding:
+        params["lm_head"] = dense((cfg.vocab_size, D), D)
+    for _ in range(n_layers):
+        layer = {
+            "norm1": norm_params(),
+            "wq": dense((D, D), D), "wk": dense((kv_dim, D), D), "wv": dense((kv_dim, D), D),
+            "wo": dense((D, D), D),
+        }
+        if cfg.qkv_bias:
+            layer["bq"] = jnp.zeros((D,), jd)
+            layer["bk"] = jnp.zeros((kv_dim,), jd)
+            layer["bv"] = jnp.zeros((kv_dim,), jd)
+        if not cfg.parallel_residual:
+            layer["norm2"] = norm_params()
+        if cfg.mlp == "gelu":
+            layer["w_fc"] = dense((F, D), D)
+            layer["w_proj"] = dense((D, F), F)
+            if cfg.mlp_bias:
+                layer["b_fc"] = jnp.zeros((F,), jd)
+                layer["b_proj"] = jnp.zeros((D,), jd)
+        else:  # swiglu / geglu
+            layer["w_gate"] = dense((F, D), D)
+            layer["w_up"] = dense((F, D), D)
+            layer["w_down"] = dense((D, F), F)
+        params["layers"].append(layer)
+    return params
+
+
+def _norm(x, p, cfg: Config):
+    if cfg.norm == "rmsnorm":
+        return ops.rms_norm(x, p["w"], eps=cfg.norm_eps)
+    return ops.layer_norm(x, (cfg.dim,), p["w"], p["b"], eps=cfg.norm_eps)
+
+
+def _rope_tables(cfg: Config, T: int, dtype):
+    rot = int(cfg.head_dim * cfg.rotary_pct) // 2 * 2
+    pos = ops.convert_element_type(ops.arange(T), dtypes.float32)
+    idx = ops.convert_element_type(ops.arange(rot // 2), dtypes.float32)
+    inv_freq = ops.pow(cfg.rope_theta, ops.true_divide(ops.mul(idx, -2.0), float(rot)))
+    angles = ops.mul(ops.unsqueeze(pos, 1), ops.unsqueeze(inv_freq, 0))
+    return (ops.convert_element_type(ops.cos(angles), dtype),
+            ops.convert_element_type(ops.sin(angles), dtype), rot)
+
+
+def _apply_rope(x, cos, sin, rot: int):
+    """Partial rotary (NeoX-style half rotation on the first ``rot`` dims)."""
+    if rot == 0:
+        return x
+    xr = x[..., :rot]
+    rest = x[..., rot:]
+    x1 = xr[..., : rot // 2]
+    x2 = xr[..., rot // 2:]
+    r1 = ops.sub(ops.mul(x1, cos), ops.mul(x2, sin))
+    r2 = ops.add(ops.mul(x2, cos), ops.mul(x1, sin))
+    out = ops.cat([r1, r2], -1)
+    if rot == x.shape[-1]:
+        return out
+    return ops.cat([out, rest], -1)
+
+
+def _attention(x, layer, cfg: Config, rope):
+    B, T, _ = x.shape
+    hd = cfg.head_dim
+    H, KV = cfg.n_heads, cfg.kv_heads
+    q = ops.linear(x, layer["wq"], layer.get("bq"))
+    k = ops.linear(x, layer["wk"], layer.get("bk"))
+    v = ops.linear(x, layer["wv"], layer.get("bv"))
+    q = ops.transpose(ops.reshape(q, (B, T, H, hd)), (0, 2, 1, 3))
+    k = ops.transpose(ops.reshape(k, (B, T, KV, hd)), (0, 2, 1, 3))
+    v = ops.transpose(ops.reshape(v, (B, T, KV, hd)), (0, 2, 1, 3))
+    if rope is not None:
+        cos, sin, rot = rope
+        q = _apply_rope(q, cos, sin, rot)
+        k = _apply_rope(k, cos, sin, rot)
+    if H != KV:  # MQA / GQA
+        rep = H // KV
+        k = ops.reshape(ops.expand(ops.unsqueeze(k, 2), (B, KV, rep, T, hd)), (B, H, T, hd))
+        v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, KV, rep, T, hd)), (B, H, T, hd))
+    attn = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
+    attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, H * hd))
+    return ops.linear(attn, layer["wo"])
+
+
+def _mlp(x, layer, cfg: Config):
+    if cfg.mlp == "gelu":
+        h = ops.gelu(ops.linear(x, layer["w_fc"], layer.get("b_fc")))
+        return ops.linear(h, layer["w_proj"], layer.get("b_proj"))
+    act = ops.silu if cfg.mlp == "swiglu" else ops.gelu
+    gate = act(ops.linear(x, layer["w_gate"]))
+    up = ops.linear(x, layer["w_up"])
+    return ops.linear(ops.mul(gate, up), layer["w_down"])
+
+
+def forward(params, tokens, cfg: Config):
+    B, T = tokens.shape
+    h = ops.embedding(tokens, params["wte"])
+    if cfg.emb_scale:
+        h = ops.mul(h, math.sqrt(cfg.dim))
+    if cfg.pos == "learned":
+        h = ops.add(h, params["wpe"][0:T])
+    rope = _rope_tables(cfg, T, h.dtype) if cfg.pos == "rope" else None
+
+    for layer in params["layers"]:
+        if cfg.parallel_residual:
+            # NeoX/Falcon: one shared norm feeds both attn and MLP
+            n1 = _norm(h, layer["norm1"], cfg)
+            h = ops.add(h, ops.add(_attention(n1, layer, cfg, rope), _mlp(n1, layer, cfg)))
+        else:
+            h = ops.add(h, _attention(_norm(h, layer["norm1"], cfg), layer, cfg, rope))
+            h = ops.add(h, _mlp(_norm(h, layer["norm2"], cfg), layer, cfg))
+
+    h = _norm(h, params["norm_f"], cfg)
+    head_w = params["wte"] if cfg.tie_embedding else params["lm_head"]
+    return ops.linear(h, head_w)
+
+
+def loss_fn(params, tokens, targets, cfg: Config):
+    logits = forward(params, tokens, cfg)
+    B, T, V = logits.shape
+    logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
+    return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
+
+
+def num_params(cfg: Config, n_layers: int | None = None) -> int:
+    import jax
+    import numpy as np
+
+    n = n_layers if n_layers is not None else cfg.n_layers
+    shapes = jax.eval_shape(lambda: init_params(cfg, seed=0, scale_layers=n))
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
