@@ -1,0 +1,127 @@
+"""Elastic checkpoint-restart tests (NEW capability — SURVEY §5 lists the
+reference's failure detection / elastic recovery as Absent)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.elastic import (
+    CheckpointManager,
+    ElasticTrainer,
+    FaultInjector,
+    Heartbeat,
+    check_stalled,
+)
+from thunder_tpu.optim import SGD
+
+
+def _make_step(js, tokens_of_step):
+    def step(state, batch):
+        loss, params, opt_state = js(state["params"], state["opt"], batch["tokens"], batch["targets"])
+        return {"params": params, "opt": opt_state, "loss": float(np.asarray(loss))}
+
+    return step
+
+
+def _setup(tmp_path):
+    from thunder_tpu.models import llama
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    opt = SGD(lr=1e-2)
+
+    def raw_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_p, new_s = opt.update(params, grads, opt_state)
+        return loss, new_p, new_s
+
+    js = tt.jit(raw_step)
+
+    def data_fn(step):
+        rng = np.random.RandomState(1000 + step)
+        tokens = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+        return {"tokens": tokens, "targets": np.roll(tokens, -1, axis=1).astype(np.int32)}
+
+    state0 = {"params": params, "opt": opt.init(params), "loss": 0.0}
+    return js, data_fn, state0
+
+
+def _final_params(state):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(state["params"])]
+
+
+def test_recovery_matches_uninterrupted_run(tmp_path):
+    js, data_fn, state0 = _setup(tmp_path)
+    step = _make_step(js, data_fn)
+
+    ref = ElasticTrainer(step, CheckpointManager(str(tmp_path / "ref"), keep=2),
+                         save_every=2).run(state0, data_fn, 6)
+
+    events = []
+    faulty = ElasticTrainer(
+        step, CheckpointManager(str(tmp_path / "faulty"), keep=2), save_every=2,
+        fault_injector=FaultInjector(fail_at={3, 5}),
+        on_event=lambda kind, info: events.append(kind),
+    ).run(state0, data_fn, 6)
+
+    assert events.count("failure") == 2
+    for a, b in zip(_final_params(ref), _final_params(faulty)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_resume_after_process_restart(tmp_path):
+    js, data_fn, state0 = _setup(tmp_path)
+    step = _make_step(js, data_fn)
+    ckdir = str(tmp_path / "ck")
+
+    # "process 1" runs 4 steps then dies (we just stop)
+    ElasticTrainer(step, CheckpointManager(ckdir, keep=2), save_every=2).run(state0, data_fn, 4)
+    # "process 2" resumes from LATEST and finishes
+    events = []
+    final = ElasticTrainer(step, CheckpointManager(ckdir, keep=2), save_every=2,
+                           on_event=lambda k, i: events.append((k, i))).run(state0, data_fn, 8)
+    assert ("resume", {"step": 4}) in events
+
+    ref = ElasticTrainer(step, CheckpointManager(str(tmp_path / "ref"), keep=2),
+                         save_every=100).run(state0, data_fn, 8)
+    for a, b in zip(_final_params(ref), _final_params(final)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_max_restarts_raises(tmp_path):
+    js, data_fn, state0 = _setup(tmp_path)
+    step = _make_step(js, data_fn)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        ElasticTrainer(step, CheckpointManager(str(tmp_path / "ck"), keep=2),
+                       save_every=2, max_restarts=1,
+                       fault_injector=FaultInjector(fail_at={1}, repeat=True)).run(state0, data_fn, 4)
+
+
+def test_checkpoint_rotation(tmp_path):
+    ck = CheckpointManager(str(tmp_path / "rot"), keep=2)
+    for s in (2, 4, 6):
+        ck.save(s, {"x": np.arange(3, dtype=np.float32) * s})
+    dirs = sorted(d for d in os.listdir(ck.root) if d.startswith("step_"))
+    assert dirs == ["step_4", "step_6"]
+    assert ck.latest_step() == 6
+    step, st = ck.restore_latest()
+    np.testing.assert_allclose(np.asarray(st["x"]), np.arange(3, dtype=np.float32) * 6)
+
+
+def test_heartbeat_and_stall_detection(tmp_path):
+    hb = Heartbeat(str(tmp_path / "hb.json"))
+    hb.beat(5)
+    assert not check_stalled(hb.path, timeout_s=60)
+    # rewrite with an old timestamp -> stalled
+    with open(hb.path) as f:
+        d = json.load(f)
+    d["time"] -= 120
+    with open(hb.path, "w") as f:
+        json.dump(d, f)
+    assert check_stalled(hb.path, timeout_s=60)

@@ -60,7 +60,8 @@ def load_checkpoint(path: str, template: Any | None = None) -> Any:
         if template is not None:
             abstract = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                               sharding=getattr(x, "sharding", None)),
+                                               sharding=getattr(x, "sharding", None))
+                if hasattr(x, "shape") and hasattr(x, "dtype") else x,
                 template)
             return ckptr.restore(path, abstract)
         return ckptr.restore(path)
