@@ -223,3 +223,55 @@ def test_dataloader_feeds_training():
             loss, params, opt_state = jstep(params, opt_state, tokens, targets)
         assert np.isfinite(np.asarray(loss))
         assert tt.cache_misses(jstep) == 1
+
+
+# ---------------------------------------------------------------------------
+# dev transforms (reference thunder/dev_utils/), langctx, numpy dialect
+# ---------------------------------------------------------------------------
+
+def test_debug_transform_sees_every_op():
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    from thunder_tpu.dev_utils import DebugTransform
+    import numpy as np
+
+    seen = []
+    tr = DebugTransform(lambda name, bsym, vals: seen.append(name))
+    jf = tt.jit(lambda x: ops.add(ops.mul(x, 2.0), 1.0), transforms=[tr],
+                executors=["eagerjax"])
+    out = np.asarray(jf(np.ones(4, np.float32)))
+    np.testing.assert_allclose(out, np.full(4, 3.0))
+    assert len(seen) >= 2  # mul and add observed
+
+
+def test_profile_transform_preserves_results():
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    from thunder_tpu.dev_utils import ProfileTransform
+    import numpy as np
+
+    jf = tt.jit(lambda x: ops.add(ops.mul(x, 2.0), 1.0), transforms=[ProfileTransform()])
+    out = np.asarray(jf(np.ones(4, np.float32)))
+    np.testing.assert_allclose(out, np.full(4, 3.0))
+
+
+def test_langctx_resolution():
+    from thunder_tpu.core.langctxs import Languages, langctx, resolve_method
+
+    add_ops = resolve_method("add")
+    with langctx(Languages.NUMPY):
+        mult = resolve_method("multiply")
+    assert callable(add_ops) and callable(mult)
+
+
+def test_numpy_dialect_semantics():
+    import thunder_tpu as tt
+    import thunder_tpu.numpy as tnp
+    import numpy as np
+
+    def f(x):
+        return tnp.sum(tnp.multiply(x, x), axis=1, keepdims=True)
+
+    out = np.asarray(tt.jit(f)(np.arange(6, dtype=np.float32).reshape(2, 3)))
+    ref = (np.arange(6, dtype=np.float32).reshape(2, 3) ** 2).sum(1, keepdims=True)
+    np.testing.assert_allclose(out, ref)

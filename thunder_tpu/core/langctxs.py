@@ -1,0 +1,99 @@
+"""Language contexts: pluggable operation-namespace resolution.
+
+Reference parity: ``thunder/core/langctxs.py`` (``LanguageContext`` registry,
+``resolve_method`` :66, ``langctx`` manager :118, ``Languages`` enum :103).
+Here the primary language is ``ops`` (the torch-capability surface); the
+numpy dialect (``thunder_tpu.numpy``) registers as a second language —
+proof the op surface is a *dialect* over the same prims, as in the
+reference's torch/clang/numpy split.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from enum import Enum
+from typing import Any, Callable
+
+
+class Languages(Enum):
+    OPS = "ops"
+    NUMPY = "numpy"
+    PRIMS = "prims"
+
+
+class LanguageContext:
+    def __init__(self, name: str):
+        self.name = name
+        self._methods: dict[str, Callable] = {}
+
+    def register_method(self, name: str, fn: Callable) -> None:
+        self._methods[name] = fn
+
+    def get_method(self, name: str) -> Callable | None:
+        return self._methods.get(name)
+
+
+_registry: dict[str, LanguageContext] = {}
+_stack: list[str] = []
+
+
+def register_langctx(lang: Languages | str, ctx: LanguageContext) -> LanguageContext:
+    _registry[lang.value if isinstance(lang, Languages) else lang] = ctx
+    return ctx
+
+
+def get_langctx(lang: Languages | str | None = None) -> LanguageContext:
+    if lang is None:
+        name = _stack[-1] if _stack else Languages.OPS.value
+    else:
+        name = lang.value if isinstance(lang, Languages) else lang
+    if name not in _registry:
+        _bootstrap()
+    return _registry[name]
+
+
+def resolve_method(name: str, lang: Languages | str | None = None) -> Callable:
+    ctx = get_langctx(lang)
+    fn = ctx.get_method(name)
+    if fn is None:
+        raise AttributeError(f"language {ctx.name!r} has no method {name!r}")
+    return fn
+
+
+@contextmanager
+def langctx(lang: Languages | str):
+    name = lang.value if isinstance(lang, Languages) else lang
+    _stack.append(name)
+    try:
+        yield get_langctx(name)
+    finally:
+        _stack.pop()
+
+
+def _bootstrap() -> None:
+    """Register the built-in languages on first use."""
+    if Languages.OPS.value not in _registry:
+        from thunder_tpu import ops as _ops
+
+        ctx = LanguageContext("ops")
+        for n in dir(_ops):
+            f = getattr(_ops, n)
+            if callable(f) and not n.startswith("_"):
+                ctx.register_method(n, f)
+        register_langctx(Languages.OPS, ctx)
+    if Languages.PRIMS.value not in _registry:
+        from thunder_tpu.core import prims as _prims
+
+        ctx = LanguageContext("prims")
+        for n in dir(_prims):
+            f = getattr(_prims, n)
+            if callable(f) and not n.startswith("_"):
+                ctx.register_method(n, f)
+        register_langctx(Languages.PRIMS, ctx)
+    if Languages.NUMPY.value not in _registry:
+        import thunder_tpu.numpy as _tnp
+
+        ctx = LanguageContext("numpy")
+        for n in getattr(_tnp, "__all__", []):
+            ctx.register_method(n, getattr(_tnp, n))
+        register_langctx(Languages.NUMPY, ctx)

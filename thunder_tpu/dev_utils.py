@@ -1,0 +1,103 @@
+"""Developer transforms: per-op debug callbacks and profiler annotation.
+
+Reference parity: ``thunder/dev_utils/`` — ``DebugTransform``
+(``debug_transform.py:15``, inject callbacks per bound symbol) and
+``NvtxProfileTransform`` (``nvtx_profile_transform.py:42``, wrap every bsym
+in nvtx push/pop). TPU equivalents: python-level callbacks interleaved into
+the generated program, and ``jax.profiler`` trace annotations around
+executor callables (visible in TensorBoard / Perfetto next to the XLA
+timeline — the NVTX analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.symbol import BoundSymbol, Symbol
+from thunder_tpu.core.trace import TraceCtx, from_trace
+from thunder_tpu.core.transform_common import Transform
+
+_SKIP = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL)
+
+
+class DebugTransform(Transform):
+    """Interleave ``callback(name, bsym, outputs)`` after every executed
+    operation of the final program. The callback receives concrete arrays —
+    use for nan-hunting, per-op logging, or golden-value capture."""
+
+    def __init__(self, callback: Callable[[str, BoundSymbol, Any], None]):
+        self.callback = callback
+
+    def transform_trace_post_optimization(self, trc: TraceCtx, **kwargs) -> TraceCtx:
+        new = from_trace(trc)
+        bsyms: list[BoundSymbol] = []
+        cb = self.callback
+        for i, bsym in enumerate(trc.bound_symbols):
+            bsyms.append(bsym)
+            if bsym.sym.id in _SKIP:
+                continue
+            outs = bsym.flat_proxy_outs()
+            if not outs:
+                continue
+            name = bsym.sym.codegen_name()
+
+            def make_impl(_name, _bsym):
+                def debug_cb(*vals):
+                    cb(_name, _bsym, vals)
+                    return None
+
+                return debug_cb
+
+            dbg = Symbol(f"debug_{i}", None, id=f"debug:{i}", is_prim=True,
+                         python_impl=make_impl(name, bsym))
+            bsyms.append(dbg.bind(*outs, output=None))
+        new.bound_symbols = bsyms
+        new.set_provenance("Debug transform")
+        return new
+
+
+class ProfileTransform(Transform):
+    """Wrap every executor callable in a ``jax.profiler.TraceAnnotation`` so
+    per-region spans appear in profiler traces alongside XLA ops."""
+
+    def __init__(self, prefix: str = "thunder_tpu"):
+        self.prefix = prefix
+
+    def transform_trace_post_optimization(self, trc: TraceCtx, **kwargs) -> TraceCtx:
+        import jax
+
+        new = from_trace(trc)
+        bsyms: list[BoundSymbol] = []
+        for bsym in trc.bound_symbols:
+            if bsym.sym.id in _SKIP or bsym.sym.python_impl is None:
+                bsyms.append(bsym)
+                continue
+            name = f"{self.prefix}.{bsym.sym.codegen_name()}"
+            inner = bsym.sym.python_impl
+
+            def make_impl(_name, _inner):
+                def profiled(*args, **kw):
+                    with jax.profiler.TraceAnnotation(_name):
+                        return _inner(*args, **kw)
+
+                return profiled
+
+            sym = Symbol(bsym.sym.name, bsym.sym.meta, id=bsym.sym.id,
+                         is_prim=bsym.sym.is_prim, executor=bsym.sym.executor,
+                         python_impl=make_impl(name, inner), tags=bsym.sym.tags)
+            bsyms.append(bsym.from_bsym(sym=sym))
+        new.bound_symbols = bsyms
+        new.set_provenance("Profile transform")
+        return new
+
+
+def profile_run(fn: Callable, logdir: str, *args, **kwargs):
+    """Run ``fn`` under a jax profiler trace written to ``logdir`` (view in
+    TensorBoard or Perfetto)."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+    return out

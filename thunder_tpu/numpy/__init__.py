@@ -1,0 +1,77 @@
+"""NumPy dialect: numpy-flavored names/semantics over the same prims.
+
+Reference parity: ``thunder/numpy/__init__.py`` (134 LoC, ``add``/``size``
+only — a proof of the multi-language design). Same role, slightly wider:
+numpy naming (``multiply``, ``concatenate``, axis kwargs, ``keepdims``)
+resolving into the shared op surface, registered as a language context.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu import ops as _ops
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "negative", "absolute", "abs",
+    "exp", "log", "sqrt", "tanh", "sum", "mean", "amax", "amin", "argmax",
+    "argmin", "reshape", "transpose", "concatenate", "stack", "where",
+    "matmul", "size", "zeros_like", "ones_like",
+]
+
+add = _ops.add
+subtract = _ops.sub
+multiply = _ops.mul
+divide = _ops.true_divide
+negative = _ops.neg
+absolute = _ops.abs
+abs = _ops.abs
+exp = _ops.exp
+log = _ops.log
+sqrt = _ops.sqrt
+tanh = _ops.tanh
+matmul = _ops.matmul
+reshape = _ops.reshape
+stack = _ops.stack
+where = _ops.where
+zeros_like = _ops.zeros_like
+ones_like = _ops.ones_like
+
+
+def sum(a, axis=None, keepdims=False):  # noqa: A001 — numpy naming
+    return _ops.sum(a, axis, keepdim=keepdims)
+
+
+def mean(a, axis=None, keepdims=False):
+    return _ops.mean(a, axis, keepdim=keepdims)
+
+
+def amax(a, axis=None, keepdims=False):
+    return _ops.amax(a, axis, keepdim=keepdims)
+
+
+def amin(a, axis=None, keepdims=False):
+    return _ops.amin(a, axis, keepdim=keepdims)
+
+
+def argmax(a, axis=None):
+    return _ops.argmax(a, axis)
+
+
+def argmin(a, axis=None):
+    return _ops.argmin(a, axis)
+
+
+def transpose(a, axes=None):
+    if axes is None:
+        axes = tuple(reversed(range(a.ndim)))
+    return _ops.transpose(a, tuple(axes))
+
+
+def concatenate(arrays, axis=0):
+    return _ops.cat(list(arrays), axis)
+
+
+def size(a) -> int:
+    n = 1
+    for d in a.shape:
+        n *= int(d)
+    return n
