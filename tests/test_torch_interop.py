@@ -1,0 +1,277 @@
+"""torch-interop dialect tests: trace unmodified torch.nn.Modules and
+torch-calling functions into thunder_tpu, compare numerics vs torch eager.
+
+Reference test model: ``thunder/tests/test_jit_general.py`` /
+``test_networks.py`` (nanoGPT & friends compiled via the bytecode
+interpreter); here acquisition is __torch_function__-based.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn
+import torch.nn.functional as F
+
+import thunder_tpu
+import thunder_tpu.torch as ttorch
+
+
+def assert_close(jax_val, torch_val, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(
+        np.asarray(jax_val), torch_val.detach().cpu().numpy(), rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# function tracing
+# ---------------------------------------------------------------------------
+
+def test_function_arith_and_methods():
+    def fn(x, y):
+        z = torch.add(x, y, alpha=2.0)
+        z = z.transpose(0, 1).contiguous().view(-1)
+        z = torch.softmax(z, dim=0)
+        return (z * y.reshape(-1)).sum()
+
+    x = torch.randn(3, 4)
+    y = torch.randn(3, 4)
+    jfn = ttorch.jit(fn)
+    assert_close(jfn(x, y), fn(x, y))
+
+
+def test_function_factories_and_indexing():
+    def fn(x):
+        idx = torch.arange(0, x.shape[0])
+        base = torch.ones(x.shape, dtype=torch.float32)
+        picked = x[idx % 2 == 0] if False else x  # keep static
+        return picked * base + torch.eye(x.shape[0], x.shape[1])
+
+    x = torch.randn(4, 5)
+    assert_close(ttorch.jit(fn)(x), fn(x))
+
+
+def test_function_reductions_comparisons():
+    def fn(x):
+        m = x.mean(dim=1, keepdim=True)
+        s = x.std(dim=1, keepdim=True, unbiased=False)
+        n = (x - m) / (s + 1e-5)
+        return torch.where(n > 0, n, torch.zeros_like(n)).sum(dim=0)
+
+    x = torch.randn(6, 7)
+    assert_close(ttorch.jit(fn)(x), fn(x))
+
+
+def test_masked_fill_and_tril():
+    def fn(x):
+        mask = torch.tril(torch.ones(x.shape[-1], x.shape[-1])) == 0
+        return x.masked_fill(mask, float("-inf")).softmax(dim=-1)
+
+    x = torch.randn(2, 5, 5)
+    assert_close(ttorch.jit(fn)(x), fn(x))
+
+
+# ---------------------------------------------------------------------------
+# module tracing
+# ---------------------------------------------------------------------------
+
+class MLP(nn.Module):
+    def __init__(self, d=16):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 4 * d)
+        self.fc2 = nn.Linear(4 * d, d)
+        self.ln = nn.LayerNorm(d)
+
+    def forward(self, x):
+        h = F.gelu(self.fc1(self.ln(x)), approximate="tanh")
+        return x + self.fc2(h)
+
+
+def test_module_mlp_forward():
+    m = MLP().eval()
+    tm = ttorch.jit(m)
+    x = torch.randn(4, 16)
+    assert_close(tm(x), m(x))
+
+
+class TinyAttention(nn.Module):
+    def __init__(self, d=32, h=4, maxlen=16):
+        super().__init__()
+        self.qkv = nn.Linear(d, 3 * d)
+        self.proj = nn.Linear(d, d)
+        self.h = h
+        self.register_buffer("bias", torch.tril(torch.ones(maxlen, maxlen)))
+
+    def forward(self, x):
+        B, T, C = x.shape
+        q, k, v = self.qkv(x).chunk(3, dim=-1)
+        q = q.view(B, T, self.h, C // self.h).transpose(1, 2)
+        k = k.view(B, T, self.h, C // self.h).transpose(1, 2)
+        v = v.view(B, T, self.h, C // self.h).transpose(1, 2)
+        att = (q @ k.transpose(-2, -1)) * (1.0 / (C // self.h) ** 0.5)
+        att = att.masked_fill(self.bias[:T, :T] == 0, float("-inf"))
+        att = F.softmax(att, dim=-1)
+        y = att @ v
+        y = y.transpose(1, 2).contiguous().view(B, T, C)
+        return self.proj(y)
+
+
+def test_module_attention_manual():
+    m = TinyAttention().eval()
+    tm = ttorch.jit(m)
+    x = torch.randn(2, 8, 32)
+    assert_close(tm(x), m(x), rtol=1e-3, atol=1e-4)
+
+
+class SDPABlock(nn.Module):
+    def __init__(self, d=32, h=4):
+        super().__init__()
+        self.qkv = nn.Linear(d, 3 * d)
+        self.h = h
+
+    def forward(self, x):
+        B, T, C = x.shape
+        q, k, v = self.qkv(x).chunk(3, dim=-1)
+        q = q.view(B, T, self.h, C // self.h).transpose(1, 2)
+        k = k.view(B, T, self.h, C // self.h).transpose(1, 2)
+        v = v.view(B, T, self.h, C // self.h).transpose(1, 2)
+        y = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return y.transpose(1, 2).reshape(B, T, C)
+
+
+def test_module_sdpa():
+    m = SDPABlock().eval()
+    tm = ttorch.jit(m)
+    x = torch.randn(2, 8, 32)
+    assert_close(tm(x), m(x), rtol=1e-3, atol=1e-4)
+
+
+def test_module_embedding_tied_head():
+    class Tied(nn.Module):
+        def __init__(self, v=11, d=8):
+            super().__init__()
+            self.emb = nn.Embedding(v, d)
+            self.head = nn.Linear(d, v, bias=False)
+            self.head.weight = self.emb.weight  # weight tying
+
+        def forward(self, ids):
+            return self.head(self.emb(ids))
+
+    m = Tied().eval()
+    tm = ttorch.jit(m)
+    ids = torch.randint(0, 11, (3, 5))
+    assert_close(tm(ids), m(ids), rtol=1e-4, atol=1e-5)
+    # tied sites must trace to the same input: only one distinct param value
+    vals = {id(v) for _, v in tm.named_parameters()}
+    assert len(vals) == 1
+
+
+def test_module_batchnorm_running_stats_epilogue():
+    m = nn.BatchNorm1d(6)
+    m.train()
+    tm = ttorch.jit(m)
+    x = torch.randn(8, 6)
+    out = tm(x)
+    ref = m(x)  # torch mutates running stats in-place
+    assert_close(out, ref, rtol=1e-4, atol=1e-5)
+    # buffer write-back (epilogue): running stats updated in the jax state
+    assert_close(tm._buffers["running_mean"], m.running_mean, rtol=1e-4, atol=1e-5)
+    assert_close(tm._buffers["running_var"], m.running_var, rtol=1e-4, atol=1e-5)
+    # second call keeps accumulating
+    x2 = torch.randn(8, 6)
+    tm(x2)
+    m(x2)
+    assert_close(tm._buffers["running_mean"], m.running_mean, rtol=1e-4, atol=1e-5)
+
+
+def test_module_train_eval_recompiles():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5), nn.Linear(4, 4))
+    tm = ttorch.jit(m)
+    x = torch.randn(2, 4)
+    tm.eval()
+    out_eval = tm(x)
+    assert_close(out_eval, m.eval()(x))
+    tm.train()
+    thunder_tpu.manual_seed(0)
+    out_train = tm(x)  # different compiled entry (dropout active)
+    assert tm._jfn.cache_misses == 2
+    assert not np.allclose(np.asarray(out_train), np.asarray(out_eval))
+
+
+def test_module_inplace_functionalization():
+    class InPlace(nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y = y.mul_(2.0)
+            y = y.add_(1.0)
+            y.clamp_(min=0.0)
+            return y
+
+    m = InPlace()
+    x = torch.randn(3, 3)
+    tm = ttorch.jit(m)
+    assert_close(tm(x), m(x))
+    # the trace is pure SSA: no in-place ops survive acquisition
+    trc = thunder_tpu.last_traces(tm._jfn)[-1]
+    assert "add_" not in trc.python() and "mul_" not in trc.python()
+
+
+def test_state_dict_roundtrip():
+    m = MLP().eval()
+    tm = ttorch.jit(m)
+    sd = tm.state_dict()
+    m2 = MLP().eval()
+    m2.load_state_dict(sd)
+    tm2 = ttorch.jit(m2)
+    x = torch.randn(2, 16)
+    assert_close(tm2(x), m(x))
+    tm2.load_state_dict(tm.state_dict())
+    assert_close(tm2(x), m(x))
+
+
+# ---------------------------------------------------------------------------
+# training through functional_call + thunder_tpu.grad
+# ---------------------------------------------------------------------------
+
+def test_functional_call_grad_matches_torch_autograd():
+    m = MLP(d=8)
+    m.eval()
+    x = torch.randn(4, 8)
+
+    def loss_fn(params, xv):
+        (out), _ = ttorch.functional_call(m, params, (xv,))
+        return thunder_tpu.ops.sum(thunder_tpu.ops.mul(out, out))
+
+    params = {k: ttorch.tensor_to_jax(v) for k, v in m.named_parameters()}
+    g = thunder_tpu.jit(thunder_tpu.grad(loss_fn))(params, ttorch.tensor_to_jax(x))
+
+    xt = x.clone().requires_grad_(False)
+    out = m(xt)
+    loss = (out * out).sum()
+    tg = torch.autograd.grad(loss, list(m.parameters()))
+    names = [k for k, _ in m.named_parameters()]
+    for name, ref in zip(names, tg):
+        assert_close(g[name], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_unmapped_op_reports_clearly():
+    def fn(x):
+        return torch.fft.fft(x)
+
+    with pytest.raises(NotImplementedError, match="no thunder_tpu mapping"):
+        ttorch.jit(fn)(torch.randn(4))
+
+
+def test_max_min_sort_narrow_torch_conventions():
+    x = torch.randn(4, 6)
+
+    def fn(x):
+        v1, i1 = torch.max(x, dim=1)
+        v2, i2 = x.min(dim=-1)
+        sv, si = torch.sort(x, dim=-1, descending=True)
+        tail = x.narrow(0, -2, 2)
+        return v1 + v2, i1 + i2, sv, si, tail
+
+    got = ttorch.jit(fn)(x)
+    ref = fn(x)
+    for g, r in zip(got, ref):
+        assert_close(g, r)

@@ -64,6 +64,14 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
         check(get_eager_impl(bsym.sym) is not None or bsym.sym.python_impl is not None,
               lambda: f"no executor can run prim {bsym.sym.name}")
         return [bsym]
+    if len(bsym.subsymbols) == 0:
+        # identity composite (e.g. eval-mode dropout returns its input):
+        # every output proxy is an input proxy, so nothing needs emitting —
+        # downstream bsyms already reference the producing names
+        arg_names = {p.name for p in bsym.flat_proxy_args()}
+        outs = bsym.flat_proxy_outs()
+        if outs and all(p.name in arg_names for p in outs):
+            return []
     check(len(bsym.subsymbols) > 0, lambda: f"unclaimed symbol {bsym.sym.name} has no decomposition")
     out: list[BoundSymbol] = []
     for sub in bsym.subsymbols:
