@@ -1,0 +1,103 @@
+"""Extend API + compile-option plumbing tests.
+
+Reference model: ``thunder/tests/test_extend.py`` (custom multimul executor)
+and the ``get_compile_option`` self-registering flag query
+(``thunder/core/compile_data.py:57``).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+import thunder_tpu
+from thunder_tpu import ops
+from thunder_tpu.core.compile_data import get_compile_option
+from thunder_tpu.executors import (
+    OperatorExecutor,
+    get_executor,
+    register_executor,
+    single_op_executor,
+)
+
+
+def test_single_op_executor_claims_op():
+    calls = []
+
+    def fast_gelu_impl(a, approximate="none"):
+        calls.append("pallas-style kernel")
+        return jnp.asarray(a) * 0 + 42.0  # sentinel: prove the claim happened
+
+    ex = single_op_executor("fastgelu_test", "fast_gelu", fast_gelu_impl,
+                            like=ops.gelu, register=False)
+
+    def fn(x):
+        return ops.gelu(x)
+
+    jfn = thunder_tpu.jit(fn, executors=[ex])
+    out = jfn(jnp.ones((4,)))
+    assert calls, "custom executor impl was not invoked"
+    np.testing.assert_allclose(np.asarray(out), 42.0)
+    # without the executor, normal decomposition runs
+    jfn2 = thunder_tpu.jit(fn)
+    out2 = jfn2(jnp.ones((4,)))
+    assert abs(float(out2[0]) - 0.8413) < 1e-3
+
+
+def test_operator_executor_checker_rejects():
+    ex = OperatorExecutor("checker_test")
+    sym = ex.register_operator("gelu_smallonly", like=ops.gelu,
+                               fn=lambda a, approximate="none": jnp.asarray(a) * 0 - 1.0)
+    # checker: only claim rank-2 inputs — rank-1 falls through to decomposition
+    ex.register_implementation(ops.gelu.id, sym,
+                               checker=lambda a, **kw: a.ndim == 2)
+
+    jfn = thunder_tpu.jit(lambda x: ops.gelu(x), executors=[ex])
+    out1 = jfn(jnp.ones((4,)))
+    assert abs(float(out1[0]) - 0.8413) < 1e-3  # not claimed
+    out2 = jfn(jnp.ones((2, 2)))
+    np.testing.assert_allclose(np.asarray(out2), -1.0)  # claimed
+
+
+def test_compile_options_queried_and_reported():
+    def fn(x):
+        return ops.mul(ops.add(x, 1.0), 2.0)
+
+    jfn = thunder_tpu.jit(fn, xla_min_region_size=100, not_a_real_option=True)
+    x = jnp.ones((4,))
+    out = jfn(x)
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+    report = thunder_tpu.last_compile_options(jfn)
+    assert "xla_min_region_size [set]" in report
+    assert "not_a_real_option" in report and "never queried" in report
+    # with region size forced above the trace length, no fusion happened
+    src = thunder_tpu.last_traces(jfn)[-1].python()
+    assert "xla_fusion" not in src
+
+
+def test_xla_disable_fusion_option():
+    def fn(x):
+        return ops.mul(ops.add(x, 1.0), 2.0)
+
+    jfn = thunder_tpu.jit(fn, xla_disable_fusion=True)
+    np.testing.assert_allclose(np.asarray(jfn(jnp.ones((4,)))), 4.0)
+    assert "xla_fusion" not in thunder_tpu.last_traces(jfn)[-1].python()
+    jfn2 = thunder_tpu.jit(fn)
+    np.testing.assert_allclose(np.asarray(jfn2(jnp.ones((4,)))), 4.0)
+    assert "xla_fusion" in thunder_tpu.last_traces(jfn2)[-1].python()
+
+
+def test_get_compile_option_default_outside_compile():
+    assert get_compile_option("whatever", "desc", 7) == 7
+
+
+def test_jit_dispatches_torch_modules():
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    from thunder_tpu.torch import ThunderModule
+
+    m = torch.nn.Linear(3, 3)
+    tm = thunder_tpu.jit(m)
+    assert isinstance(tm, ThunderModule)
+    x = torch.randn(2, 3)
+    np.testing.assert_allclose(np.asarray(tm(x)), m(x).detach().numpy(),
+                               rtol=1e-5, atol=1e-6)

@@ -125,7 +125,7 @@ class ThunderTPUFunction:
     def __init__(self, fn: Callable, *, executors=None, cache: str = "constant values",
                  transforms: Sequence[Transform] = (), enable_cse: bool = True,
                  insert_dels: bool = True, sharp_edges: str = "allow",
-                 fn_name: str | None = None):
+                 fn_name: str | None = None, **compile_options):
         from thunder_tpu.executors import resolve_executors
 
         check(cache in _CACHE_OPTIONS, lambda: f"unknown cache option {cache!r}")
@@ -141,6 +141,8 @@ class ThunderTPUFunction:
         self.fn_name = fn_name or getattr(fn, "__name__", "fn")
         self._cache: dict = {}
         self._stats = CompileStats()
+        self.compile_options = dict(compile_options)
+        self._compile_ctx = None  # last CompileContext (option usage report)
         self.__name__ = f"thunder_tpu.jit({self.fn_name})"
 
     def _leaf_cache_key(self, leaf):
@@ -232,6 +234,13 @@ class ThunderTPUFunction:
         return pro
 
     def _compile(self, flat, treedef, args, kwargs) -> CacheEntry:
+        from thunder_tpu.core.compile_data import CompileContext, compile_context
+
+        self._compile_ctx = CompileContext(self.compile_options)
+        with compile_context(self._compile_ctx):
+            return self._compile_inner(flat, treedef, args, kwargs)
+
+    def _compile_inner(self, flat, treedef, args, kwargs) -> CacheEntry:
         from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 
         t0 = time.perf_counter_ns()
@@ -299,8 +308,13 @@ class ThunderTPUFunction:
 
 def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant values",
         transforms: Sequence[Transform] = (), enable_cse: bool = True,
-        insert_dels: bool = True, sharp_edges: str = "allow") -> ThunderTPUFunction:
+        insert_dels: bool = True, sharp_edges: str = "allow",
+        **compile_options) -> ThunderTPUFunction:
     """Compile ``fn``: trace → transform → dispatch to executors.
+
+    Free-form ``**compile_options`` are queried lazily by passes/executors via
+    ``thunder_tpu.core.compile_data.get_compile_option``; see
+    ``last_compile_options`` for the used/unused report.
 
     Reference: ``thunder.jit`` (``thunder/__init__.py:262``).
     """
@@ -308,12 +322,21 @@ def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant va
         def deco(f):
             return jit(f, executors=executors, cache=cache, transforms=transforms,
                        enable_cse=enable_cse, insert_dels=insert_dels,
-                       sharp_edges=sharp_edges)
+                       sharp_edges=sharp_edges, **compile_options)
 
         return deco
+    import sys
+
+    _torch = sys.modules.get("torch")
+    if _torch is not None and isinstance(fn, _torch.nn.Module):
+        from thunder_tpu.torch import jit as torch_jit
+
+        return torch_jit(fn, executors=executors, cache=cache, transforms=transforms,
+                         enable_cse=enable_cse, insert_dels=insert_dels,
+                         sharp_edges=sharp_edges, **compile_options)
     return ThunderTPUFunction(fn, executors=executors, cache=cache, transforms=transforms,
                               enable_cse=enable_cse, insert_dels=insert_dels,
-                              sharp_edges=sharp_edges)
+                              sharp_edges=sharp_edges, **compile_options)
 
 
 # ---------------------------------------------------------------------------
@@ -383,6 +406,27 @@ def cache_misses(jfn) -> int:
 
 def compile_stats(jfn) -> CompileStats:
     return _as_tfn(jfn)._stats
+
+
+def last_compile_options(jfn) -> str:
+    """Report which compile options the last compilation queried (with their
+    self-registered descriptions) and which passed options were never used
+    (reference ``thunder/__init__.py:980-1015``)."""
+    from thunder_tpu.core.compile_data import used_and_unused_options
+
+    ctx = _as_tfn(jfn)._compile_ctx
+    if ctx is None:
+        return "no compilation has run yet"
+    queried, unused = used_and_unused_options(ctx)
+    lines = ["queried compile options:"]
+    for name, desc in sorted(queried.items()):
+        mark = "set" if name in ctx.options else "default"
+        lines.append(f"  {name} [{mark}]: {desc}")
+    if unused:
+        lines.append("passed but never queried (possibly misspelled):")
+        for name in sorted(unused):
+            lines.append(f"  {name}")
+    return "\n".join(lines)
 
 
 # re-exports

@@ -103,6 +103,27 @@ class FusionExecutor(Executor):
         raise NotImplementedError
 
 
+def single_op_executor(executor_name: str, op_name: str, fn: Callable, *,
+                       meta: Callable | None = None, like: Symbol | None = None,
+                       checker: Callable | None = None,
+                       grad_transform: Callable | None = None,
+                       register: bool = True) -> OperatorExecutor:
+    """Create an OperatorExecutor claiming exactly one operation — the
+    smallest possible custom-kernel integration (reference
+    ``thunder/extend/__init__.py:282``).
+
+    ``fn`` is the runtime callable; ``like`` (an existing Symbol, e.g. an op
+    from ``thunder_tpu.ops``) supplies the meta and the claimed id.
+    """
+    ex = OperatorExecutor(executor_name)
+    sym = ex.register_operator(op_name, meta=meta, like=like, fn=fn)
+    target = like.id if like is not None else op_name
+    ex.register_implementation(target, sym, checker=checker, grad_transform=grad_transform)
+    if register:
+        register_executor(ex)
+    return ex
+
+
 # ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------

@@ -96,6 +96,16 @@ class XLAFusionExecutor(FusionExecutor):
         return get_eager_impl(bsym.sym) is not None
 
     def fusion_pass(self, trc: TraceCtx) -> TraceCtx:
+        from thunder_tpu.core.compile_data import get_compile_option
+
+        if get_compile_option("xla_disable_fusion",
+                              "skip XLA region fusion entirely (all ops run eagerly); "
+                              "bisection/debugging aid", False):
+            return trc
+        min_region_size = get_compile_option(
+            "xla_min_region_size",
+            "minimum bound symbols per XLA fusion region; smaller regions stay eager",
+            self.min_region_size)
         # outputs of the whole trace stay live
         live_out = {Variable(o) for o in tree_flatten(trc.output)[0] if isinstance(o, Proxy)}
 
@@ -137,7 +147,7 @@ class XLAFusionExecutor(FusionExecutor):
                 new_bsyms.append(e)
                 continue
             gbsyms = groups[e[1]]
-            if len(gbsyms) < self.min_region_size:
+            if len(gbsyms) < min_region_size:
                 new_bsyms.extend(gbsyms)
                 continue
             new_bsyms.append(self._make_fusion_bsym(gbsyms, suffix_sets[i], new))
