@@ -353,3 +353,236 @@ def _ref_sdpa(q, k, v, attn_mask, is_causal, scale):
             scores = scores + attn_mask
     probs = jax.nn.softmax(scores, axis=-1)
     return probs @ v
+
+
+# -- wider-surface composites -------------------------------------------------
+
+for name, ref, lo, hi, grad in [
+    ("frac", lambda a: a - jnp.trunc(a), -3, 3, True),
+    ("deg2rad", jnp.deg2rad, -180, 180, True),
+    ("rad2deg", jnp.rad2deg, -3, 3, True),
+    ("sinc", jnp.sinc, -2, 2, True),
+    ("square", jnp.square, -2, 2, True),
+    ("relu6", lambda a: jnp.clip(a, 0, 6), -8, 8, True),
+    ("hardswish", jax.nn.hard_swish, -4, 4, True),
+    ("hardsigmoid", jax.nn.hard_sigmoid, -4, 4, True),
+    ("elu", jax.nn.elu, -2, 2, True),
+    ("selu", jax.nn.selu, -2, 2, True),
+    ("celu", jax.nn.celu, -2, 2, True),
+    ("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), -2, 2, True),
+    ("softsign", jax.nn.soft_sign, -2, 2, True),
+    ("tanhshrink", lambda a: a - jnp.tanh(a), -2, 2, True),
+    ("log_sigmoid", jax.nn.log_sigmoid, -3, 3, True),
+    ("softplus", jax.nn.softplus, -3, 3, True),
+    ("leaky_relu", jax.nn.leaky_relu, -2, 2, True),
+]:
+    register(OpInfo(name, getattr(ops, name), ref, _unary_samples(lo, hi),
+                    supports_grad=grad, atol=1e-4, rtol=1e-4))
+
+register(OpInfo("logit", ops.logit, jax.scipy.special.logit,
+                _unary_samples(0.1, 0.9), atol=1e-4))
+register(OpInfo("nan_to_num", ops.nan_to_num, jnp.nan_to_num,
+                lambda rng: [SampleInput((np.array([1.0, np.nan, np.inf, -np.inf, 2.0],
+                                                   dtype=np.float32),))],
+                supports_grad=False))
+register(OpInfo("heaviside", ops.heaviside, jnp.heaviside,
+                lambda rng: [SampleInput((_t(rng, 4, 4, lo=-2, hi=2), _t(rng, 4, 4, lo=0, hi=1)))],
+                supports_grad=False))
+
+for name, ref, lo, hi in [
+    ("xlogy", jax.scipy.special.xlogy, 0.2, 2),
+    ("logaddexp", jnp.logaddexp, -2, 2),
+    ("logaddexp2", jnp.logaddexp2, -2, 2),
+    ("hypot", jnp.hypot, 0.2, 2),
+]:
+    register(OpInfo(name, getattr(ops, name), ref, _binary_samples(lo, hi), atol=1e-4, rtol=1e-4))
+
+register(OpInfo("ldexp", ops.ldexp, lambda a, b: a * 2.0 ** b,
+                lambda rng: [SampleInput((_t(rng, 4, 4), rng.randint(-3, 4, size=(4, 4)).astype(np.float32)))],
+                atol=1e-4, rtol=1e-4))
+
+register(OpInfo("addcmul", ops.addcmul,
+                lambda a, t1, t2, value=1.0: a + value * t1 * t2,
+                lambda rng: [SampleInput((_t(rng, 3, 4), _t(rng, 3, 4), _t(rng, 3, 4)),
+                                         {"value": 0.5})]))
+register(OpInfo("logsumexp", ops.logsumexp,
+                lambda a, dim=None, keepdim=False: jax.scipy.special.logsumexp(
+                    a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4, lo=-3, hi=3), 1)),
+                             SampleInput((_t(rng, 3, 4, lo=-3, hi=3), -1, True))],
+                atol=1e-4))
+register(OpInfo("count_nonzero", ops.count_nonzero,
+                lambda a, dim=None: jnp.count_nonzero(a, axis=dim),
+                lambda rng: [SampleInput((np.array([[0.0, 1.0, 2.0], [0.0, 0.0, 3.0]],
+                                                   dtype=np.float32),))],
+                supports_grad=False))
+register(OpInfo("nansum", ops.nansum,
+                lambda a, dim=None, keepdim=False: jnp.nansum(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((np.array([[1.0, np.nan], [2.0, 3.0]],
+                                                   dtype=np.float32),))],
+                supports_grad=False))
+register(OpInfo("nanmean", ops.nanmean,
+                lambda a, dim=None, keepdim=False: jnp.nanmean(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((np.array([[1.0, np.nan], [2.0, 3.0]],
+                                                   dtype=np.float32), 1))],
+                supports_grad=False))
+register(OpInfo("vector_norm", ops.vector_norm,
+                lambda a, ord=2, dim=None, keepdim=False: jnp.linalg.norm(
+                    a, ord=ord, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), 2, 1)),
+                             SampleInput((_t(rng, 3, 4), 1, 1)),
+                             SampleInput((_t(rng, 3, 4), float("inf"), 1))],
+                grad_sample_filter=lambda s: s.args[1] == 2, atol=1e-4))
+register(OpInfo("median", lambda a, dim=-1: ops.median(a, dim),
+                lambda a, dim=-1: jnp.quantile(a, 0.5, axis=dim, method="lower"),
+                lambda rng: [SampleInput((_t(rng, 3, 5), 1))], supports_grad=False))
+register(OpInfo("glu", ops.glu, jax.nn.glu,
+                lambda rng: [SampleInput((_t(rng, 3, 8), -1))], atol=1e-4))
+register(OpInfo("prelu", ops.prelu,
+                lambda a, w: jnp.where(a > 0, a, w * a),
+                lambda rng: [SampleInput((_t(rng, 3, 4), np.float32(0.25)))]))
+register(OpInfo("hardtanh", ops.hardtanh,
+                lambda a, lo=-1.0, hi=1.0: jnp.clip(a, lo, hi),
+                _unary_samples(-3, 3)))
+register(OpInfo("hardshrink", ops.hardshrink,
+                lambda a, l=0.5: jnp.where(jnp.abs(a) > l, a, 0.0),
+                _unary_samples(-2, 2)))
+register(OpInfo("softshrink", ops.softshrink,
+                lambda a, l=0.5: jnp.where(a > l, a - l, jnp.where(a < -l, a + l, 0.0)),
+                _unary_samples(-2, 2)))
+register(OpInfo("threshold", lambda a: ops.threshold(a, 0.5, -7.0),
+                lambda a: jnp.where(a > 0.5, a, -7.0), _unary_samples(-2, 2)))
+register(OpInfo("softmin", ops.softmin,
+                lambda a, dim=-1: jax.nn.softmax(-a, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 3, 4), -1))], atol=1e-4))
+
+# shape additions
+register(OpInfo("broadcast_to", ops.broadcast_to, jnp.broadcast_to,
+                lambda rng: [SampleInput((_t(rng, 1, 4), (3, 4)))]))
+register(OpInfo("ravel", ops.ravel, jnp.ravel,
+                lambda rng: [SampleInput((_t(rng, 3, 4),))]))
+register(OpInfo("unflatten", ops.unflatten,
+                lambda a, d, s: jnp.reshape(a, a.shape[:d] + tuple(s) + a.shape[d + 1:]),
+                lambda rng: [SampleInput((_t(rng, 3, 12), 1, (3, 4)))]))
+register(OpInfo("tile", ops.tile, lambda a, dims: jnp.tile(a, dims),
+                lambda rng: [SampleInput((_t(rng, 2, 3), (2, 2))),
+                             SampleInput((_t(rng, 3), (2, 2)))]))
+register(OpInfo("tensor_split", lambda a, k, dim=0: ops.tensor_split(a, k, dim)[0],
+                lambda a, k, dim=0: jnp.array_split(a, k, axis=dim)[0],
+                lambda rng: [SampleInput((_t(rng, 7, 3), 3, 0))]))
+register(OpInfo("narrow", ops.narrow,
+                lambda a, dim, start, length: jax.lax.slice_in_dim(
+                    a, start if start >= 0 else start + a.shape[dim],
+                    (start if start >= 0 else start + a.shape[dim]) + length, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 5, 4), 0, 1, 3)),
+                             SampleInput((_t(rng, 5, 4), 0, -2, 2))]))
+register(OpInfo("select", ops.select,
+                lambda a, dim, i: jnp.take(a, i, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 5, 4), 1, 2))]))
+register(OpInfo("diagonal", ops.diagonal,
+                lambda a, offset=0, dim1=0, dim2=1: jnp.diagonal(a, offset, dim1, dim2),
+                lambda rng: [SampleInput((_t(rng, 4, 4),)),
+                             SampleInput((_t(rng, 4, 6), 1)),
+                             SampleInput((_t(rng, 4, 6), -2)),
+                             SampleInput((_t(rng, 2, 4, 4), 0, 1, 2))]))
+register(OpInfo("diag_vec", lambda a: ops.diag(a),
+                lambda a: jnp.diag(a),
+                lambda rng: [SampleInput((_t(rng, 4),))]))
+register(OpInfo("hstack", lambda a, b: ops.hstack([a, b]),
+                lambda a, b: jnp.hstack([a, b]),
+                lambda rng: [SampleInput((_t(rng, 3, 2), _t(rng, 3, 4))),
+                             SampleInput((_t(rng, 3), _t(rng, 4)))]))
+register(OpInfo("vstack", lambda a, b: ops.vstack([a, b]),
+                lambda a, b: jnp.vstack([a, b]),
+                lambda rng: [SampleInput((_t(rng, 2, 3), _t(rng, 4, 3)))]))
+
+# linalg additions
+register(OpInfo("mv", ops.mv, jnp.matmul,
+                lambda rng: [SampleInput((_t(rng, 4, 5), _t(rng, 5)))]))
+register(OpInfo("vdot", ops.vdot, jnp.vdot,
+                lambda rng: [SampleInput((_t(rng, 6), _t(rng, 6)))]))
+register(OpInfo("inner", ops.inner, jnp.inner,
+                lambda rng: [SampleInput((_t(rng, 4), _t(rng, 4))),
+                             SampleInput((_t(rng, 3, 4), _t(rng, 5, 4)))]))
+register(OpInfo("tensordot", ops.tensordot,
+                lambda a, b, dims=2: jnp.tensordot(a, b, axes=dims),
+                lambda rng: [SampleInput((_t(rng, 3, 4, 5), _t(rng, 4, 5, 6))),
+                             SampleInput((_t(rng, 3, 4), _t(rng, 4, 5)), {"dims": 1})]))
+register(OpInfo("cosine_similarity", ops.cosine_similarity,
+                lambda a, b, dim=1, eps=1e-8: jnp.sum(a * b, axis=dim) /
+                    jnp.maximum(jnp.linalg.norm(a, axis=dim) * jnp.linalg.norm(b, axis=dim), eps),
+                lambda rng: [SampleInput((_t(rng, 3, 5), _t(rng, 3, 5)))], atol=1e-4))
+register(OpInfo("cdist", ops.cdist,
+                lambda a, b, p=2.0: jnp.sqrt(jnp.maximum(jnp.sum(
+                    (a[..., :, None, :] - b[..., None, :, :]) ** 2, -1), 0.0)),
+                lambda rng: [SampleInput((_t(rng, 4, 3), _t(rng, 5, 3)))],
+                supports_grad=False, atol=1e-4))
+
+# nn additions
+from thunder_tpu.ops import nn as ops_nn  # noqa: E402
+
+register(OpInfo("l1_loss", ops_nn.l1_loss,
+                lambda i, t, reduction="mean": jnp.mean(jnp.abs(i - t)),
+                lambda rng: [SampleInput((_t(rng, 4, 5), _t(rng, 4, 5)))]))
+register(OpInfo("smooth_l1_loss", ops_nn.smooth_l1_loss,
+                lambda i, t, reduction="mean", beta=1.0: jnp.mean(jnp.where(
+                    jnp.abs(i - t) < beta, 0.5 * (i - t) ** 2 / beta,
+                    jnp.abs(i - t) - 0.5 * beta)),
+                lambda rng: [SampleInput((_t(rng, 4, 5, lo=-2, hi=2), _t(rng, 4, 5)))]))
+register(OpInfo("huber_loss", ops_nn.huber_loss,
+                lambda i, t, reduction="mean", delta=1.0: jnp.mean(jnp.where(
+                    jnp.abs(i - t) < delta, 0.5 * (i - t) ** 2,
+                    delta * (jnp.abs(i - t) - 0.5 * delta))),
+                lambda rng: [SampleInput((_t(rng, 4, 5, lo=-2, hi=2), _t(rng, 4, 5)))]))
+register(OpInfo("bce", ops_nn.binary_cross_entropy,
+                lambda i, t, weight=None, reduction="mean": jnp.mean(
+                    -(t * jnp.log(i) + (1 - t) * jnp.log(1 - i))),
+                lambda rng: [SampleInput((_t(rng, 4, 5, lo=0.1, hi=0.9),
+                                          _t(rng, 4, 5, lo=0, hi=1)))], atol=1e-4))
+register(OpInfo("bce_with_logits", ops_nn.binary_cross_entropy_with_logits,
+                lambda i, t, weight=None, pos_weight=None, reduction="mean": jnp.mean(
+                    jnp.maximum(i, 0) - i * t + jnp.log1p(jnp.exp(-jnp.abs(i)))),
+                lambda rng: [SampleInput((_t(rng, 4, 5, lo=-3, hi=3),
+                                          _t(rng, 4, 5, lo=0, hi=1)))], atol=1e-4))
+register(OpInfo("kl_div", ops_nn.kl_div,
+                lambda i, t, reduction="mean", log_target=False: jnp.mean(
+                    jax.scipy.special.xlogy(t, t) - t * i),
+                lambda rng: [SampleInput((_t(rng, 4, 5, lo=-2, hi=0),
+                                          _t(rng, 4, 5, lo=0.1, hi=0.9)))], atol=1e-4))
+register(OpInfo("nll_loss", ops_nn.nll_loss,
+                lambda lp, t, weight=None, ignore_index=-100, reduction="mean":
+                    -jnp.mean(jnp.take_along_axis(lp, t[:, None], axis=1)[:, 0]),
+                lambda rng: [SampleInput((_t(rng, 6, 5, lo=-3, hi=-0.1),
+                                          rng.randint(0, 5, size=(6,))))], atol=1e-4))
+register(OpInfo("max_pool2d", ops_nn.max_pool2d,
+                lambda a, k, stride=None, padding=0: jax.lax.reduce_window(
+                    a, -jnp.inf, jax.lax.max, (1, 1, k, k), (1, 1, stride or k, stride or k),
+                    [(0, 0), (0, 0), (padding, padding), (padding, padding)]),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 8, 8), 2)),
+                             SampleInput((_t(rng, 2, 3, 9, 9), 3), {"stride": 2, "padding": 1})],
+                atol=1e-5))
+register(OpInfo("avg_pool2d", ops_nn.avg_pool2d,
+                lambda a, k, stride=None, padding=0, count_include_pad=True:
+                    jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k, k),
+                                          (1, 1, stride or k, stride or k),
+                                          [(0, 0), (0, 0), (padding, padding),
+                                           (padding, padding)]) / (k * k),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 8, 8), 2))], atol=1e-5))
+register(OpInfo("adaptive_avg_pool2d", ops_nn.adaptive_avg_pool2d,
+                lambda a, os_: jnp.mean(jnp.reshape(
+                    a, a.shape[:-2] + (os_, a.shape[-2] // os_, os_, a.shape[-1] // os_)),
+                    axis=(-3, -1)),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 8, 8), 4))]))
+register(OpInfo("instance_norm", ops_nn.instance_norm,
+                lambda a, w=None, b=None, eps=1e-5: (a - jnp.mean(a, axis=(2, 3), keepdims=True))
+                    / jnp.sqrt(jnp.var(a, axis=(2, 3), keepdims=True) + eps),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4, 4),))], atol=1e-4))
+register(OpInfo("pixel_shuffle", ops_nn.pixel_shuffle,
+                lambda a, r: jnp.reshape(jnp.transpose(jnp.reshape(
+                    a, a.shape[:-3] + (a.shape[-3] // (r * r), r, r, a.shape[-2], a.shape[-1])),
+                    tuple(range(a.ndim - 3)) + tuple(x + a.ndim - 3 for x in (0, 3, 1, 4, 2))),
+                    a.shape[:-3] + (a.shape[-3] // (r * r), a.shape[-2] * r, a.shape[-1] * r)),
+                lambda rng: [SampleInput((_t(rng, 2, 8, 3, 3), 2))]))
+register(OpInfo("interpolate_nearest", ops_nn.interpolate_nearest,
+                lambda a, s: jnp.repeat(jnp.repeat(a, s, axis=-2), s, axis=-1),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4, 4), 2))]))

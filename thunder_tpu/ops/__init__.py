@@ -1013,6 +1013,425 @@ def log_softmax(a, dim=-1, dtype=None):
     return sub(shifted, log(sum(exp(shifted), d, keepdim=True)))
 
 
+
+# ---------------------------------------------------------------------------
+# wider torch-surface composites (reference thunder/torch/__init__.py 276 ops;
+# every op below decomposes into prims, so trace-level VJP applies for free)
+# ---------------------------------------------------------------------------
+
+def frac(a):
+    return sub(a, trunc(a))
+
+
+def nan_to_num(a, nan=0.0, posinf=None, neginf=None):
+    if isinstance(a, Number):
+        return a
+    fi = dtypes.finfo(a.dtype if a.dtype.is_inexact else dtypes.float32)
+    posinf = float(fi.max) if posinf is None else posinf
+    neginf = float(fi.min) if neginf is None else neginf
+    out = where(isnan(a), nan, a)
+    out = where(logical_and(isinf(out), gt(out, 0)), posinf, out)
+    return where(logical_and(isinf(out), lt(out, 0)), neginf, out)
+
+
+def deg2rad(a):
+    return mul(a, math.pi / 180.0)
+
+
+def rad2deg(a):
+    return mul(a, 180.0 / math.pi)
+
+
+def sinc(a):
+    x = mul(_float_promote(a), math.pi)
+    safe = where(eq(x, 0.0), ones_like(x) if isinstance(x, TensorProxy) else 1.0, x)
+    return where(eq(x, 0.0), 1.0, true_divide(sin(safe), safe))
+
+
+def logit(a, eps=None):
+    if eps is not None:
+        a = clamp(a, min=eps, max=1.0 - eps)
+    return log(true_divide(a, sub(1.0, a)))
+
+
+def xlogy(a, b):
+    safe = where(eq(a, 0.0), 1.0, b)
+    return where(eq(a, 0.0), zeros_like(b) if isinstance(b, TensorProxy) else 0.0,
+                 mul(a, log(safe)))
+
+
+def logaddexp(a, b):
+    m = maximum(a, b)
+    return add(m, log1p(exp(neg(abs(sub(a, b))))))
+
+
+def logaddexp2(a, b):
+    m = maximum(a, b)
+    return add(m, true_divide(log1p(exp2(neg(abs(sub(a, b))))), math.log(2.0)))
+
+
+def hypot(a, b):
+    return sqrt(add(mul(a, a), mul(b, b)))
+
+
+def float_power(a, b):
+    return pow(_float_promote(a), _float_promote(b))
+
+
+def ldexp(a, b):
+    return mul(a, exp2(b))
+
+
+def heaviside(a, values):
+    return where(gt(a, 0.0), ones_like(a), where(eq(a, 0.0), values, zeros_like(a)))
+
+
+def square(a):
+    return mul(a, a)
+
+
+def positive(a):
+    return a
+
+
+def addcmul(a, t1, t2, *, value=1.0):
+    return add(a, mul(mul(t1, t2), value))
+
+
+def addcdiv(a, t1, t2, *, value=1.0):
+    return add(a, mul(true_divide(t1, t2), value))
+
+
+# -- reductions over the wider surface --------------------------------------
+
+def logsumexp(a, dim=None, keepdim=False):
+    dims = _reduce_dims(a, dim)
+    m = detach(amax(a, dim, keepdim=True))
+    out = log(sum(exp(sub(a, m)), dim, keepdim=True))
+    out = add(out, m)
+    if not keepdim:
+        for d in sorted(dims, reverse=True):
+            out = squeeze(out, d)
+    return out
+
+
+def count_nonzero(a, dim=None):
+    return sum(convert_element_type(ne(a, 0), dtypes.int64), dim)
+
+
+def nansum(a, dim=None, keepdim=False):
+    return sum(where(isnan(a), zeros_like(a), a), dim, keepdim)
+
+
+def nanmean(a, dim=None, keepdim=False):
+    valid = convert_element_type(logical_not(isnan(a)),
+                                 a.dtype if a.dtype.is_inexact else dtypes.float32)
+    total = sum(where(isnan(a), zeros_like(a), a), dim, keepdim)
+    return true_divide(total, sum(valid, dim, keepdim))
+
+
+def aminmax(a, dim=None, keepdim=False):
+    return amin(a, dim, keepdim), amax(a, dim, keepdim)
+
+
+def vector_norm(a, ord=2, dim=None, keepdim=False):
+    if ord == 2:
+        return sqrt(sum(mul(a, a), dim, keepdim))
+    if ord == 1:
+        return sum(abs(a), dim, keepdim)
+    if ord == float("inf"):
+        return amax(abs(a), dim, keepdim)
+    if ord == float("-inf"):
+        return amin(abs(a), dim, keepdim)
+    if ord == 0:
+        return convert_element_type(count_nonzero(a, dim), dtypes.float32)
+    return pow(sum(pow(abs(a), ord), dim, keepdim), 1.0 / ord)
+
+
+def norm(a, p=2, dim=None, keepdim=False):
+    return vector_norm(a, ord=p, dim=dim, keepdim=keepdim)
+
+
+def median(a, dim=-1, keepdim=False):
+    """Median along ``dim`` (torch convention: lower of two middles)."""
+    d = canonicalize_dim(a.ndim, dim)
+    n = a.shape[d]
+    vals = sort(a, dim=d)[0]
+    idx = [slice(None)] * a.ndim
+    idx[d] = (n - 1) // 2
+    out = getitem(vals, tuple(idx))
+    return unsqueeze(out, d) if keepdim else out
+
+
+# -- additional activations ---------------------------------------------------
+
+def relu6(a):
+    return clamp(a, min=0.0, max=6.0)
+
+
+def hardtanh(a, min_val=-1.0, max_val=1.0):
+    return clamp(a, min=min_val, max=max_val)
+
+
+def hardswish(a):
+    return mul(a, true_divide(clamp(add(a, 3.0), min=0.0, max=6.0), 6.0))
+
+
+def hardsigmoid(a):
+    return true_divide(clamp(add(a, 3.0), min=0.0, max=6.0), 6.0)
+
+
+def elu(a, alpha=1.0):
+    return where(gt(a, 0.0), a, mul(alpha, expm1(a)))
+
+
+def selu(a):
+    _alpha = 1.6732632423543772
+    _scale = 1.0507009873554805
+    return mul(_scale, elu(a, _alpha))
+
+
+def celu(a, alpha=1.0):
+    return where(gt(a, 0.0), a, mul(alpha, expm1(true_divide(a, alpha))))
+
+
+def mish(a):
+    return mul(a, tanh(softplus(a)))
+
+
+def softsign(a):
+    return true_divide(a, add(1.0, abs(a)))
+
+
+def tanhshrink(a):
+    return sub(a, tanh(a))
+
+
+def hardshrink(a, lambd=0.5):
+    return where(gt(abs(a), lambd), a, zeros_like(a))
+
+
+def softshrink(a, lambd=0.5):
+    return where(gt(a, lambd), sub(a, lambd),
+                 where(lt(a, -lambd), add(a, lambd), zeros_like(a)))
+
+
+def log_sigmoid(a):
+    # stable: -softplus(-x)
+    return neg(softplus(neg(a)))
+
+
+def glu(a, dim=-1):
+    d = canonicalize_dim(a.ndim, dim)
+    check(a.shape[d] % 2 == 0, "glu: dimension size must be even")
+    x, g = chunk(a, 2, dim=d)
+    return mul(x, sigmoid(g))
+
+
+def prelu(a, weight):
+    if isinstance(weight, TensorProxy) and weight.numel > 1:
+        bshape = [1] * a.ndim
+        bshape[1 if a.ndim > 1 else 0] = weight.numel
+        weight = reshape(weight, tuple(bshape))
+    return where(gt(a, 0.0), a, mul(weight, a))
+
+
+def threshold(a, threshold_value, value):
+    return where(gt(a, threshold_value), a, full_like(a, value))
+
+
+def softmin(a, dim=-1, dtype=None):
+    return softmax(neg(a), dim=dim, dtype=dtype)
+
+
+# -- additional shape ops ----------------------------------------------------
+
+def broadcast_to(a, shape):
+    return expand(a, shape)
+
+
+def ravel(a):
+    return reshape(a, (-1,))
+
+
+def unflatten(a, dim, sizes):
+    d = canonicalize_dim(a.ndim, dim)
+    new_shape = tuple(a.shape[:d]) + tuple(sizes) + tuple(a.shape[d + 1:])
+    return reshape(a, new_shape)
+
+
+def tile(a, dims):
+    """numpy/torch tile: repeat the tensor dims[i] times along each axis."""
+    dims = tuple(dims) if isinstance(dims, (tuple, list)) else (dims,)
+    out = a
+    lead = len(dims) - a.ndim
+    for _ in range(max(lead, 0)):
+        out = unsqueeze(out, 0)
+    offset = max(-lead, 0)
+    for i, r in enumerate(dims):
+        if r != 1:
+            out = cat([out] * int(r), dim=i + offset)
+    return out
+
+
+def tensor_split(a, indices_or_sections, dim=0):
+    d = canonicalize_dim(a.ndim, dim)
+    n = a.shape[d]
+    if isinstance(indices_or_sections, int):
+        k = indices_or_sections
+        base, rem = divmod(n, k)
+        bounds, acc = [], 0
+        for i in range(k):
+            acc += base + (1 if i < rem else 0)
+            bounds.append(acc)
+    else:
+        bounds = list(indices_or_sections) + [n]
+    outs, start = [], 0
+    for b in bounds:
+        idx = [slice(None)] * a.ndim
+        idx[d] = slice(start, b)
+        outs.append(getitem(a, tuple(idx)))
+        start = b
+    return tuple(outs)
+
+
+def atleast_1d(a):
+    return a if a.ndim >= 1 else unsqueeze(a, 0)
+
+
+def atleast_2d(a):
+    a = atleast_1d(a)
+    return a if a.ndim >= 2 else unsqueeze(a, 0)
+
+
+def atleast_3d(a):
+    a = atleast_2d(a)
+    return a if a.ndim >= 3 else unsqueeze(a, -1)
+
+
+def hstack(tensors):
+    tensors = [atleast_1d(t) for t in tensors]
+    return cat(tensors, dim=0 if tensors[0].ndim == 1 else 1)
+
+
+def vstack(tensors):
+    return cat([atleast_2d(t) for t in tensors], dim=0)
+
+
+def dstack(tensors):
+    return cat([atleast_3d(t) for t in tensors], dim=2)
+
+
+def narrow(a, dim, start, length):
+    d = canonicalize_dim(a.ndim, dim)
+    start = int(pyval(start))
+    if start < 0:
+        start += int(a.shape[d])
+    idx = [slice(None)] * a.ndim
+    idx[d] = slice(start, start + int(length))
+    return getitem(a, tuple(idx))
+
+
+def select(a, dim, index):
+    d = canonicalize_dim(a.ndim, dim)
+    idx = [slice(None)] * a.ndim
+    idx[d] = int(index)
+    return getitem(a, tuple(idx))
+
+
+def _eye_mask(n, m, dtype):
+    rows = unsqueeze(arange(0, n), 1)
+    cols = unsqueeze(arange(0, m), 0)
+    return convert_element_type(eq(rows, cols), dtype)
+
+
+def diagonal(a, offset=0, dim1=0, dim2=1):
+    """Differentiable diagonal via an eye mask + sum over dim2 (static
+    shapes; XLA folds the mask multiply into the reduce)."""
+    d1 = canonicalize_dim(a.ndim, dim1)
+    d2 = canonicalize_dim(a.ndim, dim2)
+    n, m = a.shape[d1], a.shape[d2]
+    # length of the requested diagonal
+    dlen = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+    check(dlen > 0, lambda: f"diagonal: offset {offset} out of range for ({n},{m})")
+    rows = unsqueeze(arange(0, n), 1)
+    cols = unsqueeze(arange(0, m), 0)
+    mask = convert_element_type(eq(add(rows, offset), cols), a.dtype)
+    bshape = [1] * a.ndim
+    bshape[d1], bshape[d2] = n, m
+    masked = mul(a, reshape(mask, tuple(bshape)))
+    summed = sum(masked, dim=d2)  # (..., n, ...) with d2 removed
+    # slice the valid diagonal entries along d1
+    start = max(-offset, 0)
+    d1_after = d1 if d1 < d2 else d1 - 1
+    idx = [slice(None)] * summed.ndim
+    idx[d1_after] = slice(start, start + dlen)
+    out = getitem(summed, tuple(idx))
+    # torch moves the diagonal to the LAST dim
+    return movedim(out, d1_after, -1)
+
+
+def diag(a, diagonal_offset=0):
+    if a.ndim == 1:
+        n = a.shape[0] + builtins_abs(diagonal_offset)
+        rows = unsqueeze(arange(0, n), 1)
+        cols = unsqueeze(arange(0, n), 0)
+        mask = convert_element_type(eq(add(rows, diagonal_offset), cols), a.dtype)
+        if diagonal_offset >= 0:
+            vec = pad(a, ((diagonal_offset, n - a.shape[0] - diagonal_offset, 0),))
+            return mul(mask, unsqueeze(vec, 0))
+        vec = pad(a, ((0, n - a.shape[0], 0),))
+        return mul(mask, unsqueeze(vec, 1))
+    return diagonal(a, offset=diagonal_offset)
+
+
+def builtins_abs(x):
+    return x if x >= 0 else -x
+
+
+# -- additional linalg -------------------------------------------------------
+
+def mv(a, v):
+    return matmul(a, v)
+
+
+def vdot(a, b):
+    return sum(mul(a, b))
+
+
+def inner(a, b):
+    if a.ndim == 1 and b.ndim == 1:
+        return vdot(a, b)
+    return prims.dot_general(a, b, contract_dims=((a.ndim - 1,), (b.ndim - 1,)))
+
+
+def tensordot(a, b, dims=2):
+    if isinstance(dims, int):
+        ca = tuple(range(a.ndim - dims, a.ndim))
+        cb = tuple(range(dims))
+    else:
+        ca, cb = tuple(dims[0]), tuple(dims[1])
+    return prims.dot_general(a, b, contract_dims=(ca, cb))
+
+
+def addmv(a, mat, vec, *, beta=1.0, alpha=1.0):
+    return add(mul(a, beta), mul(mv(mat, vec), alpha))
+
+
+def cosine_similarity(a, b, dim=1, eps=1e-8):
+    num = sum(mul(a, b), dim)
+    na = sqrt(sum(mul(a, a), dim))
+    nb = sqrt(sum(mul(b, b), dim))
+    return true_divide(num, maximum(mul(na, nb), eps))
+
+
+def cdist(a, b, p=2.0):
+    """Pairwise distances between rows: (..., n, d) x (..., m, d) -> (..., n, m)."""
+    check(p == 2.0, "cdist: only p=2 supported")
+    diff = sub(unsqueeze(a, -2), unsqueeze(b, -3))
+    return sqrt(clamp(sum(mul(diff, diff), -1), min=0.0))
+
+
 # nn composites live in ops.nn; re-export the common entry points
 from thunder_tpu.ops import nn  # noqa: E402
 from thunder_tpu.ops.nn import (  # noqa: E402,F401

@@ -367,3 +367,191 @@ def _cross_entropy_vjp(logits, target, weight=None, ignore_index: int = -100,
         return [(logits, ops.convert_element_type(dlogits, logits.dtype))]
 
     return loss, pullback
+
+
+# ---------------------------------------------------------------------------
+# additional losses (reference: thunder/torch/__init__.py loss section)
+# ---------------------------------------------------------------------------
+
+def _reduce_loss(per_elem, reduction: str):
+    if reduction == "none":
+        return per_elem
+    if reduction == "sum":
+        return ops.sum(per_elem)
+    check(reduction == "mean", lambda: f"unknown reduction {reduction!r}")
+    return ops.mean(per_elem)
+
+
+@opsymbol(id="nn.l1_loss")
+def l1_loss(input, target, reduction: str = "mean"):
+    return _reduce_loss(ops.abs(ops.sub(input, target)), reduction)
+
+
+@opsymbol(id="nn.smooth_l1_loss")
+def smooth_l1_loss(input, target, reduction: str = "mean", beta: float = 1.0):
+    d = ops.abs(ops.sub(input, target))
+    per = ops.where(ops.lt(d, beta),
+                    ops.true_divide(ops.mul(ops.mul(d, d), 0.5), beta),
+                    ops.sub(d, 0.5 * beta))
+    return _reduce_loss(per, reduction)
+
+
+@opsymbol(id="nn.huber_loss")
+def huber_loss(input, target, reduction: str = "mean", delta: float = 1.0):
+    d = ops.abs(ops.sub(input, target))
+    per = ops.where(ops.lt(d, delta),
+                    ops.mul(ops.mul(d, d), 0.5),
+                    ops.mul(delta, ops.sub(d, 0.5 * delta)))
+    return _reduce_loss(per, reduction)
+
+
+@opsymbol(id="nn.binary_cross_entropy")
+def binary_cross_entropy(input, target, weight=None, reduction: str = "mean"):
+    eps = 1e-12
+    per = ops.neg(ops.add(ops.mul(target, ops.log(ops.clamp(input, min=eps))),
+                          ops.mul(ops.sub(1.0, target),
+                                  ops.log(ops.clamp(ops.sub(1.0, input), min=eps)))))
+    if weight is not None:
+        per = ops.mul(per, weight)
+    return _reduce_loss(per, reduction)
+
+
+@opsymbol(id="nn.binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(input, target, weight=None, pos_weight=None,
+                                     reduction: str = "mean"):
+    # stable: max(x,0) - x*t + log(1+exp(-|x|)), with optional pos_weight
+    neg_abs = ops.neg(ops.abs(input))
+    softplus_term = ops.log1p(ops.exp(neg_abs))
+    if pos_weight is not None:
+        log_weight = ops.add(1.0, ops.mul(ops.sub(pos_weight, 1.0), target))
+        per = ops.add(ops.sub(ops.clamp(input, min=0.0), ops.mul(input, target)),
+                      ops.mul(log_weight, softplus_term))
+    else:
+        per = ops.add(ops.sub(ops.clamp(input, min=0.0), ops.mul(input, target)),
+                      softplus_term)
+    if weight is not None:
+        per = ops.mul(per, weight)
+    return _reduce_loss(per, reduction)
+
+
+@opsymbol(id="nn.kl_div")
+def kl_div(input, target, reduction: str = "mean", log_target: bool = False):
+    """input is log-probabilities (torch convention)."""
+    if log_target:
+        per = ops.mul(ops.exp(target), ops.sub(target, input))
+    else:
+        per = ops.xlogy(target, target)
+        per = ops.sub(per, ops.mul(target, input))
+    return _reduce_loss(per, reduction)
+
+
+@opsymbol(id="nn.nll_loss")
+def nll_loss(logp, target, weight=None, ignore_index: int = -100,
+             reduction: str = "mean"):
+    check(weight is None, "nll_loss: class weights unsupported")
+    tgt = ops.reshape(target, (-1,)) if target.ndim > 1 else target
+    lp = ops.reshape(logp, (-1, logp.shape[-1])) if logp.ndim > 2 else logp
+    safe = ops.where(ops.ne(tgt, ignore_index), tgt, ops.zeros_like(tgt))
+    picked = ops.neg(ops.squeeze(ops.gather(lp, 1, ops.unsqueeze(safe, 1)), 1))
+    valid = ops.ne(tgt, ignore_index)
+    picked = ops.where(valid, picked, ops.zeros_like(picked))
+    if reduction == "none":
+        return ops.reshape(picked, tuple(target.shape))
+    total = ops.sum(picked)
+    if reduction == "sum":
+        return total
+    return ops.true_divide(total, ops.sum(ops.convert_element_type(valid, picked.dtype)))
+
+
+# ---------------------------------------------------------------------------
+# pooling — decomposed into static strided slices + elementwise reductions
+# (fully differentiable through existing prims; XLA fuses the k*k slice
+# reads into one windowed reduce on TPU)
+# ---------------------------------------------------------------------------
+
+def _pool_windows(a, kernel_size, stride, padding, pad_value):
+    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    if stride is None:
+        stride = (kh, kw)
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if ph or pw:
+        a = ops.pad(a, ((0, 0, 0), (0, 0, 0), (ph, ph, 0), (pw, pw, 0)), value=pad_value)
+    H, W = a.shape[-2], a.shape[-1]
+    out_h = (H - kh) // sh + 1
+    out_w = (W - kw) // sw + 1
+    windows = []
+    for i in range(kh):
+        for j in range(kw):
+            idx = (Ellipsis, slice(i, i + (out_h - 1) * sh + 1, sh),
+                   slice(j, j + (out_w - 1) * sw + 1, sw))
+            windows.append(ops.getitem(a, idx))
+    return windows, kh * kw
+
+
+@opsymbol(id="nn.max_pool2d")
+def max_pool2d(a, kernel_size, stride=None, padding=0):
+    windows, _ = _pool_windows(a, kernel_size, stride, padding, float("-inf"))
+    out = windows[0]
+    for w in windows[1:]:
+        out = ops.maximum(out, w)
+    return out
+
+
+@opsymbol(id="nn.avg_pool2d")
+def avg_pool2d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
+    check(count_include_pad or padding == 0, "avg_pool2d: count_include_pad=False unsupported")
+    windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0)
+    out = windows[0]
+    for w in windows[1:]:
+        out = ops.add(out, w)
+    return ops.true_divide(out, float(n))
+
+
+@opsymbol(id="nn.adaptive_avg_pool2d")
+def adaptive_avg_pool2d(a, output_size):
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
+    H, W = a.shape[-2], a.shape[-1]
+    check(H % oh == 0 and W % ow == 0,
+          lambda: f"adaptive_avg_pool2d: input {H}x{W} not divisible by output {oh}x{ow}")
+    r = ops.reshape(a, tuple(a.shape[:-2]) + (oh, H // oh, ow, W // ow))
+    return ops.mean(r, dim=(-3, -1))
+
+
+@opsymbol(id="nn.instance_norm")
+def instance_norm(a, weight=None, bias=None, eps: float = 1e-5):
+    dims = tuple(range(2, a.ndim))
+    var, mean = ops.var_mean(a, dim=dims, correction=0, keepdim=True)
+    out = ops.true_divide(ops.sub(a, mean), ops.sqrt(ops.add(var, eps)))
+    bshape = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+    if weight is not None:
+        out = ops.mul(out, ops.reshape(weight, bshape))
+    if bias is not None:
+        out = ops.add(out, ops.reshape(bias, bshape))
+    return out
+
+
+@opsymbol(id="nn.pixel_shuffle")
+def pixel_shuffle(a, upscale_factor: int):
+    r = upscale_factor
+    B_dims = tuple(a.shape[:-3])
+    C, H, W = a.shape[-3], a.shape[-2], a.shape[-1]
+    check(C % (r * r) == 0, "pixel_shuffle: channels not divisible by r^2")
+    oc = C // (r * r)
+    x = ops.reshape(a, B_dims + (oc, r, r, H, W))
+    nb = len(B_dims)
+    x = ops.transpose(x, tuple(range(nb)) + (nb, nb + 3, nb + 1, nb + 4, nb + 2))
+    return ops.reshape(x, B_dims + (oc, H * r, W * r))
+
+
+@opsymbol(id="nn.interpolate_nearest")
+def interpolate_nearest(a, scale_factor: int):
+    """Nearest-neighbor upsampling by an integer factor over the last two dims."""
+    s = int(scale_factor)
+    out = a
+    out = ops.movedim(out, -2, 0)
+    out = ops.repeat_interleave_dim0(out, s)
+    out = ops.movedim(out, 0, -2)
+    out = ops.movedim(out, -1, 0)
+    out = ops.repeat_interleave_dim0(out, s)
+    return ops.movedim(out, 0, -1)

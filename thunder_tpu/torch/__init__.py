@@ -1399,3 +1399,188 @@ class _ConvertingWrapper:
 
     def __getattr__(self, name):
         return getattr(self._jfn, name)
+
+
+# ---------------------------------------------------------------------------
+# wider-surface registrations (ops added for torch parity breadth)
+# ---------------------------------------------------------------------------
+
+def _t_vector_norm(a, ord=2, dim=None, keepdim=False, *, dtype=None, out=None):
+    return ops.vector_norm(a, ord=ord, dim=dim, keepdim=keepdim)
+
+
+def _t_norm(a, p=2, dim=None, keepdim=False, *, dtype=None, out=None):
+    return ops.norm(a, p=2 if p in (None, "fro") else p, dim=dim, keepdim=keepdim)
+
+
+def _t_logsumexp(a, dim=None, keepdim=False, *, out=None):
+    return ops.logsumexp(a, dim=dim, keepdim=keepdim)
+
+
+def _t_median(a, dim=None, keepdim=False):
+    if dim is None:
+        return ops.median(ops.reshape(a, (a.numel,)), dim=0)
+    return ops.median(a, dim=dim, keepdim=keepdim), None  # indices unsupported
+
+
+def _t_tensor_split(a, indices_or_sections, dim=0):
+    return ops.tensor_split(a, indices_or_sections, dim=dim)
+
+
+def _t_diagonal(a, offset=0, dim1=0, dim2=1):
+    return ops.diagonal(a, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def _t_avg_pool2d(a, kernel_size, stride=None, padding=0, ceil_mode=False,
+                  count_include_pad=True, divisor_override=None):
+    check(not ceil_mode and divisor_override is None,
+          "avg_pool2d: ceil_mode/divisor_override unsupported")
+    return ops_nn.avg_pool2d(a, kernel_size, stride, padding, count_include_pad)
+
+
+def _t_max_pool2d(a, kernel_size, stride=None, padding=0, dilation=1,
+                  ceil_mode=False, return_indices=False):
+    check(dilation == 1 and not ceil_mode and not return_indices,
+          "max_pool2d: dilation/ceil_mode/return_indices unsupported")
+    return ops_nn.max_pool2d(a, kernel_size, stride, padding)
+
+
+def _t_interpolate(a, size=None, scale_factor=None, mode="nearest", align_corners=None,
+                   recompute_scale_factor=None, antialias=False):
+    check(mode == "nearest", "interpolate: only mode='nearest' supported")
+    if scale_factor is None:
+        check(size is not None, "interpolate needs size or scale_factor")
+        sh = size[0] // a.shape[-2] if isinstance(size, (tuple, list)) else size // a.shape[-2]
+        scale_factor = sh
+    return ops_nn.interpolate_nearest(a, int(scale_factor))
+
+
+def _t_instance_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
+                     use_input_stats=True, momentum=0.1, eps=1e-5):
+    check(running_mean is None and running_var is None,
+          "instance_norm: running stats unsupported")
+    return ops_nn.instance_norm(a, weight, bias, eps)
+
+
+for _tf, _fn in {
+    torch.frac: _make_simple(ops.frac),
+    torch.nan_to_num: (lambda a, nan=0.0, posinf=None, neginf=None, *, out=None:
+                       ops.nan_to_num(a, nan, posinf, neginf)),
+    torch.deg2rad: _make_simple(ops.deg2rad), torch.rad2deg: _make_simple(ops.rad2deg),
+    torch.sinc: _make_simple(ops.sinc),
+    torch.logit: (lambda a, eps=None: ops.logit(a, eps)),
+    torch.xlogy: (lambda a, b: ops.xlogy(a, b)),
+    torch.logaddexp: (lambda a, b: ops.logaddexp(a, b)),
+    torch.logaddexp2: (lambda a, b: ops.logaddexp2(a, b)),
+    torch.hypot: (lambda a, b: ops.hypot(a, b)),
+    torch.float_power: (lambda a, b: ops.float_power(a, b)),
+    torch.ldexp: (lambda a, b: ops.ldexp(a, b)),
+    torch.heaviside: (lambda a, v: ops.heaviside(a, v)),
+    torch.square: _make_simple(ops.square),
+    torch.positive: _make_simple(ops.positive),
+    torch.addcmul: (lambda a, t1, t2, *, value=1.0, out=None: ops.addcmul(a, t1, t2, value=value)),
+    torch.addcdiv: (lambda a, t1, t2, *, value=1.0, out=None: ops.addcdiv(a, t1, t2, value=value)),
+    torch.logsumexp: _t_logsumexp,
+    torch.count_nonzero: (lambda a, dim=None: ops.count_nonzero(a, dim)),
+    torch.nansum: (lambda a, dim=None, keepdim=False, *, dtype=None: ops.nansum(a, dim, keepdim)),
+    torch.nanmean: (lambda a, dim=None, keepdim=False, *, dtype=None: ops.nanmean(a, dim, keepdim)),
+    torch.aminmax: (lambda a, *, dim=None, keepdim=False, out=None: ops.aminmax(a, dim, keepdim)),
+    torch.median: _t_median,
+    torch.norm: _t_norm,
+    torch.linalg.vector_norm: _t_vector_norm,
+    torch.linalg.norm: _t_norm,
+    torch.broadcast_to: (lambda a, shape: ops.broadcast_to(a, tuple(shape))),
+    torch.ravel: _make_simple(ops.ravel),
+    torch.unflatten: (lambda a, dim, sizes: ops.unflatten(a, dim, sizes)),
+    torch.tile: (lambda a, dims: ops.tile(a, dims)),
+    torch.tensor_split: _t_tensor_split,
+    torch.atleast_1d: _make_simple(ops.atleast_1d),
+    torch.atleast_2d: _make_simple(ops.atleast_2d),
+    torch.atleast_3d: _make_simple(ops.atleast_3d),
+    torch.hstack: (lambda ts, *, out=None: ops.hstack(list(ts))),
+    torch.vstack: (lambda ts, *, out=None: ops.vstack(list(ts))),
+    torch.dstack: (lambda ts, *, out=None: ops.dstack(list(ts))),
+    torch.diagonal: _t_diagonal,
+    torch.diag: (lambda a, diagonal=0, *, out=None: ops.diag(a, diagonal)),
+    torch.mv: (lambda a, v, *, out=None: ops.mv(a, v)),
+    torch.vdot: (lambda a, b, *, out=None: ops.vdot(a, b)),
+    torch.inner: (lambda a, b, *, out=None: ops.inner(a, b)),
+    torch.tensordot: (lambda a, b, dims=2, out=None: ops.tensordot(a, b, dims)),
+    torch.addmv: (lambda a, mat, vec, *, beta=1.0, alpha=1.0, out=None:
+                  ops.addmv(a, mat, vec, beta=beta, alpha=alpha)),
+    torch.cosine_similarity: (lambda a, b, dim=1, eps=1e-8: ops.cosine_similarity(a, b, dim, eps)),
+    torch.cdist: (lambda a, b, p=2.0, compute_mode=None: ops.cdist(a, b, p)),
+    # activations
+    F.relu6: (lambda a, inplace=False: ops.relu6(a)),
+    F.hardtanh: (lambda a, min_val=-1.0, max_val=1.0, inplace=False:
+                 ops.hardtanh(a, min_val, max_val)),
+    F.hardswish: (lambda a, inplace=False: ops.hardswish(a)),
+    F.hardsigmoid: (lambda a, inplace=False: ops.hardsigmoid(a)),
+    F.elu: (lambda a, alpha=1.0, inplace=False: ops.elu(a, alpha)),
+    F.selu: (lambda a, inplace=False: ops.selu(a)),
+    F.celu: (lambda a, alpha=1.0, inplace=False: ops.celu(a, alpha)),
+    F.softsign: _make_simple(ops.softsign),
+    F.tanhshrink: _make_simple(ops.tanhshrink),
+    F.hardshrink: (lambda a, lambd=0.5: ops.hardshrink(a, lambd)),
+    F.softshrink: (lambda a, lambd=0.5: ops.softshrink(a, lambd)),
+    F.logsigmoid: _make_simple(ops.log_sigmoid),
+    F.glu: (lambda a, dim=-1: ops.glu(a, dim)),
+    F.prelu: (lambda a, weight: ops.prelu(a, weight)),
+    F.threshold: (lambda a, threshold, value, inplace=False: ops.threshold(a, threshold, value)),
+    F.softmin: (lambda a, dim=None, _stacklevel=None, dtype=None:
+                ops.softmin(a, dim=dim if dim is not None else -1, dtype=dtype)),
+    # losses
+    F.l1_loss: (lambda i, t, size_average=None, reduce=None, reduction="mean":
+                ops_nn.l1_loss(i, t, reduction)),
+    F.smooth_l1_loss: (lambda i, t, size_average=None, reduce=None, reduction="mean", beta=1.0:
+                       ops_nn.smooth_l1_loss(i, t, reduction, beta)),
+    F.huber_loss: (lambda i, t, reduction="mean", delta=1.0, weight=None:
+                   ops_nn.huber_loss(i, t, reduction, delta)),
+    F.binary_cross_entropy: (lambda i, t, weight=None, size_average=None, reduce=None,
+                             reduction="mean": ops_nn.binary_cross_entropy(i, t, weight, reduction)),
+    F.binary_cross_entropy_with_logits: (
+        lambda i, t, weight=None, size_average=None, reduce=None, reduction="mean",
+        pos_weight=None: ops_nn.binary_cross_entropy_with_logits(i, t, weight, pos_weight, reduction)),
+    F.kl_div: (lambda i, t, size_average=None, reduce=None, reduction="mean",
+               log_target=False: ops_nn.kl_div(i, t, reduction, log_target)),
+    # pooling / vision
+    F.max_pool2d: _t_max_pool2d,
+    F.avg_pool2d: _t_avg_pool2d,
+    F.adaptive_avg_pool2d: (lambda a, output_size: ops_nn.adaptive_avg_pool2d(a, output_size)),
+    F.instance_norm: _t_instance_norm,
+    F.pixel_shuffle: (lambda a, r: ops_nn.pixel_shuffle(a, r)),
+    F.interpolate: _t_interpolate,
+}.items():
+    _torch_to_thunder_function_map[_tf] = _fn
+
+_EXTRA_METHODS = {
+    "frac": _make_simple(ops.frac), "square": _make_simple(ops.square),
+    "nan_to_num": (lambda a, nan=0.0, posinf=None, neginf=None: ops.nan_to_num(a, nan, posinf, neginf)),
+    "logsumexp": _t_logsumexp, "norm": _t_norm, "median": _t_median,
+    "count_nonzero": (lambda a, dim=None: ops.count_nonzero(a, dim)),
+    "nansum": (lambda a, dim=None, keepdim=False: ops.nansum(a, dim, keepdim)),
+    "nanmean": (lambda a, dim=None, keepdim=False: ops.nanmean(a, dim, keepdim)),
+    "aminmax": (lambda a, *, dim=None, keepdim=False: ops.aminmax(a, dim, keepdim)),
+    "broadcast_to": (lambda a, shape: ops.broadcast_to(a, tuple(shape))),
+    "ravel": _make_simple(ops.ravel),
+    "unflatten": (lambda a, dim, sizes: ops.unflatten(a, dim, sizes)),
+    "tile": (lambda a, *dims: ops.tile(a, dims[0] if len(dims) == 1 and
+                                       isinstance(dims[0], (tuple, list)) else dims)),
+    "tensor_split": _t_tensor_split, "diagonal": _t_diagonal,
+    "diag": (lambda a, diagonal=0: ops.diag(a, diagonal)),
+    "mv": (lambda a, v: ops.mv(a, v)), "vdot": (lambda a, b: ops.vdot(a, b)),
+    "inner": (lambda a, b: ops.inner(a, b)),
+    "addcmul": (lambda a, t1, t2, *, value=1.0: ops.addcmul(a, t1, t2, value=value)),
+    "addcdiv": (lambda a, t1, t2, *, value=1.0: ops.addcdiv(a, t1, t2, value=value)),
+    "addcmul_": (lambda a, t1, t2, *, value=1.0: ops.addcmul(a, t1, t2, value=value)),
+    "addcdiv_": (lambda a, t1, t2, *, value=1.0: ops.addcdiv(a, t1, t2, value=value)),
+    "xlogy": (lambda a, b: ops.xlogy(a, b)),
+    "hypot": (lambda a, b: ops.hypot(a, b)),
+    "heaviside": (lambda a, v: ops.heaviside(a, v)),
+    "hardshrink": (lambda a, lambd=0.5: ops.hardshrink(a, lambd)),
+}
+_TENSOR_METHODS.update(_EXTRA_METHODS)
+for _name, _impl in _EXTRA_METHODS.items():
+    _desc = getattr(torch.Tensor, _name, None)
+    if _desc is not None and _desc not in _torch_to_thunder_function_map:
+        _torch_to_thunder_function_map[_desc] = _impl
