@@ -83,18 +83,19 @@ class CompileStats:
 
 class CacheEntry:
     __slots__ = ("computation_fn", "run_fn", "tensor_indices", "uses_rng", "traces",
-                 "prologue_trace", "prologue_fn", "out_spec")
+                 "prologue_trace", "prologue_fn", "out_spec", "arg_of_flat")
 
     def __init__(self, computation_fn, tensor_indices, uses_rng, traces, prologue_trace,
                  prologue_fn, out_spec):
         self.computation_fn = computation_fn
-        self.run_fn = computation_fn  # may be wrapped (e.g. shard_map) by subclasses
+        self.run_fn = computation_fn  # may be wrapped (jit / shard_map) in finalize
         self.tensor_indices = tensor_indices
         self.uses_rng = uses_rng
         self.traces = traces
         self.prologue_trace = prologue_trace
         self.prologue_fn = prologue_fn
         self.out_spec = out_spec
+        self.arg_of_flat: dict[int, int] | None = None  # flat index -> positional argnum
 
 
 def _is_arraylike(x) -> bool:
@@ -284,6 +285,14 @@ class ThunderTPUFunction:
         uses_rng = getattr(traces[0], "rng_input_proxy", None) is not None
         entry = CacheEntry(computation_fn, tensor_indices, uses_rng, traces, prologue,
                            prologue_fn, None)
+        # map flat leaf positions to top-level positional args (donation support)
+        import jax.tree_util as _jtu
+
+        flat_with_paths, _ = _jtu.tree_flatten_with_path((args, kwargs))
+        entry.arg_of_flat = {}
+        for i, (path, _leaf) in enumerate(flat_with_paths):
+            if len(path) >= 2 and getattr(path[0], "idx", None) == 0:
+                entry.arg_of_flat[i] = getattr(path[1], "idx", None)
         self._finalize_entry(entry, flat, exec_trc)
         self._stats.last_traces = traces
         self._stats.last_prologue_traces = [prologue]
@@ -294,7 +303,41 @@ class ThunderTPUFunction:
         return TensorProxy(shape=leaf.shape, dtype=dtypes.to_dtype(leaf.dtype))
 
     def _finalize_entry(self, entry: CacheEntry, flat, exec_trc) -> None:
-        pass
+        """Whole-program compilation: the generated trace callable is pure JAX
+        ops, so one ``jax.jit`` over it gives XLA whole-program fusion and a
+        persistent executable — the TPU answer to the reference's CUDA-graphs
+        executor (``thunder/executors/cudagraphex.py:133``: capture once,
+        replay with stable buffers). Region fusions inline into the outer jit.
+
+        ``donate_argnums=(i, ...)`` (a jit compile option, matching jax.jit's
+        parameter): tensor leaves under those positional args are donated so
+        XLA reuses their buffers for outputs — in-place optimizer updates.
+        """
+        if self.cache_option == "symbolic values":
+            # number inputs are Python scalars guarded by type; an outer jit
+            # would re-trace per value, defeating symbolic caching — keep the
+            # per-region execution path
+            return
+        from thunder_tpu.core.compile_data import get_compile_option
+
+        if not get_compile_option(
+                "whole_program_jit",
+                "compile the entire execution trace as one XLA program "
+                "(persistent executable; CUDA-graphs analog)", True):
+            return
+        import jax
+
+        donate_args = tuple(get_compile_option(
+            "donate_argnums",
+            "positional args whose tensor leaves are donated to XLA "
+            "(buffer reuse for outputs; pass params/optimizer-state argnums)",
+            ()) or ())
+        donate = ()
+        if donate_args and entry.arg_of_flat is not None:
+            donate = tuple(
+                j for j, fi in enumerate(entry.tensor_indices)
+                if entry.arg_of_flat.get(fi) in donate_args)
+        entry.run_fn = jax.jit(entry.computation_fn, donate_argnums=donate)
 
     # -- introspection ------------------------------------------------------
     @property

@@ -79,7 +79,9 @@ def _sdpa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float, causal: b
     o = jax.lax.dot_general(e / l, v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     o_ref[0] = o.astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse carried as (bq, 1): a 2D last-dim-1 layout keeps the block shape
+    # legal on TPU ((1, bq, 1): bq sublanes, lane dim equals the array dim)
+    lse_ref[0] = m + jnp.log(l)
 
 
 def pallas_sdpa_fwd(q, k, v, is_causal=False, scale=None):
@@ -104,11 +106,11 @@ def pallas_sdpa_fwd(q, k, v, is_causal=False, scale=None):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, T, hd), q.dtype),
-            jax.ShapeDtypeStruct((bh, T), jnp.float32),
+            jax.ShapeDtypeStruct((bh, T, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(q3, k3, v3)
@@ -139,14 +141,14 @@ def _sdpa_dq_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dq_ref,
     k = k_ref[0].astype(jnp.float32)      # (S, hd)
     v = v_ref[0].astype(jnp.float32)      # (S, hd)
     o = o_ref[0].astype(jnp.float32)      # (bq, hd)
-    lse = lse_ref[0].astype(jnp.float32)  # (bq,)
+    lse = lse_ref[0].astype(jnp.float32)  # (bq, 1)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale  # (bq, S)
     if causal:
         row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(row >= col, s, -jnp.inf)
-    p = jnp.exp(s - lse[:, None])                       # (bq, S)
+    p = jnp.exp(s - lse)                                # (bq, S)
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)  # (bq, S)
     delta = jnp.sum(g * o, axis=-1, keepdims=True)      # (bq, 1)
@@ -164,14 +166,14 @@ def _sdpa_dkv_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dk_ref, dv_ref,
     k = k_ref[0].astype(jnp.float32)      # (bk, hd)
     v = v_ref[0].astype(jnp.float32)      # (bk, hd)
     o = o_ref[0].astype(jnp.float32)      # (T, hd)
-    lse = lse_ref[0].astype(jnp.float32)  # (T,)
+    lse = lse_ref[0].astype(jnp.float32)  # (T, 1)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale  # (T, bk)
     if causal:
         row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         col = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(row >= col, s, -jnp.inf)
-    p = jnp.exp(s - lse[:, None])                       # (T, bk)
+    p = jnp.exp(s - lse)                                # (T, bk)
     dv = jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)  # (bk, hd)
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
@@ -195,7 +197,7 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
     k3 = k.reshape(bh, S, hd)
     v3 = v.reshape(bh, S, hd)
     o3 = out.reshape(bh, T, hd)
-    lse3 = lse.reshape(bh, T)
+    lse3 = lse.reshape(bh, T, 1)
     bq = T if T <= 256 else max(b for b in (256, 128, 64) if T % b == 0)
     bk = S if S <= 256 else max(b for b in (256, 128, 64) if S % b == 0)
 
@@ -208,7 +210,7 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
             pl.BlockSpec((1, S, hd), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, hd), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, T, hd), q.dtype),
@@ -224,7 +226,7 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
             pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
@@ -256,15 +258,23 @@ def _ce_kernel(logits_ref, tgt_ref, nll_ref, lse_ref, *, ignore_index: int):
     lse = (m + jnp.log(jnp.sum(e, axis=-1, keepdims=True)))[:, 0]  # (bn,)
     col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     safe = jnp.where(tgt == ignore_index, 0, tgt)  # (bn, 1)
-    picked = jnp.sum(jnp.where(col == safe, x, 0.0), axis=-1)  # (bn,)
-    nll = jnp.where(tgt[:, 0] == ignore_index, 0.0, lse - picked)
+    picked = jnp.sum(jnp.where(col == safe, x, 0.0), axis=-1, keepdims=True)  # (bn, 1)
+    lse2 = lse[:, None]
+    nll = jnp.where(tgt == ignore_index, 0.0, lse2 - picked)  # (bn, 1)
     nll_ref[...] = nll
-    lse_ref[...] = lse
+    lse_ref[...] = lse2
 
 
 def pallas_ce_fwd(logits, target, ignore_index=-100):
     N, V = logits.shape
-    bn = N if N <= 128 else max(b for b in (128, 64, 32, 16, 8) if N % b == 0)
+    # size the row block by VMEM budget: the (bn, V) f32 tile must fit well
+    # under the ~16MB scoped vmem limit alongside double-buffering
+    budget_rows = max((4 * 1024 * 1024) // (V * 4), 1)
+    if N <= 128 and N <= budget_rows:
+        bn = N
+    else:
+        bn = max((b for b in (128, 64, 32, 16, 8) if N % b == 0 and b <= budget_rows),
+                 default=min(8, N))
     tgt2 = target.astype(jnp.int32).reshape(N, 1)
     nll, lse = pl.pallas_call(
         functools.partial(_ce_kernel, ignore_index=ignore_index),
@@ -274,16 +284,16 @@ def pallas_ce_fwd(logits, target, ignore_index=-100):
             pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((bn,), lambda i: (i,)),
-            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((N,), jnp.float32),
-            jax.ShapeDtypeStruct((N,), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(logits, tgt2)
-    return nll, lse
+    return nll.reshape(N), lse.reshape(N)
 
 
 def _ce_checker(logits, target, ignore_index=-100):
@@ -291,7 +301,9 @@ def _ce_checker(logits, target, ignore_index=-100):
         return False
     if _interpret():
         return True
-    return logits.shape[-1] % 128 == 0 and logits.shape[0] % 8 == 0
+    # min row block is 8; reject vocabularies whose 8-row f32 tile can't fit
+    return (logits.shape[-1] % 128 == 0 and logits.shape[0] % 8 == 0
+            and 8 * logits.shape[-1] * 4 <= 4 * 1024 * 1024)
 
 
 # ---------------------------------------------------------------------------
