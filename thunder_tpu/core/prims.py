@@ -56,6 +56,7 @@ class PrimIDs(Enum):
     BROADCAST_IN_DIM = auto(); CAT = auto(); FLIP = auto(); RESHAPE = auto(); SLICE = auto()
     SQUEEZE = auto(); TRANSPOSE = auto(); PAD = auto()
     TAKE = auto(); TAKE_ALONG_AXIS = auto(); SCATTER_ADD = auto(); INDEX_PUT = auto()
+    INDEX_ADD = auto()
     DYNAMIC_SLICE = auto(); DYNAMIC_UPDATE_SLICE = auto()
     # elementwise unary
     ABS = auto(); ACOS = auto(); ACOSH = auto(); ASIN = auto(); ASINH = auto(); ATAN = auto()
@@ -445,6 +446,20 @@ def _scatter_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, 
 
 
 scatter_add = make_prim(PrimIDs.SCATTER_ADD, "scatter_add", _scatter_add_meta)
+
+
+def _index_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, dim: int) -> TensorProxy:
+    """Row-wise scatter-add: ``indices`` is rank-1 (n,), ``value`` has ``a``'s
+    shape with ``dim`` replaced by n; each slice ``value[..., i, ...]`` is
+    added to ``a[..., indices[i], ...]``. Unlike SCATTER_ADD (torch
+    ``scatter_add_`` semantics — per-element index tensor), this lowers to an
+    XLA scatter with ``update_window_dims``: 1 index per row, not per
+    element — the fast path for embedding gradients on TPU."""
+    check(indices.ndim == 1, "index_add: indices must be rank-1")
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+index_add = make_prim(PrimIDs.INDEX_ADD, "index_add", _index_add_meta)
 
 
 def _index_put_meta(a: TensorProxy, indices: Sequence[TensorProxy], values: TensorProxy, accumulate: bool) -> TensorProxy:

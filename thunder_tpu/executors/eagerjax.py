@@ -233,6 +233,15 @@ def _scatter_add(a, indices, value, dim):
     return a.at[tuple(idx)].add(value)
 
 
+@impl(PrimIDs.INDEX_ADD)
+def _index_add(a, indices, value, dim):
+    if dim == 0:
+        return a.at[indices].add(value)
+    a2 = jnp.moveaxis(a, dim, 0)
+    v2 = jnp.moveaxis(value, dim, 0)
+    return jnp.moveaxis(a2.at[indices].add(v2), 0, dim)
+
+
 @impl(PrimIDs.INDEX_PUT)
 def _index_put(a, indices, values, accumulate):
     if accumulate:
