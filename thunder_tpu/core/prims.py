@@ -456,6 +456,10 @@ def _index_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, di
     XLA scatter with ``update_window_dims``: 1 index per row, not per
     element — the fast path for embedding gradients on TPU."""
     check(indices.ndim == 1, "index_add: indices must be rank-1")
+    check(0 <= dim < a.ndim, lambda: f"index_add: dim {dim} out of range for rank {a.ndim}")
+    expected = a.shape[:dim] + (indices.shape[0],) + a.shape[dim + 1:]
+    check(tuple(value.shape) == expected,
+          lambda: f"index_add: value shape {value.shape} != {expected}")
     return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
 
 

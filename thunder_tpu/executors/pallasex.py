@@ -43,6 +43,34 @@ def _interpret() -> bool:
     return os.environ.get("THUNDER_TPU_PALLAS_INTERPRET") == "1"
 
 
+def _pick_block(n: int, budget_elems: int) -> int:
+    """Largest block size dividing ``n`` whose f32 tile stays within
+    ``budget_elems``; ``n`` itself when it fits (single-shot: measured faster
+    than the inner loop on v5e at T<=4096 — fori_loop overhead exceeds the
+    causal-skip FLOP saving)."""
+    if n <= budget_elems:
+        return n
+    fitting = [b for b in (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+               if b <= budget_elems and n % b == 0]
+    # no fitting divisor: fall back to n whole — caller's checker must have
+    # bounded n already (real-TPU claims require n % 128 == 0); interpret
+    # mode has no VMEM to blow
+    return max(fitting) if fitting else n
+
+
+def _causal_nk(qi, bq, bk, nk_all):
+    """Number of kv blocks a causal q block must process: blocks up to and
+    including the one containing the q block's last row (the diagonal)."""
+    return jnp.minimum(((qi + 1) * bq + bk - 1) // bk, nk_all)
+
+
+def _causal_mask(s, row0, col0):
+    """Mask score tile ``s`` to row >= col given the tile's global offsets."""
+    row = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(row >= col, s, -jnp.inf)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.default_backend() == "tpu"
@@ -62,23 +90,45 @@ register_executor(ex, default=True)
 # flash attention forward
 # ---------------------------------------------------------------------------
 
-def _sdpa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float, causal: bool, bq: int):
+def _sdpa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float, causal: bool,
+                 bq: int, bk: int):
+    """Flash-attention forward, one q block per program.
+
+    MXU discipline: all three matmuls take bf16 (input-dtype) operands with
+    f32 accumulation (``preferred_element_type``) — casting operands to f32
+    first would force multi-pass f32 MXU arithmetic (~8x slower). Causal
+    block skipping: the kv loop stops at the q block's diagonal, halving
+    attention FLOPs — a saving XLA's full-T^2 softmax lowering cannot make.
+    """
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (bq, hd)
-    k = k_ref[0].astype(jnp.float32)  # (T, hd)
-    v = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale  # (bq, T)
-    if causal:
-        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(row >= col, s, -jnp.inf)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
-    l = jnp.sum(e, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(e / l, v, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    o_ref[0] = o.astype(o_ref.dtype)
+    q = q_ref[0]                       # (bq, hd) input dtype
+    S = k_ref.shape[1]
+    nk_all = S // bk
+    # causal: process kv blocks up to and including the diagonal block
+    nk = _causal_nk(qi, bq, bk, nk_all) if causal else nk_all
+
+    def body(kj, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kj * bk, bk), :]          # (bk, hd)
+        v = v_ref[0, pl.ds(kj * bk, bk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
+        if causal:
+            s = _causal_mask(s, qi * bq, kj * bk)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                        # (bq, bk) f32
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc = acc * alpha + pv
+        return acc, m_new, l
+
+    acc = jnp.zeros((bq, q_ref.shape[2]), jnp.float32)
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
     # lse carried as (bq, 1): a 2D last-dim-1 layout keeps the block shape
     # legal on TPU ((1, bq, 1): bq sublanes, lane dim equals the array dim)
     lse_ref[0] = m + jnp.log(l)
@@ -94,10 +144,11 @@ def pallas_sdpa_fwd(q, k, v, is_causal=False, scale=None):
     q3 = q.reshape(bh, T, hd)
     k3 = k.reshape(bh, S, hd)
     v3 = v.reshape(bh, S, hd)
-    bq = T if T <= 256 else max(b for b in (256, 128, 64) if T % b == 0)
+    bq = _pick_block(T, 256)
+    bk = _pick_block(S, (4 * 1024 * 1024) // (bq * 4))
 
     out, lse = pl.pallas_call(
-        functools.partial(_sdpa_kernel, scale=scale, causal=bool(is_causal), bq=bq),
+        functools.partial(_sdpa_kernel, scale=scale, causal=bool(is_causal), bq=bq, bk=bk),
         grid=(bh, T // bq),
         in_specs=[
             pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
@@ -123,8 +174,16 @@ def _sdpa_checker(q, k, v, is_causal=False, scale=None):
     T, hd = q.shape[-2], q.shape[-1]
     if _interpret():
         return True
-    # lane/sublane alignment on real TPU
-    return hd % 128 == 0 and T % 128 == 0 and k.shape[-2] % 128 == 0
+    if not (hd % 128 == 0 and T % 128 == 0 and k.shape[-2] % 128 == 0):
+        return False
+    # the kernels stage two whole-sequence (seq, hd) operands in VMEM (K/V in
+    # fwd and dq; G/Q in dkv — delta/lse vectors are negligible); only the
+    # score tile is blocked. Reject sequences whose staged blocks blow the
+    # ~16MB VMEM budget; XLA (or ring attention over a mesh axis) handles those.
+    # q.dtype is a thunder dtype at trace time (checkers see proxies)
+    elt = getattr(q.dtype, "bytes", None) or jnp.dtype(q.dtype).itemsize
+    staged = 2 * max(T, k.shape[-2]) * hd * elt
+    return staged <= 6 * 1024 * 1024
 
 
 # ---------------------------------------------------------------------------
@@ -133,57 +192,75 @@ def _sdpa_checker(q, k, v, is_causal=False, scale=None):
 # reference thunder/executors/sdpaex.py:312, cudnnex.py:721)
 # ---------------------------------------------------------------------------
 
-def _sdpa_dq_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dq_ref,
-                    *, scale: float, causal: bool, bq: int):
+def _sdpa_dq_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dq_ref, delta_ref,
+                    *, scale: float, causal: bool, bq: int, bk: int):
     qi = pl.program_id(1)
-    g = g_ref[0].astype(jnp.float32)      # (bq, hd)
-    q = q_ref[0].astype(jnp.float32)      # (bq, hd)
-    k = k_ref[0].astype(jnp.float32)      # (S, hd)
-    v = v_ref[0].astype(jnp.float32)      # (S, hd)
-    o = o_ref[0].astype(jnp.float32)      # (bq, hd)
+    g = g_ref[0]                          # (bq, hd) input dtype
+    q = q_ref[0]                          # (bq, hd)
     lse = lse_ref[0].astype(jnp.float32)  # (bq, 1)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale  # (bq, S)
-    if causal:
-        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(row >= col, s, -jnp.inf)
-    p = jnp.exp(s - lse)                                # (bq, S)
-    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (bq, S)
-    delta = jnp.sum(g * o, axis=-1, keepdims=True)      # (bq, 1)
-    ds = p * (dp - delta) * scale
-    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    gf = g.astype(jnp.float32)
+    # delta = rowsum(g * o), written out for the dkv kernel (FlashAttention-2
+    # style): dkv then needs neither o nor the redundant recomputation
+    delta = jnp.sum(gf * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)  # (bq, 1)
+    delta_ref[0] = delta
+    S = k_ref.shape[1]
+    nk_all = S // bk
+    nk = _causal_nk(qi, bq, bk, nk_all) if causal else nk_all
+
+    def body(kj, acc):
+        k = k_ref[0, pl.ds(kj * bk, bk), :]           # (bk, hd)
+        v = v_ref[0, pl.ds(kj * bk, bk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi * bq, kj * bk)
+        p = jnp.exp(s - lse)                          # (bq, bk) f32
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, q_ref.shape[2]), jnp.float32))
+    dq_ref[0] = acc.astype(dq_ref.dtype)
 
 
-def _sdpa_dkv_kernel(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, dk_ref, dv_ref,
-                     *, scale: float, causal: bool, bk: int):
+def _sdpa_dkv_kernel(g_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref, dk_ref, dv_ref,
+                     *, scale: float, causal: bool, bk: int, bq: int):
     kj = pl.program_id(1)
-    g = g_ref[0].astype(jnp.float32)      # (T, hd)
-    q = q_ref[0].astype(jnp.float32)      # (T, hd)
-    k = k_ref[0].astype(jnp.float32)      # (bk, hd)
-    v = v_ref[0].astype(jnp.float32)      # (bk, hd)
-    o = o_ref[0].astype(jnp.float32)      # (T, hd)
-    lse = lse_ref[0].astype(jnp.float32)  # (T, 1)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale  # (T, bk)
-    if causal:
-        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        col = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(row >= col, s, -jnp.inf)
-    p = jnp.exp(s - lse)                                # (T, bk)
-    dv = jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (bk, hd)
-    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (T, bk)
-    delta = jnp.sum(g * o, axis=-1, keepdims=True)      # (T, 1)
-    ds = p * (dp - delta) * scale                       # (T, bk)
-    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (bk, hd)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    k = k_ref[0]                          # (bk, hd) input dtype
+    v = v_ref[0]
+    T = q_ref.shape[1]
+    nq_all = T // bq
+    # causal: q rows strictly above the k block's start contribute nothing
+    q0 = (kj * bk) // bq if causal else 0
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(qi * bq, bq), :]           # (bq, hd)
+        g = g_ref[0, pl.ds(qi * bq, bq), :]
+        lse = lse_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)  # (bq, 1)
+        delta = delta_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)  # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi * bq, kj * bk)
+        p = jnp.exp(s - lse)                          # (bq, bk) f32
+        pb = p.astype(g.dtype)
+        dv_acc = dv_acc + jax.lax.dot_general(pb, g, (((0,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    hd = q_ref.shape[2]
+    z = jnp.zeros((bk, hd), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(q0, nq_all, body, (z, z))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
 def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
@@ -198,11 +275,15 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
     v3 = v.reshape(bh, S, hd)
     o3 = out.reshape(bh, T, hd)
     lse3 = lse.reshape(bh, T, 1)
-    bq = T if T <= 256 else max(b for b in (256, 128, 64) if T % b == 0)
-    bk = S if S <= 256 else max(b for b in (256, 128, 64) if S % b == 0)
+    # dq kernel: grid over q blocks, kv loop — single kv block when it fits.
+    bq = _pick_block(T, 256)
+    bk_dq = _pick_block(S, (4 * 1024 * 1024) // (bq * 4))
+    # dkv kernel: grid over kv blocks, q loop — single q block when it fits.
+    bk = _pick_block(S, 256)
+    bq_dkv = _pick_block(T, (4 * 1024 * 1024) // (bk * 4))
 
-    dq = pl.pallas_call(
-        functools.partial(_sdpa_dq_kernel, scale=scale_v, causal=bool(is_causal), bq=bq),
+    dq, delta3 = pl.pallas_call(
+        functools.partial(_sdpa_dq_kernel, scale=scale_v, causal=bool(is_causal), bq=bq, bk=bk_dq),
         grid=(bh, T // bq),
         in_specs=[
             pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
@@ -212,20 +293,26 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
             pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, T, hd), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, T, hd), q.dtype),
+            jax.ShapeDtypeStruct((bh, T, 1), jnp.float32),
+        ],
         interpret=_interpret(),
     )(g3, q3, k3, v3, o3, lse3)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_sdpa_dkv_kernel, scale=scale_v, causal=bool(is_causal), bk=bk),
+        functools.partial(_sdpa_dkv_kernel, scale=scale_v, causal=bool(is_causal), bk=bk, bq=bq_dkv),
         grid=(bh, S // bk),
         in_specs=[
             pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, hd), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, T, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, T, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
@@ -237,7 +324,7 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
             jax.ShapeDtypeStruct((bh, S, hd), v.dtype),
         ],
         interpret=_interpret(),
-    )(g3, q3, k3, v3, o3, lse3)
+    )(g3, q3, k3, v3, delta3, lse3)
 
     return (dq.reshape(orig_shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
