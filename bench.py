@@ -12,12 +12,13 @@ eager-style dispatch is a given on TPU).
 
 A single v5e chip (16 GB) cannot hold full 7B training state, so the model
 uses the Llama-2-7B layer geometry (dim 4096, 32 heads, MLP 11008) with
-BENCH_LAYERS layers (default 2) — per-layer arithmetic identical to 7B.
+BENCH_LAYERS layers (default 4) — per-layer arithmetic identical to 7B.
 Env overrides: BENCH_LAYERS, BENCH_BATCH, BENCH_SEQ, BENCH_STEPS.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import math
 import os
@@ -34,7 +35,7 @@ def main():
     from thunder_tpu.models import llama
     from thunder_tpu.optim import AdamW
 
-    n_layers = int(os.environ.get("BENCH_LAYERS", "2"))
+    n_layers = int(os.environ.get("BENCH_LAYERS", "4"))
     batch = int(os.environ.get("BENCH_BATCH", "1"))
     seq = int(os.environ.get("BENCH_SEQ", "2048"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
@@ -117,7 +118,7 @@ def main():
         logp = jax.nn.log_softmax(logits, -1)
         return -jnp.take_along_axis(logp, tgts.reshape(-1, 1), 1).mean()
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def jax_step(p, opt_state, toks, tgts):
         loss, grads = jax.value_and_grad(jax_loss)(p, toks, tgts)
         m, v, step = opt_state["m"], opt_state["v"], opt_state["step"] + 1.0

@@ -357,11 +357,7 @@ def pallas_ce_fwd(logits, target, ignore_index=-100):
     # size the row block by VMEM budget: the (bn, V) f32 tile must fit well
     # under the ~16MB scoped vmem limit alongside double-buffering
     budget_rows = max((4 * 1024 * 1024) // (V * 4), 1)
-    if N <= 128 and N <= budget_rows:
-        bn = N
-    else:
-        bn = max((b for b in (128, 64, 32, 16, 8) if N % b == 0 and b <= budget_rows),
-                 default=min(8, N))
+    bn = _pick_block(N, min(128, budget_rows))
     tgt2 = target.astype(jnp.int32).reshape(N, 1)
     nll, lse = pl.pallas_call(
         functools.partial(_ce_kernel, ignore_index=ignore_index),
@@ -412,7 +408,7 @@ def pallas_rms_norm(a, weight=None, eps=1e-5, dim=-1):
     D = a.shape[-1]
     N = a.size // D
     x2 = a.reshape(N, D)
-    bn = N if N <= 256 else max(b for b in (256, 128, 64, 32, 16, 8) if N % b == 0)
+    bn = _pick_block(N, 256)
     kernel = functools.partial(_rms_kernel, eps=eps, cast=a.dtype)
     if weight is None:
         def kernel_nw(x_ref, o_ref):
