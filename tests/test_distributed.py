@@ -266,3 +266,37 @@ def test_pipeline_trace_contains_ppermute(eight_devices):
     assert "ppermute" in src, "pipeline schedule should rotate activations via ppermute"
     assert "all_reduce" in src, "replicated embed/head grads should be sum-reduced"
     assert "axis_index" in src
+
+
+def test_fsdp_zero3_regathers_in_backward(eight_devices):
+    """zero=3 rewrites backward consumers of gathered params onto fresh
+    ``regather`` ops (reference rematerialize_all_gather semantics), and
+    training still matches the single-device run."""
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=4, scale_layers=2)
+    opt = AdamW(lr=3e-3)
+    tokens, targets = _data(cfg, N, 8, seed=4)
+
+    ref_losses, _ = _run_steps(tt.jit(_make_step(cfg, opt)), params, opt.init(params),
+                               tokens, targets)
+    jstep = fsdp(_make_step(cfg, opt), MeshSpec.make(fsdp=N), zero=3)
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(3):
+        loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+        losses.append(float(np.asarray(loss)))
+    np.testing.assert_allclose(ref_losses, losses, atol=1e-5, rtol=1e-5)
+
+    # the final trace inlines collectives into the XLA fusion; assert on the
+    # post-transform (pre-fusion) stage
+    srcs = [t.python() for t in tt.last_traces(jstep)]
+    n_regather = max(s.count("= regather") for s in srcs)
+    # every sharded param with a backward consumer re-gathers: at least one
+    # regather per transformer layer's weight set
+    assert n_regather >= 4, n_regather
+
+    # zero=2 (default) must NOT regather
+    jstep2 = fsdp(_make_step(cfg, opt), MeshSpec.make(fsdp=N))
+    p2 = llama.init_params(cfg, seed=4, scale_layers=2)
+    jstep2(p2, opt.init(p2), tokens, targets)
+    assert all("= regather" not in t.python() for t in tt.last_traces(jstep2))

@@ -200,6 +200,101 @@ def rematerialize_forward_and_backward(fwd: TraceCtx, bwd: TraceCtx) -> tuple[Tr
     return new_fwd, new_bwd
 
 
+def rematerialize_all_gather(trc: TraceCtx) -> TraceCtx:
+    """FSDP ZeRO-3: re-all-gather sharded params in the backward instead of
+    keeping the forward's gathered copy alive across the whole step
+    (reference ``rematerialize_all_gather``,
+    ``thunder/core/rematerialization.py:394``).
+
+    Operates on the joint fwd+bwd trace: the backward region starts at the
+    boundary comment ``inline_value_and_grad`` emits between the augmented
+    forward and the backward pass. Every FULLY_SHARDED ``synchronize`` whose
+    gathered output is consumed inside the backward gets a fresh ``regather``
+    of the shard emitted before its first backward consumer, and those
+    consumers are rewritten to use it — the forward's gathered value dies at
+    its last forward use, bounding peak memory to one gathered layer at a
+    time.
+    """
+    from thunder_tpu.core.proxies import DistParallelType
+    from thunder_tpu.core.trace import tracectx
+    from thunder_tpu.distributed.prims import DistPrimIDs, regather
+
+    bsyms = list(trc.bound_symbols)
+
+    def _marker_positions() -> list[tuple[int, int]]:
+        """(begin, end) windows of backward regions — one per value_and_grad
+        call in the step (a GAN-style step has several)."""
+        windows, begin = [], None
+        for i, b in enumerate(bsyms):
+            if b.sym.id is not PrimIDs.COMMENT or not b.args:
+                continue
+            if b.args[0] == "backward pass begins":
+                begin = i
+            elif b.args[0] == "backward pass ends" and begin is not None:
+                windows.append((begin, i))
+                begin = None
+        if begin is not None:  # unterminated (older traces): to the end
+            windows.append((begin, len(bsyms)))
+        return windows
+
+    windows = _marker_positions()
+    if not windows:
+        return trc
+
+    def _in_backward(j: int) -> bool:
+        return any(b <= j < e for b, e in windows)
+
+    # one linear pre-pass: proxy name -> consumer indices (avoids re-flattening
+    # every bsym's args once per sharded param on big traces)
+    consumers: dict[str, list[int]] = {}
+    for j, b in enumerate(bsyms):
+        for a in b.flat_proxy_args():
+            consumers.setdefault(a.name, []).append(j)
+
+    rewritten = False
+    i = 0
+    while i < len(bsyms):
+        b = bsyms[i]
+        if (b.sym.id is not DistPrimIDs.SYNCHRONIZE
+                or b.args[2] is not DistParallelType.FULLY_SHARDED
+                or _in_backward(i) or not isinstance(b.output, Proxy)):
+            i += 1
+            continue
+        w = b.output
+        late = [j for j in consumers.get(w.name, ()) if j > i and _in_backward(j)]
+        if not late:
+            i += 1
+            continue
+        # token: a backward-side operand of the first consumer — its barrier
+        # dependency pins the regather to its use site (else XLA hoists all
+        # gathers to program start, voiding the one-layer-live memory bound)
+        token = next((a for a in bsyms[late[0]].flat_proxy_args()
+                      if isinstance(a, TensorProxy) and a.name != w.name), None)
+        scope: list = []
+        with tracectx(trc):
+            trc.push_scope(scope)
+            w2 = regather(b.args[0], b.args[1], b.args[2], b.args[3], token)
+            trc.pop_scope()
+        swap = {Variable(w): w2}
+        for j in late:
+            bsyms[j] = bsyms[j].from_bsym_swap_proxies(swap, skip_output=True)
+        bsyms[late[0]:late[0]] = scope
+        windows = [(bg + len(scope), e + len(scope)) if bg >= late[0] else
+                   (bg, e + len(scope)) if e > late[0] else (bg, e)
+                   for bg, e in windows]
+        for name, idxs in consumers.items():
+            consumers[name] = [j + len(scope) if j >= late[0] else j for j in idxs]
+        rewritten = True
+        i += 1
+
+    if not rewritten:
+        return trc
+    new = from_trace(trc)
+    new.bound_symbols = bsyms
+    new.set_provenance("FSDP ZeRO-3 all-gather rematerialization")
+    return new
+
+
 # ---------------------------------------------------------------------------
 # activation checkpointing (NEW capability — absent upstream, SURVEY §2.2)
 # ---------------------------------------------------------------------------

@@ -303,7 +303,11 @@ def inline_value_and_grad(fn, argnums=0, has_aux: bool = False):
         check(isinstance(loss, TensorProxy) and loss.numel == 1 and loss.dtype.is_inexact,
               lambda: f"grad requires a scalar float loss, got {loss}")
         grads: dict[Variable, Any] = {Variable(loss): ops.ones_like(loss)}
+        # boundary marker: trace passes that distinguish forward from backward
+        # (e.g. FSDP ZeRO-3 rematerialize_all_gather) key off this comment
+        prims.comment("backward pass begins")
         backward_pass(records, grads)
+        prims.comment("backward pass ends")
 
         def grad_of(x):
             if isinstance(x, TensorProxy):

@@ -35,6 +35,7 @@ class DistPrimIDs(Enum):
     ALL_TO_ALL = auto()
     WAIT = auto()
     SYNCHRONIZE = auto()
+    REGATHER = auto()
     SYNCHRONIZE_TP_OUTPUT = auto()
     SYNCHRONIZE_TP_INPUT = auto()
     AXIS_INDEX = auto()
@@ -119,7 +120,8 @@ axis_index = make_prim(DistPrimIDs.AXIS_INDEX, "axis_index", _axis_index_meta,
 
 
 # synchronize: the polymorphic param-sync op (reference prims.py:376-419).
-def _synchronize_meta(a: TensorProxy, axis: str, parallel_type: DistParallelType, size: int) -> TensorProxy:
+def _synchronize_meta(a: TensorProxy, axis: str, parallel_type: DistParallelType, size: int,
+                      token: TensorProxy | None = None) -> TensorProxy:
     if parallel_type is DistParallelType.FULLY_SHARDED:
         shape = (a.shape[0] * size,) + a.shape[1:]
         return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
@@ -131,6 +133,13 @@ def _synchronize_meta(a: TensorProxy, axis: str, parallel_type: DistParallelType
 
 synchronize = make_prim(DistPrimIDs.SYNCHRONIZE, "synchronize", _synchronize_meta,
                         tags=(OpTags.COLLECTIVE_OP,))
+
+# regather: a backward-pass re-issue of a FULLY_SHARDED synchronize (FSDP
+# ZeRO-3, reference rematerialization.py:394 rematerialize_all_gather). A
+# distinct prim so neither trace-level CSE nor XLA CSE folds it back into the
+# forward gather (its lowering starts with an optimization barrier).
+regather = make_prim(DistPrimIDs.REGATHER, "regather", _synchronize_meta,
+                     tags=(OpTags.COLLECTIVE_OP,))
 
 
 def _sync_tp_output_meta(a: TensorProxy, axis: str, size: int) -> TensorProxy:
@@ -209,7 +218,23 @@ def _axis_index_impl(axis):
 
 
 @impl(DistPrimIDs.SYNCHRONIZE)
-def _synchronize_impl(a, axis, parallel_type, size):
+def _synchronize_impl(a, axis, parallel_type, size, token=None):
+    if parallel_type is DistParallelType.FULLY_SHARDED:
+        return jax.lax.all_gather(a, axis, axis=0, tiled=True)
+    return a
+
+
+@impl(DistPrimIDs.REGATHER)
+def _regather_impl(a, axis, parallel_type, size, token=None):
+    # the barrier prevents XLA CSE from merging this with the forward
+    # all_gather (which would revert ZeRO-3 to ZeRO-2); chaining ``token``
+    # (an operand of the first backward consumer) through the same barrier
+    # adds a data dependency that stops the scheduler from hoisting every
+    # regather to program start — the gather runs just before its use
+    if token is not None:
+        a = jax.lax.optimization_barrier((a, token))[0]
+    else:
+        a = jax.lax.optimization_barrier(a)
     if parallel_type is DistParallelType.FULLY_SHARDED:
         return jax.lax.all_gather(a, axis, axis=0, tiled=True)
     return a

@@ -33,6 +33,7 @@ from thunder_tpu.core.baseutils import check
 from thunder_tpu.core.devices import MeshSpec
 from thunder_tpu.core.proxies import DistParallelType, TensorProxy
 from thunder_tpu.core.pytree import tree_flatten, tree_map
+from thunder_tpu.core.transform_common import Transform
 
 
 def _shard_map():
@@ -63,12 +64,22 @@ class LeafPlan:
         self.shard_dim = shard_dim
 
 
+class _Zero3Transform(Transform):
+    """FSDP ZeRO-3 (reference ``FSDPType.ZERO3``): re-all-gather params in
+    the backward via the ``rematerialize_all_gather`` trace pass."""
+
+    def transform_traces_pre_prologue(self, prologue_trc, computation_trc, epilogue_trc, **kw):
+        from thunder_tpu.core.rematerialization import rematerialize_all_gather
+
+        return prologue_trc, rematerialize_all_gather(computation_trc), epilogue_trc
+
+
 class DistributedFunction(ThunderTPUFunction):
     def __init__(self, fn, mesh_spec: MeshSpec, *, mode: str, axis: str,
                  params_argnums: Sequence[int] = (0,), column_patterns=(), row_patterns=(),
                  expert_patterns=(), stage_patterns=(), shard_data: bool = True,
                  data_argnums: Sequence[int] | None = None,
-                 zero: int = 3, **jit_kwargs):
+                 zero: int = 2, **jit_kwargs):
         self.data_argnums = tuple(data_argnums) if data_argnums is not None else None
         self.expert_re = re.compile("|".join(expert_patterns)) if expert_patterns else None
         self.stage_re = re.compile("|".join(stage_patterns)) if stage_patterns else None
@@ -96,6 +107,8 @@ class DistributedFunction(ThunderTPUFunction):
         check(jit_kwargs.get("cache", "constant values") != "symbolic values",
               "symbolic-values caching is not supported under distributed transforms "
               "(leaf plans and shard specs are built per concrete call)")
+        if mode == "fsdp" and zero == 3:
+            jit_kwargs["transforms"] = tuple(jit_kwargs.get("transforms", ())) + (_Zero3Transform(),)
         super().__init__(wrapped, **jit_kwargs)
         self._orig_fn = fn
 
@@ -306,16 +319,21 @@ def _default_mesh_spec(axis: str) -> MeshSpec:
 
 
 def fsdp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "fsdp",
-         params_argnums: Sequence[int] = (0,), zero: int = 3, **jit_kwargs) -> DistributedFunction:
+         params_argnums: Sequence[int] = (0,), zero: int = 2, **jit_kwargs) -> DistributedFunction:
     """Fully-sharded data parallel (ZeRO-2/3 semantics; reference
-    ``thunder/distributed/__init__.py:574``).
+    ``thunder/distributed/__init__.py:574``, default ``FSDPType.ZERO2`` there
+    too).
 
     Params (argnums ``params_argnums``) are sharded on dim 0 across ``axis``;
     the trace all-gathers them inside the grad scope, reduce-scatters grads,
     and the traced optimizer updates shards (optimizer state is born sharded
-    — ZeRO-1 included for free). Whether backward re-gathers (ZeRO-3) or
-    keeps gathered params (ZeRO-2) is XLA's rematerialization choice over the
-    single fused program.
+    — ZeRO-1 included for free). ``zero=2``: the forward's gathered params
+    stay available to the backward (XLA may still rematerialize under memory
+    pressure). ``zero=3``: the ``rematerialize_all_gather`` trace pass
+    rewrites backward consumers onto a fresh ``regather`` of the shard, so
+    at most one gathered layer is ever live — the reference's ZeRO-3
+    (``rematerialization.py:394``), pinned against XLA CSE by an
+    optimization barrier.
     """
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="fsdp", axis=axis,

@@ -442,7 +442,13 @@ def _rms_checker(a, weight=None, eps=1e-5, dim=-1):
         return False
     if _interpret():
         return True
-    return a.shape[-1] % 128 == 0
+    D = a.shape[-1]
+    N = 1
+    for d in a.shape[:-1]:
+        N *= int(d)
+    # rows must tile (min sublane block 8) and the largest row block's f32
+    # tile must fit VMEM alongside double-buffering
+    return D % 128 == 0 and N % 8 == 0 and 256 * D * 4 <= 8 * 1024 * 1024
 
 
 # ---------------------------------------------------------------------------
