@@ -1,0 +1,69 @@
+"""Data-dependent partitioner tests (reference data_dependent_partition.py:
+dataflow_merge/horizontal_merge behavior through the XLA fusion pass)."""
+
+import numpy as np
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+from thunder_tpu.executors.data_dependent_partition import fuse_bound_symbols
+
+
+def _trace_of(fn, *args):
+    jfn = tt.jit(fn)
+    jfn(*args)
+    return tt.last_traces(jfn)
+
+
+def test_unfusible_op_does_not_split_independent_chains():
+    """An ITEM (device sync, unfusible) between two independent fusible
+    chains in program order must not split them into separate regions."""
+    def fn(a, b):
+        x = ops.mul(ops.add(a, 1.0), 2.0)      # chain 1 (fusible)
+        s = ops.item(ops.sum(b))                # unfusible sync op
+        y = ops.mul(ops.add(a, 3.0), 4.0)      # chain 2, independent of s
+        return ops.add(x, y), s
+
+    traces = _trace_of(fn, np.ones((4, 4), np.float32), np.ones((2,), np.float32))
+    final = traces[-1].python()
+    # dataflow partitioning puts both chains (and the sum feeding item) into
+    # one fusion; only item itself stays out -> exactly one xla fusion
+    assert final.count("= xla_fusion") == 1, final
+
+
+def test_partitioner_no_cycles_and_complete():
+    def fn(a):
+        b = ops.add(a, 1.0)
+        c = ops.item(ops.sum(b))      # unfusible, depends on b
+        d = ops.mul(b, 2.0)           # fusible, depends on b only
+        e = ops.add(d, ops.convert_element_type(c, dtypes.float32))
+        return e
+
+    traces = _trace_of(fn, np.ones((3,), np.float32))
+    src = traces[-1].python()
+    # two fusions: {add, sum, mul} before item, {convert/add} after — the
+    # cycle guard must NOT merge them through item
+    assert src.count("= xla_fusion") >= 1
+    # numerics
+    jfn = tt.jit(fn)
+    out = jfn(np.ones((3,), np.float32))
+    assert np.allclose(np.asarray(out), (1.0 + 1.0) * 2.0 + 6.0)
+
+
+def test_fuse_bound_symbols_groups_topological():
+    def fn(a):
+        x = ops.add(a, 1.0)
+        y = ops.mul(x, 2.0)
+        return y
+
+    traces = _trace_of(fn, np.ones((2,), np.float32))
+    trc = traces[0]
+    groups = fuse_bound_symbols(trc.bound_symbols, lambda b: b.sym.name != "python_return")
+    flat = [b for g in groups for b in g]
+    assert len(flat) == len(trc.bound_symbols)
+    produced = set()
+    for b in flat:
+        for a_ in b.flat_proxy_args():
+            assert a_.name in produced or any(a_.name == p.name for p in trc.args), a_.name
+        for o in b.flat_proxy_outs():
+            produced.add(o.name)

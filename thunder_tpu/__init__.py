@@ -325,6 +325,14 @@ class ThunderTPUFunction:
                 "compile the entire execution trace as one XLA program "
                 "(persistent executable; CUDA-graphs analog)", True):
             return
+        # host-sync ops (item etc.) need concrete values — they cannot live
+        # under an outer jit; keep the per-region path (regions stay compiled,
+        # sync ops run eagerly between them)
+        from thunder_tpu.core.prims import OpTags as _OpTags
+
+        for b in exec_trc.bound_symbols:
+            if _OpTags.DEVICE_SYNC_OP in b.sym.tags:
+                return
         import jax
 
         donate_args = tuple(get_compile_option(

@@ -1,0 +1,184 @@
+"""Data-dependent fusion partitioning.
+
+Reference parity: ``thunder/executors/data_dependent_partition.py`` — a
+dataflow ``Graph`` over bound symbols (:79), iterative ``dataflow_merge``
+(:213) and ``horizontal_merge`` (:252) with cycle avoidance, and
+``fuse_bound_symbols(trace, merge_fn)`` (:300) returning ordered groups.
+
+Why not just fuse contiguous runs: an unfusible op in *program order* (a
+Pallas-claimed kernel, an ITEM sync, a COMMENT) does not necessarily sit on
+the *dataflow* path between its neighbours — contiguous grouping would split
+one legal fusion region into two. Here regions are maximal under dataflow:
+two fusible ops land in one group unless merging them would create a cycle
+through a non-member (which would make the region's inputs depend on its own
+outputs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from thunder_tpu.core.symbol import BoundSymbol
+
+
+class Node:
+    """A mergeable group of bound symbols (starts as a single bsym)."""
+
+    __slots__ = ("bsyms", "parents", "children", "min_index", "max_index", "order")
+
+    def __init__(self, bsym: BoundSymbol, index: int):
+        self.bsyms: list[BoundSymbol] = [bsym]
+        self.parents: set[Node] = set()
+        self.children: set[Node] = set()
+        self.min_index = index
+        self.max_index = index
+        self.order: dict[int, int] = {id(bsym): index}  # program order of members
+
+    def __repr__(self):
+        return f"<Node {[b.sym.name for b in self.bsyms]}>"
+
+
+class Graph:
+    """Dataflow graph over a trace's bound symbols (reference ``Graph`` :79)."""
+
+    def __init__(self, bsyms: Sequence[BoundSymbol]):
+        self.nodes: list[Node] = [Node(b, i) for i, b in enumerate(bsyms)]
+        producer_of: dict[str, Node] = {}
+        for n in self.nodes:
+            for b in n.bsyms:
+                for o in b.flat_proxy_outs():
+                    producer_of[o.name] = n
+        for n in self.nodes:
+            for b in n.bsyms:
+                for a in b.flat_proxy_args():
+                    p = producer_of.get(a.name)
+                    if p is not None and p is not n:
+                        n.parents.add(p)
+                        p.children.add(n)
+
+    def _reachable(self, src: Node, dst: Node, *, skip_direct: bool) -> bool:
+        """Is there a path src -> dst (optionally ignoring the direct edge)?
+
+        Pure DFS — no index-based pruning: once nodes merge, a node can be
+        entered via a high-program-index member and exited via a low-index
+        one, so member-index bounds cannot soundly prune paths (an earlier
+        pruned version produced cycles under fuzzing).
+        """
+        stack = [c for c in src.children if not (skip_direct and c is dst)]
+        seen: set[int] = set()
+        while stack:
+            n = stack.pop()
+            if n is dst:
+                return True
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            stack.extend(n.children)
+        return False
+
+    def merge(self, a: Node, b: Node) -> Node:
+        """Fold ``b`` into ``a`` (bsyms kept in program order)."""
+        a.order.update(b.order)
+        a.bsyms = sorted(a.bsyms + b.bsyms, key=lambda bs: a.order[id(bs)])
+        a.min_index = min(a.min_index, b.min_index)
+        a.max_index = max(a.max_index, b.max_index)
+        for p in b.parents:
+            p.children.discard(b)
+            if p is not a:
+                p.children.add(a)
+                a.parents.add(p)
+        for c in b.children:
+            c.parents.discard(b)
+            if c is not a:
+                c.parents.add(a)
+                a.children.add(c)
+        a.parents.discard(b)
+        a.children.discard(b)
+        a.parents.discard(a)
+        a.children.discard(a)
+        self.nodes.remove(b)
+        return a
+
+    def dataflow_merge(self, can_merge: Callable[[Node, Node], bool]) -> None:
+        """Merge producer->consumer pairs until fixpoint (reference :213).
+        A pair is mergeable when ``can_merge`` allows it and no *other* path
+        connects them (merging would otherwise create a cycle)."""
+        changed = True
+        while changed:
+            changed = False
+            for n in list(self.nodes):
+                if n not in self.nodes:
+                    continue
+                for c in sorted(n.children, key=lambda x: x.min_index):
+                    if not can_merge(n, c):
+                        continue
+                    if self._reachable(n, c, skip_direct=True):
+                        continue  # indirect path through a non-member: cycle
+                    self.merge(n, c)
+                    changed = True
+                    break
+
+    def horizontal_merge(self, can_merge: Callable[[Node, Node], bool]) -> None:
+        """Merge sibling nodes (no path either way) that share a parent or
+        are both roots (reference :252) — catches parallel branches that the
+        vertical pass cannot join."""
+        changed = True
+        while changed:
+            changed = False
+            groups: list[list[Node]] = []
+            roots = [n for n in self.nodes if not n.parents]
+            if len(roots) > 1:
+                groups.append(roots)
+            for n in self.nodes:
+                if len(n.children) > 1:
+                    groups.append(sorted(n.children, key=lambda x: x.min_index))
+            for group in groups:
+                for i in range(len(group)):
+                    for j in range(i + 1, len(group)):
+                        a, b = group[i], group[j]
+                        if a not in self.nodes or b not in self.nodes or a is b:
+                            continue
+                        if not can_merge(a, b):
+                            continue
+                        if self._reachable(a, b, skip_direct=False) or \
+                                self._reachable(b, a, skip_direct=False):
+                            continue
+                        self.merge(a, b)
+                        changed = True
+                if changed:
+                    break
+
+    def toposorted(self) -> list[Node]:
+        """Topological order, stable by minimum original index."""
+        indeg = {id(n): len(n.parents) for n in self.nodes}
+        import heapq
+
+        ready = [(n.min_index, id(n), n) for n in self.nodes if not n.parents]
+        heapq.heapify(ready)
+        out: list[Node] = []
+        while ready:
+            _, _, n = heapq.heappop(ready)
+            out.append(n)
+            for c in n.children:
+                indeg[id(c)] -= 1
+                if indeg[id(c)] == 0:
+                    heapq.heappush(ready, (c.min_index, id(c), c))
+        if len(out) != len(self.nodes):  # pragma: no cover - cycle guard
+            raise RuntimeError("partition graph has a cycle")
+        return out
+
+
+def fuse_bound_symbols(bsyms: Sequence[BoundSymbol],
+                       fusible: Callable[[BoundSymbol], bool]) -> list[list[BoundSymbol]]:
+    """Partition ``bsyms`` into an ordered list of groups: maximal fusible
+    regions under dataflow plus singleton groups for unfusible ops
+    (reference ``fuse_bound_symbols`` :300). Within each group, bsyms keep
+    program order; groups come out topologically sorted."""
+    g = Graph(bsyms)
+
+    def can_merge(a: Node, b: Node) -> bool:
+        return all(fusible(x) for x in a.bsyms) and all(fusible(x) for x in b.bsyms)
+
+    g.dataflow_merge(can_merge)
+    g.horizontal_merge(can_merge)
+    return [n.bsyms for n in g.toposorted()]

@@ -106,48 +106,52 @@ class XLAFusionExecutor(FusionExecutor):
             "xla_min_region_size",
             "minimum bound symbols per XLA fusion region; smaller regions stay eager",
             self.min_region_size)
+        partitioner = get_compile_option(
+            "xla_partitioner",
+            "fusion region formation: 'dataflow' (data-dependent partitioner — "
+            "maximal regions under the dataflow graph, reference "
+            "data_dependent_partition.py) or 'contiguous' (greedy program-order runs)",
+            "dataflow")
         # outputs of the whole trace stay live
         live_out = {Variable(o) for o in tree_flatten(trc.output)[0] if isinstance(o, Proxy)}
 
-        groups: list[list[BoundSymbol]] = []
-        current: list[BoundSymbol] = []
-        ordered: list[Any] = []  # bsyms or ("group", idx)
-        for bsym in trc.bound_symbols:
-            if self.can_fuse(bsym) and self.get_fuel():
-                current.append(bsym)
-            else:
-                if current:
-                    ordered.append(("group", len(groups)))
-                    groups.append(current)
-                    current = []
-                ordered.append(bsym)
-        if current:
-            ordered.append(("group", len(groups)))
-            groups.append(current)
+        def fusible(bsym: BoundSymbol) -> bool:
+            return self.can_fuse(bsym) and self.get_fuel()
 
-        # consumers after each group decide region outputs
+        groups: list[list[BoundSymbol]]
+        if partitioner == "dataflow":
+            from thunder_tpu.executors.data_dependent_partition import fuse_bound_symbols
+
+            # fuel consumption must be deterministic per bsym: memoize
+            fuel_ok = {id(b): fusible(b) for b in trc.bound_symbols}
+            groups = fuse_bound_symbols(trc.bound_symbols, lambda b: fuel_ok[id(b)])
+        else:
+            groups = []
+            current: list[BoundSymbol] = []
+            for bsym in trc.bound_symbols:
+                if fusible(bsym):
+                    current.append(bsym)
+                else:
+                    if current:
+                        groups.append(current)
+                        current = []
+                    groups.append([bsym])
+            if current:
+                groups.append(current)
+            fuel_ok = {id(b): self.can_fuse(b) for b in trc.bound_symbols}
+
         new = from_trace(trc)
         new_bsyms: list[BoundSymbol] = []
-        consumed_later: list[set[Variable]] = []
-        # precompute: for entry i, vars consumed by entries after i
-        all_entries = ordered
+        # for group i: vars consumed by groups after i (region outputs)
         suffix_consumed: set[Variable] = set(live_out)
-        suffix_sets = [None] * len(all_entries)
-        for i in range(len(all_entries) - 1, -1, -1):
+        suffix_sets: list[set[Variable]] = [set()] * len(groups)
+        for i in range(len(groups) - 1, -1, -1):
             suffix_sets[i] = set(suffix_consumed)
-            e = all_entries[i]
-            if isinstance(e, tuple):
-                for b in groups[e[1]]:
-                    suffix_consumed |= consumed_vars(b)
-            else:
-                suffix_consumed |= consumed_vars(e)
+            for b in groups[i]:
+                suffix_consumed |= consumed_vars(b)
 
-        for i, e in enumerate(all_entries):
-            if not isinstance(e, tuple):
-                new_bsyms.append(e)
-                continue
-            gbsyms = groups[e[1]]
-            if len(gbsyms) < min_region_size:
+        for i, gbsyms in enumerate(groups):
+            if len(gbsyms) < min_region_size or not all(fuel_ok[id(b)] for b in gbsyms):
                 new_bsyms.extend(gbsyms)
                 continue
             new_bsyms.append(self._make_fusion_bsym(gbsyms, suffix_sets[i], new))
