@@ -277,7 +277,15 @@ class ThunderTPUFunction:
         traces.append(exec_trc)
         self._stats.last_transform_ns = time.perf_counter_ns() - t1
 
-        computation_fn = exec_trc.python_callable()
+        from thunder_tpu.core.compile_data import get_compile_option
+
+        execution_file = get_compile_option(
+            "execution_file",
+            "dump the final generated program to this file — or, if the file "
+            "already exists (user-edited), execute its contents instead "
+            "(reference set_execution_callback_file: hand-patch generated code)",
+            None)
+        computation_fn = exec_trc.python_callable(execution_file=execution_file)
         prologue_fn = prologue.python_callable()
         # sanity-run the prologue guards once on the compiling inputs
         prologue_fn(*flat)

@@ -187,10 +187,117 @@ def make_train_step_benchmark(config: str = "tiny", batch: int = 4, seq: int = 2
     return b
 
 
+def make_gelu_benchmark(N=8192, D=11008) -> Benchmark:
+    """Reference: LitGPT GELU microbenchmark (``thunder/benchmarks/targets.py``)."""
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        x = _np_rng().randn(N, D).astype(np.float32)
+
+        def fn(x):
+            return ops.gelu(x, approximate="tanh")
+
+        return fn, (x,)
+
+    return Benchmark(f"gelu_N{N}D{D}", make)
+
+
+def make_layernorm_benchmark(N=8192, D=4096) -> Benchmark:
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        rng = _np_rng()
+        x = rng.randn(N, D).astype(np.float32)
+        w = rng.randn(D).astype(np.float32)
+        b = rng.randn(D).astype(np.float32)
+
+        def fn(x, w, b):
+            return ops.layer_norm(x, (D,), w, b)
+
+        return fn, (x, w, b)
+
+    return Benchmark(f"layer_norm_N{N}D{D}", make)
+
+
+def make_einsum_benchmark(B=8, I=512, J=512, K=512) -> Benchmark:
+    """Reference: einsum benchmark family (``thunder/benchmarks/einsum.py``)."""
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+
+        rng = _np_rng()
+        a = rng.randn(B, I, J).astype(np.float32)
+        b = rng.randn(B, J, K).astype(np.float32)
+
+        def fn(a, b):
+            return ops.einsum("bij,bjk->bik", a, b)
+
+        return fn, (a, b)
+
+    return Benchmark(f"einsum_bij_bjk_B{B}", make)
+
+
+def make_nanogpt_attn_benchmark(B=8, T=1024, config: str = "gpt2-tiny") -> Benchmark:
+    """nanoGPT causal-self-attention module (reference ``NanoGPTCSABenchmark``)."""
+    def make():
+        import numpy as np
+
+        from thunder_tpu import ops
+        from thunder_tpu.models import nanogpt
+
+        cfg = nanogpt.CONFIGS[config]
+        D, H = cfg.n_embd, cfg.n_head
+        rng = _np_rng()
+        x = rng.randn(B, T, D).astype(np.float32)
+        wqkv = (rng.randn(3 * D, D) / np.sqrt(D)).astype(np.float32)
+        wo = (rng.randn(D, D) / np.sqrt(D)).astype(np.float32)
+
+        def fn(x, wqkv, wo):
+            qkv = ops.linear(x, wqkv)
+            q, k, v = [ops.transpose(ops.reshape(t, (B, T, H, D // H)), (0, 2, 1, 3))
+                       for t in ops.chunk(qkv, 3, -1)]
+            o = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
+            return ops.linear(ops.reshape(ops.transpose(o, (0, 2, 1, 3)), (B, T, D)), wo)
+
+        return fn, (x, wqkv, wo)
+
+    return Benchmark(f"nanogpt_csa_B{B}T{T}", make)
+
+
+def make_nanogpt_block_benchmark(config: str = "gpt2-tiny", B=8, T=1024) -> Benchmark:
+    """One full nanoGPT block fwd (reference ``NanoGPTBlockBenchmark``)."""
+    def make():
+        import numpy as np
+
+        from thunder_tpu.models import nanogpt
+
+        cfg = nanogpt.CONFIGS[config]
+        params = nanogpt.init_params(cfg, seed=0, scale_layers=1)
+        rng = _np_rng()
+        tokens = rng.randint(0, cfg.vocab_size, size=(B, min(T, cfg.block_size))).astype(np.int32)
+
+        def fn(params, tokens):
+            return nanogpt.forward(params, tokens, cfg)
+
+        return fn, (params, tokens)
+
+    return Benchmark(f"nanogpt_block_B{B}", make)
+
+
 DEFAULT_BENCHMARKS: dict[str, Callable[[], Benchmark]] = {
     "sdpa": make_sdpa_benchmark,
     "cross_entropy": make_cross_entropy_benchmark,
     "llama_mlp": make_llama_mlp_benchmark,
     "rms_norm": make_rmsnorm_benchmark,
+    "layer_norm": make_layernorm_benchmark,
+    "gelu": make_gelu_benchmark,
+    "einsum": make_einsum_benchmark,
+    "nanogpt_csa": make_nanogpt_attn_benchmark,
+    "nanogpt_block": make_nanogpt_block_benchmark,
     "train_step": make_train_step_benchmark,
 }

@@ -1,0 +1,64 @@
+"""pytest benchmark grid (reference ``thunder/benchmarks/targets.py``):
+every workload x executor stack x {fwd, fwd+bwd}, runnable as
+
+    THUNDER_TPU_BENCH=1 pytest thunder_tpu/benchmarks/targets.py -v -s
+
+Skipped by default (env gate) so the correctness suite stays fast; on TPU
+each case prints the harness summary (median/IQR/compile split).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from thunder_tpu.benchmarks import DEFAULT_BENCHMARKS
+
+_RUN = os.environ.get("THUNDER_TPU_BENCH") == "1"
+
+EXECUTOR_STACKS = {
+    "xla": ["xla"],
+    "pallas+xla": None,  # defaults: pallas kernels claim above XLA fusion
+}
+
+_GRAD_WORKLOADS = {"sdpa", "cross_entropy", "llama_mlp", "rms_norm", "layer_norm",
+                   "gelu", "einsum", "nanogpt_csa"}
+
+
+@pytest.mark.parametrize("stack", list(EXECUTOR_STACKS))
+@pytest.mark.parametrize("workload", list(DEFAULT_BENCHMARKS))
+def test_benchmark_forward(workload, stack):
+    if not _RUN:
+        pytest.skip("set THUNDER_TPU_BENCH=1 to run benchmarks")
+    bench = DEFAULT_BENCHMARKS[workload]()
+    stats = bench.run(executors=EXECUTOR_STACKS[stack])
+    print("\n" + stats.summary())
+
+
+@pytest.mark.parametrize("stack", list(EXECUTOR_STACKS))
+@pytest.mark.parametrize("workload", sorted(_GRAD_WORKLOADS))
+def test_benchmark_forward_backward(workload, stack):
+    if not _RUN:
+        pytest.skip("set THUNDER_TPU_BENCH=1 to run benchmarks")
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    from thunder_tpu.benchmarks import Benchmark, time_fn
+
+    bench = DEFAULT_BENCHMARKS[workload]()
+    fn, args = bench.make()
+
+    def loss_fn(*a):
+        out = fn(*a)
+        first = out[0] if isinstance(out, tuple) else out
+        return ops.sum(ops.convert_element_type(first, tt.core.dtypes.float32)) \
+            if hasattr(first, "dtype") else first
+
+    def fwd_bwd(*a):
+        return tt.value_and_grad(loss_fn)(*a)
+
+    jfn = tt.jit(fwd_bwd, executors=EXECUTOR_STACKS[stack])
+    stats = time_fn(jfn, *args, name=f"{bench.name}_fwdbwd[{stack}]")
+    print("\n" + stats.summary())

@@ -150,10 +150,45 @@ class TraceCtx:
         ctx.update(self._python_ctx_extra)
         return ctx
 
-    def python_callable(self) -> Callable:
+    def python_callable(self, execution_file: str | None = None) -> Callable:
         source = self.python()
+        if execution_file is not None:
+            # execution hook (reference ``_set_execution_file``,
+            # ``thunder/core/trace.py:612-622``): dump the final program to
+            # the file — or, if the user edited it there, execute the file's
+            # contents instead of the generated source (hand-patching of
+            # generated code between runs). A content-hash trailer
+            # distinguishes machine-written (safe to overwrite: a recompile
+            # or a new specialization must not execute a stale program) from
+            # user-edited files.
+            import hashlib
+            import os
+
+            def _with_marker(src: str) -> str:
+                h = hashlib.sha1(src.encode()).hexdigest()[:16]
+                return src + f"\n# thunder-tpu-execution-file-hash: {h}\n"
+
+            def _is_machine_written(text: str) -> bool:
+                lines = text.rstrip("\n").splitlines()
+                if not lines or not lines[-1].startswith("# thunder-tpu-execution-file-hash: "):
+                    return False
+                h = lines[-1].split(": ", 1)[1].strip()
+                body = "\n".join(lines[:-1])
+                return hashlib.sha1(body.encode()).hexdigest()[:16] == h
+
+            if os.path.exists(execution_file):
+                with open(execution_file) as f:
+                    text = f.read()
+                if _is_machine_written(text):
+                    with open(execution_file, "w") as f:
+                        f.write(_with_marker(source))
+                else:
+                    source = text  # user-edited: execute their program
+            else:
+                with open(execution_file, "w") as f:
+                    f.write(_with_marker(source))
         ctx = self.python_ctx()
-        code = compile(source, f"thunder_tpu.gen_{self.fn_name}", "exec")
+        code = compile(source, execution_file or f"thunder_tpu.gen_{self.fn_name}", "exec")
         module_ns: dict[str, Any] = dict(ctx)
         exec(code, module_ns)
         fn = module_ns[self.siginfo().name]

@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Callable, Sequence
 
 from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.utils import consumed_vars, produced_vars
 
 
 class Node:
@@ -43,15 +44,18 @@ class Graph:
 
     def __init__(self, bsyms: Sequence[BoundSymbol]):
         self.nodes: list[Node] = [Node(b, i) for i, b in enumerate(bsyms)]
+        # recursive consumed/produced (like the fusion pass's region-IO
+        # computation): a composite whose SUBSYMBOLS read a proxy absent from
+        # its top-level args still depends on that proxy's producer
         producer_of: dict[str, Node] = {}
         for n in self.nodes:
             for b in n.bsyms:
-                for o in b.flat_proxy_outs():
-                    producer_of[o.name] = n
+                for v in produced_vars(b):
+                    producer_of[v.proxy.name] = n
         for n in self.nodes:
             for b in n.bsyms:
-                for a in b.flat_proxy_args():
-                    p = producer_of.get(a.name)
+                for v in consumed_vars(b):
+                    p = producer_of.get(v.proxy.name)
                     if p is not None and p is not n:
                         n.parents.add(p)
                         p.children.add(n)
@@ -175,9 +179,12 @@ def fuse_bound_symbols(bsyms: Sequence[BoundSymbol],
     (reference ``fuse_bound_symbols`` :300). Within each group, bsyms keep
     program order; groups come out topologically sorted."""
     g = Graph(bsyms)
+    node_fusible = {id(n): fusible(n.bsyms[0]) for n in g.nodes}
 
     def can_merge(a: Node, b: Node) -> bool:
-        return all(fusible(x) for x in a.bsyms) and all(fusible(x) for x in b.bsyms)
+        # merged nodes only ever contain fusible members, so the per-node
+        # flag (cached at creation, AND-ed on merge by construction) suffices
+        return node_fusible[id(a)] and node_fusible[id(b)]
 
     g.dataflow_merge(can_merge)
     g.horizontal_merge(can_merge)

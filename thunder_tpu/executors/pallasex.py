@@ -408,7 +408,8 @@ def pallas_rms_norm(a, weight=None, eps=1e-5, dim=-1):
     D = a.shape[-1]
     N = a.size // D
     x2 = a.reshape(N, D)
-    bn = _pick_block(N, 256)
+    # in+out f32 tiles are double-buffered: keep bn*D*4 under ~1.5MB
+    bn = _pick_block(N, max(8, min(256, (3 * 1024 * 1024) // (D * 8))))
     kernel = functools.partial(_rms_kernel, eps=eps, cast=a.dtype)
     if weight is None:
         def kernel_nw(x_ref, o_ref):
@@ -446,9 +447,9 @@ def _rms_checker(a, weight=None, eps=1e-5, dim=-1):
     N = 1
     for d in a.shape[:-1]:
         N *= int(d)
-    # rows must tile (min sublane block 8) and the largest row block's f32
+    # rows must tile (min sublane block 8) and the smallest row block's f32
     # tile must fit VMEM alongside double-buffering
-    return D % 128 == 0 and N % 8 == 0 and 256 * D * 4 <= 8 * 1024 * 1024
+    return D % 128 == 0 and N % 8 == 0 and 8 * D * 8 <= 3 * 1024 * 1024
 
 
 # ---------------------------------------------------------------------------

@@ -118,18 +118,21 @@ class XLAFusionExecutor(FusionExecutor):
         def fusible(bsym: BoundSymbol) -> bool:
             return self.can_fuse(bsym) and self.get_fuel()
 
+        # fuel consumption must be deterministic per bsym: memoize once and
+        # use the same answers for grouping AND emission (a fuel-denied bsym
+        # must stay unfused on every path — fuel bisection depends on it)
+        fuel_ok = {id(b): fusible(b) for b in trc.bound_symbols}
+
         groups: list[list[BoundSymbol]]
         if partitioner == "dataflow":
             from thunder_tpu.executors.data_dependent_partition import fuse_bound_symbols
 
-            # fuel consumption must be deterministic per bsym: memoize
-            fuel_ok = {id(b): fusible(b) for b in trc.bound_symbols}
             groups = fuse_bound_symbols(trc.bound_symbols, lambda b: fuel_ok[id(b)])
         else:
             groups = []
             current: list[BoundSymbol] = []
             for bsym in trc.bound_symbols:
-                if fusible(bsym):
+                if fuel_ok[id(bsym)]:
                     current.append(bsym)
                 else:
                     if current:
@@ -138,7 +141,6 @@ class XLAFusionExecutor(FusionExecutor):
                     groups.append([bsym])
             if current:
                 groups.append(current)
-            fuel_ok = {id(b): self.can_fuse(b) for b in trc.bound_symbols}
 
         new = from_trace(trc)
         new_bsyms: list[BoundSymbol] = []
