@@ -157,3 +157,43 @@ def test_llama_sgd_momentum_step():
     for _ in range(10):
         l1, params, opt_state = jstep(params, opt_state, tokens, targets)
     assert float(np.asarray(l1)) < float(np.asarray(l0))
+
+
+def test_seq2seq_cross_attention_trains():
+    """Encoder-decoder (BART/T5-style) with cross-attention (T != S):
+    fwd/bwd through jit, loss decreases, matches eager executor."""
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu.models import seq2seq
+    from thunder_tpu.optim import AdamW
+
+    cfg = seq2seq.CONFIGS["tiny"]
+    params = seq2seq.init_params(cfg, seed=0)
+    opt = AdamW(lr=3e-3)
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, cfg.vocab_size, size=(2, 24)).astype(np.int32)   # S=24
+    tgt = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)   # T=16
+    labels = np.roll(tgt, -1, axis=1).astype(np.int32)
+
+    def step(p, s, src, tgt, labels):
+        loss, grads = tt.value_and_grad(
+            lambda q: seq2seq.loss_fn(q, src, tgt, labels, cfg))(p)
+        newp, news = opt.update(p, grads, s)
+        return loss, newp, news
+
+    jstep = tt.jit(step)
+    s = opt.init(params)
+    losses = []
+    for _ in range(8):
+        loss, params, s = jstep(params, s, src, tgt, labels)
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0], losses
+
+    # logits parity: compiled (fused) vs pure eager decomposition
+    p2 = seq2seq.init_params(cfg, seed=0)
+    out_fused = tt.jit(lambda p: seq2seq.forward(p, src, tgt, cfg))(p2)
+    out_eager = tt.jit(lambda p: seq2seq.forward(p, src, tgt, cfg),
+                       xla_disable_fusion=True)(p2)
+    np.testing.assert_allclose(np.asarray(out_fused), np.asarray(out_eager),
+                               atol=1e-4, rtol=1e-4)

@@ -4,3 +4,4 @@
 
 from thunder_tpu.models import llama, mixtral, nanogpt  # noqa: F401
 from thunder_tpu.models import gpt  # noqa: F401
+from thunder_tpu.models import seq2seq  # noqa: F401

@@ -1,0 +1,136 @@
+"""Encoder-decoder transformer (BART/T5-style) with cross-attention.
+
+Reference parity: the reference's zoo includes a BART self-attention test
+module (``thunder/tests/hf_bart_self_attn.py``); here the full seq2seq
+architecture is provided — bidirectional encoder, causal decoder with
+cross-attention over encoder states, learned positions, tied lm_head —
+exercising the one attention pattern (cross-attention, T != S) the
+decoder-only families never hit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+
+@dataclass(frozen=True)
+class Seq2SeqConfig:
+    name: str = "tiny"
+    vocab_size: int = 512
+    dim: int = 64
+    n_heads: int = 4
+    enc_layers: int = 2
+    dec_layers: int = 2
+    ffn_dim: int = 256
+    max_seq_len: int = 128
+    norm_eps: float = 1e-5
+    dtype: dtypes.dtype = dtypes.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "tiny": Seq2SeqConfig(),
+    "bart-base": Seq2SeqConfig(name="bart-base", vocab_size=50265, dim=768, n_heads=12,
+                               enc_layers=6, dec_layers=6, ffn_dim=3072, max_seq_len=1024),
+}
+
+
+def init_params(cfg: Seq2SeqConfig, seed: int = 0):
+    import jax
+    import numpy as np
+
+    jd = cfg.dtype.jax
+    key = jax.random.PRNGKey(seed)
+    ks = iter(jax.random.split(key, 8 + 12 * (cfg.enc_layers + cfg.dec_layers)))
+    D, F = cfg.dim, cfg.ffn_dim
+
+    def _dense(shape, std=0.02):
+        return (jax.random.normal(next(ks), shape) * std).astype(jd)
+
+    def attn_block():
+        return {"wq": _dense((D, D)), "wk": _dense((D, D)),
+                "wv": _dense((D, D)), "wo": _dense((D, D))}
+
+    def ffn_block():
+        return {"w1": _dense((F, D)), "w2": _dense((D, F))}
+
+    ones = lambda: np.ones((D,), dtype=cfg.dtype.jax)
+    params = {
+        "tok_embedding": _dense((cfg.vocab_size, D)),
+        "pos_embedding": _dense((cfg.max_seq_len, D)),
+        "enc": [{"attn": attn_block(), "attn_norm": ones(),
+                 "ffn": ffn_block(), "ffn_norm": ones()} for _ in range(cfg.enc_layers)],
+        "dec": [{"self_attn": attn_block(), "self_norm": ones(),
+                 "cross_attn": attn_block(), "cross_norm": ones(),
+                 "ffn": ffn_block(), "ffn_norm": ones()} for _ in range(cfg.dec_layers)],
+        "final_norm": ones(),
+    }
+    return params
+
+
+def _attend(x, kv, blk, cfg: Seq2SeqConfig, *, causal: bool):
+    """Multi-head attention; ``kv`` may differ from ``x`` (cross-attention)."""
+    B, T = x.shape[0], x.shape[1]
+    S = kv.shape[1]
+    H, hd = cfg.n_heads, cfg.head_dim
+    q = ops.transpose(ops.reshape(ops.linear(x, blk["wq"]), (B, T, H, hd)), (0, 2, 1, 3))
+    k = ops.transpose(ops.reshape(ops.linear(kv, blk["wk"]), (B, S, H, hd)), (0, 2, 1, 3))
+    v = ops.transpose(ops.reshape(ops.linear(kv, blk["wv"]), (B, S, H, hd)), (0, 2, 1, 3))
+    o = ops.scaled_dot_product_attention(q, k, v, is_causal=causal)
+    return ops.linear(ops.reshape(ops.transpose(o, (0, 2, 1, 3)), (B, T, cfg.dim)), blk["wo"])
+
+
+def _ffn(x, blk):
+    return ops.linear(ops.gelu(ops.linear(x, blk["w1"])), blk["w2"])
+
+
+def _embed(params, tokens, cfg: Seq2SeqConfig):
+    T = tokens.shape[1]
+    if T > cfg.max_seq_len:
+        raise ValueError(f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}")
+    h = ops.embedding(tokens, params["tok_embedding"])
+    pos = ops.narrow(params["pos_embedding"], 0, 0, T)
+    return ops.add(h, ops.unsqueeze(pos, 0))
+
+
+def encode(params, src_tokens, cfg: Seq2SeqConfig):
+    """Bidirectional encoder: (B, S) int32 -> (B, S, D)."""
+    h = _embed(params, src_tokens, cfg)
+    for layer in params["enc"]:
+        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+        h = ops.add(h, _attend(x, x, layer["attn"], cfg, causal=False))
+        x = ops.rms_norm(h, layer["ffn_norm"], eps=cfg.norm_eps)
+        h = ops.add(h, _ffn(x, layer["ffn"]))
+    return h
+
+
+def decode(params, tgt_tokens, enc_out, cfg: Seq2SeqConfig):
+    """Causal decoder with cross-attention: (B, T) + (B, S, D) -> logits."""
+    h = _embed(params, tgt_tokens, cfg)
+    for layer in params["dec"]:
+        x = ops.rms_norm(h, layer["self_norm"], eps=cfg.norm_eps)
+        h = ops.add(h, _attend(x, x, layer["self_attn"], cfg, causal=True))
+        x = ops.rms_norm(h, layer["cross_norm"], eps=cfg.norm_eps)
+        h = ops.add(h, _attend(x, enc_out, layer["cross_attn"], cfg, causal=False))
+        x = ops.rms_norm(h, layer["ffn_norm"], eps=cfg.norm_eps)
+        h = ops.add(h, _ffn(x, layer["ffn"]))
+    h = ops.rms_norm(h, params["final_norm"], eps=cfg.norm_eps)
+    # tied lm_head: project onto the token embedding
+    return ops.matmul(h, ops.transpose(params["tok_embedding"], (1, 0)))
+
+
+def forward(params, src_tokens, tgt_tokens, cfg: Seq2SeqConfig):
+    return decode(params, tgt_tokens, encode(params, src_tokens, cfg), cfg)
+
+
+def loss_fn(params, src_tokens, tgt_tokens, labels, cfg: Seq2SeqConfig):
+    logits = forward(params, src_tokens, tgt_tokens, cfg)
+    B, T, V = logits.shape
+    logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
+    return ops.cross_entropy(logits, ops.reshape(labels, (B * T,)))
