@@ -41,7 +41,14 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
 
     cfg = llama.CONFIGS["llama2-7b-bench"]
-    opt = AdamW(lr=1e-4)
+    # bf16 moments by default: the AdamW update is HBM-bound and bf16 halves
+    # its state traffic; both sides (thunder and the handwritten baseline)
+    # use the same precision, so vs_baseline stays apples-to-apples
+    from thunder_tpu.core import dtypes as _dt
+
+    state_dtype = {"f32": _dt.float32, "bf16": _dt.bfloat16}[
+        os.environ.get("BENCH_OPT_STATE", "bf16")]
+    opt = AdamW(lr=1e-4, state_dtype=state_dtype)
 
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
@@ -118,6 +125,8 @@ def main():
         logp = jax.nn.log_softmax(logits, -1)
         return -jnp.take_along_axis(logp, tgts.reshape(-1, 1), 1).mean()
 
+    sd = state_dtype.jax
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def jax_step(p, opt_state, toks, tgts):
         loss, grads = jax.value_and_grad(jax_loss)(p, toks, tgts)
@@ -126,12 +135,13 @@ def main():
 
         def upd(pl, g, ml, vl):
             g = g.astype(jnp.float32)
-            ml = b1 * ml + (1 - b1) * g
-            vl = b2 * vl + (1 - b2) * g * g
+            ml = b1 * ml.astype(jnp.float32) + (1 - b1) * g
+            vl = b2 * vl.astype(jnp.float32) + (1 - b2) * g * g
             mh = ml / (1 - b1 ** step)
             vh = vl / (1 - b2 ** step)
             u = mh / (jnp.sqrt(vh) + eps) + wd * pl.astype(jnp.float32)
-            return (pl.astype(jnp.float32) - lr * u).astype(pl.dtype), ml, vl
+            # m in sd (bf16-safe); v stays f32 — see thunder_tpu.optim.AdamW
+            return (pl.astype(jnp.float32) - lr * u).astype(pl.dtype), ml.astype(sd), vl
 
         triples = jax.tree_util.tree_map(upd, p, grads, m, v)
         newp = jax.tree_util.tree_map(lambda t: t[0], triples, is_leaf=lambda x: isinstance(x, tuple))

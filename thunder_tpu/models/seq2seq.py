@@ -122,7 +122,7 @@ def decode(params, tgt_tokens, enc_out, cfg: Seq2SeqConfig):
         h = ops.add(h, _ffn(x, layer["ffn"]))
     h = ops.rms_norm(h, params["final_norm"], eps=cfg.norm_eps)
     # tied lm_head: project onto the token embedding
-    return ops.matmul(h, ops.transpose(params["tok_embedding"], (1, 0)))
+    return ops.linear(h, params["tok_embedding"])
 
 
 def forward(params, src_tokens, tgt_tokens, cfg: Seq2SeqConfig):
