@@ -122,6 +122,28 @@ def _apply_rope(x, cos, sin):
     return ops.cat([rx1, rx2], -1)
 
 
+def _project_qkv(x, layer, cfg: LlamaConfig, cos, sin):
+    """RoPE'd q/k/v heads from a normed hidden state: q (B, n_heads, T, hd);
+    k, v keep kv_heads (GQA expansion is the attention path's business)."""
+    B, T = x.shape[0], x.shape[1]
+    hd = cfg.head_dim
+    q = ops.transpose(ops.reshape(ops.linear(x, layer["wq"]),
+                                  (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
+    k = ops.transpose(ops.reshape(ops.linear(x, layer["wk"]),
+                                  (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+    v = ops.transpose(ops.reshape(ops.linear(x, layer["wv"]),
+                                  (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+    return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
+
+
+def _mlp(h, layer, cfg: LlamaConfig):
+    """Residual SwiGLU MLP sub-block."""
+    x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
+    gate = ops.silu(ops.linear(x, layer["w_gate"]))
+    up = ops.linear(x, layer["w_up"])
+    return ops.add(h, ops.linear(ops.mul(gate, up), layer["w_down"]))
+
+
 def _block(h, layer, cfg: LlamaConfig, cos, sin):
     """One decoder layer: RMSNorm → GQA attention → RMSNorm → SwiGLU MLP."""
     B, T = h.shape[0], h.shape[1]
@@ -129,14 +151,7 @@ def _block(h, layer, cfg: LlamaConfig, cos, sin):
     hd = cfg.head_dim
 
     x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
-    q = ops.linear(x, layer["wq"])  # (B, T, D)
-    k = ops.linear(x, layer["wk"])  # (B, T, kv_dim)
-    v = ops.linear(x, layer["wv"])
-    q = ops.transpose(ops.reshape(q, (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
-    k = ops.transpose(ops.reshape(k, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
-    v = ops.transpose(ops.reshape(v, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
-    q = _apply_rope(q, cos, sin)
-    k = _apply_rope(k, cos, sin)
+    q, k, v = _project_qkv(x, layer, cfg, cos, sin)
     if n_rep > 1:  # GQA: repeat kv heads
         k = ops.reshape(ops.expand(ops.unsqueeze(k, 2), (B, cfg.kv_heads, n_rep, T, hd)),
                         (B, cfg.n_heads, T, hd))
@@ -146,12 +161,7 @@ def _block(h, layer, cfg: LlamaConfig, cos, sin):
     # width is n_heads*hd (== dim/tp_size under tensor parallelism)
     attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
     h = ops.add(h, ops.linear(attn, layer["wo"]))
-
-    # SwiGLU MLP block
-    x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
-    gate = ops.silu(ops.linear(x, layer["w_gate"]))
-    up = ops.linear(x, layer["w_up"])
-    return ops.add(h, ops.linear(ops.mul(gate, up), layer["w_down"]))
+    return _mlp(h, layer, cfg)
 
 
 def forward(params, tokens, cfg: LlamaConfig):
@@ -267,3 +277,115 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int, n_layers: int | None = None)
     n = num_params(cfg, n_layers) - 2 * cfg.vocab_size * cfg.dim
     attn = 2 * 2 * (n_layers or cfg.n_layers) * cfg.dim * seq_len  # qk^T + pv per token
     return 6 * (n + cfg.vocab_size * cfg.dim) + 3 * attn
+
+
+# ---------------------------------------------------------------------------
+# KV-cache inference (autoregressive decoding)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
+                  n_layers: int | None = None):
+    """Per-layer K/V buffers (B, kv_heads, max_len, head_dim)."""
+    import jax.numpy as jnp
+
+    max_len = max_len or cfg.max_seq_len
+    n = n_layers if n_layers is not None else cfg.n_layers
+    shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype.jax), "v": jnp.zeros(shape, cfg.dtype.jax)}
+            for _ in range(n)]
+
+
+def forward_step(params, tokens, cache, pos, cfg: LlamaConfig):
+    """Incremental forward: ``tokens`` (B, T) occupy positions
+    [pos, pos+T) (prefill T>1 or decode T=1); ``pos`` is a traced scalar so
+    one compiled program serves every decode step. Returns
+    (logits (B, T, vocab), updated cache)."""
+    from thunder_tpu.core import prims
+
+    B, T = tokens.shape
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.kv_heads
+    max_len = cache[0]["k"].shape[2]
+    h = ops.embedding(tokens, params["tok_embedding"])
+    cos, sin = _rope_cos_sin(cfg, T, h.dtype, pos_offset=pos)
+    zero = ops.full((), 0, dtype=dtypes.int32)
+    new_cache = []
+    # validity of cache column j for local row i: j <= pos + i
+    col = ops.arange(max_len)                                   # (max_len,)
+    row = ops.add(ops.arange(T), pos)                           # (T,)
+    valid = ops.le(ops.unsqueeze(col, 0), ops.unsqueeze(row, 1))  # (T, max_len)
+
+    for layer, c in zip(params["layers"], cache):
+        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+        q, k, v = _project_qkv(x, layer, cfg, cos, sin)
+        ck = prims.dynamic_update_slice(c["k"], k, (zero, zero, pos, zero))
+        cv = prims.dynamic_update_slice(c["v"], v, (zero, zero, pos, zero))
+        new_cache.append({"k": ck, "v": cv})
+        # grouped-query attention WITHOUT materializing the expanded cache:
+        # fold the group dim into q's row dim — q (B, H, T, hd) becomes
+        # (B, kv_heads, n_rep*T, hd) and matmuls run against the unexpanded
+        # (B, kv_heads, max_len, hd) cache
+        qg = ops.reshape(q, (B, cfg.kv_heads, n_rep * T, hd))
+        qf = ops.convert_element_type(qg, dtypes.float32)
+        kf = ops.convert_element_type(ck, dtypes.float32)
+        scores = ops.mul(ops.matmul(qf, kf.mT), 1.0 / math.sqrt(hd))
+        scores = ops.reshape(scores, (B, cfg.n_heads, T, max_len))
+        neg = ops.full((), float("-inf"), dtype=dtypes.float32)
+        scores = ops.where(valid, scores, neg)
+        attn_w = ops.convert_element_type(ops.softmax(scores, -1), h.dtype)
+        attn = ops.matmul(ops.reshape(attn_w, (B, cfg.kv_heads, n_rep * T, max_len)), cv)
+        attn = ops.reshape(attn, (B, cfg.n_heads, T, hd))
+        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
+        h = ops.add(h, ops.linear(attn, layer["wo"]))
+        h = _mlp(h, layer, cfg)
+
+    h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+    return ops.linear(h, params["lm_head"]), new_cache
+
+
+def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
+             temperature: float = 0.0, key=None, max_len: int | None = None,
+             n_layers: int | None = None):
+    """Autoregressive decoding with a KV cache: prefill once, then one
+    compiled decode step reused for every position (``pos`` is a traced
+    array — no per-step recompilation). Greedy when ``temperature == 0``,
+    else softmax sampling via Gumbel trick with the keyed functional RNG."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import thunder_tpu as tt
+
+    if max_new_tokens <= 0:
+        import jax.numpy as _jnp
+
+        return _jnp.zeros((len(prompt), 0), _jnp.int32)
+    prompt = jnp.asarray(prompt)
+    B, Tp = prompt.shape
+    max_len = max_len or (Tp + max_new_tokens)
+    if Tp + max_new_tokens > max_len or max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({Tp}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"context window (max_len={max_len}, cfg.max_seq_len={cfg.max_seq_len})")
+    cache = init_kv_cache(cfg, B, max_len, n_layers=n_layers)
+
+    step_fn = tt.jit(lambda p, t, c, pos: forward_step(p, t, c, pos, cfg))
+
+    def pick(logits_last, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, -1).astype(jnp.int32)
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, logits_last.shape) + 1e-10) + 1e-10)
+        return jnp.argmax(logits_last / temperature + g, -1).astype(jnp.int32)
+
+    logits, cache = step_fn(params, prompt, cache, jnp.int32(0))
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    tok = pick(np.asarray(logits)[:, -1], sub)
+    out = [tok]
+    for i in range(1, max_new_tokens):
+        logits, cache = step_fn(params, tok[:, None], cache, jnp.int32(Tp + i - 1))
+        key, sub = jax.random.split(key)
+        tok = pick(np.asarray(logits)[:, -1], sub)
+        out.append(tok)
+    return jnp.stack(out, axis=1)  # (B, max_new_tokens)
