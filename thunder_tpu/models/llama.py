@@ -357,9 +357,7 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
     import thunder_tpu as tt
 
     if max_new_tokens <= 0:
-        import jax.numpy as _jnp
-
-        return _jnp.zeros((len(prompt), 0), _jnp.int32)
+        return jnp.zeros((len(prompt), 0), jnp.int32)
     prompt = jnp.asarray(prompt)
     B, Tp = prompt.shape
     max_len = max_len or (Tp + max_new_tokens)
@@ -369,7 +367,16 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
             f"context window (max_len={max_len}, cfg.max_seq_len={cfg.max_seq_len})")
     cache = init_kv_cache(cfg, B, max_len, n_layers=n_layers)
 
-    step_fn = tt.jit(lambda p, t, c, pos: forward_step(p, t, c, pos, cfg))
+    # the step returns only the LAST position's logits (prefill would
+    # otherwise run lm_head over the whole prompt and ship (B, Tp, vocab)
+    # to the host); the cache is donated so XLA updates it in place instead
+    # of copying ~all of it every token
+    def _step(p, t, c, pos):
+        logits, nc = forward_step(p, t, c, pos, cfg)
+        T = t.shape[1]
+        return ops.squeeze(ops.narrow(logits, 1, T - 1, 1), 1), nc
+
+    step_fn = tt.jit(_step, donate_argnums=(2,))
 
     def pick(logits_last, key):
         if temperature == 0.0:
@@ -377,15 +384,15 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
         g = -jnp.log(-jnp.log(jax.random.uniform(key, logits_last.shape) + 1e-10) + 1e-10)
         return jnp.argmax(logits_last / temperature + g, -1).astype(jnp.int32)
 
-    logits, cache = step_fn(params, prompt, cache, jnp.int32(0))
+    last, cache = step_fn(params, prompt, cache, jnp.int32(0))
     if key is None:
         key = jax.random.PRNGKey(0)
     key, sub = jax.random.split(key)
-    tok = pick(np.asarray(logits)[:, -1], sub)
+    tok = pick(last, sub)
     out = [tok]
     for i in range(1, max_new_tokens):
-        logits, cache = step_fn(params, tok[:, None], cache, jnp.int32(Tp + i - 1))
+        last, cache = step_fn(params, tok[:, None], cache, jnp.int32(Tp + i - 1))
         key, sub = jax.random.split(key)
-        tok = pick(np.asarray(logits)[:, -1], sub)
+        tok = pick(last, sub)
         out.append(tok)
     return jnp.stack(out, axis=1)  # (B, max_new_tokens)
