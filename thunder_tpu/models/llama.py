@@ -164,8 +164,8 @@ def _block(h, layer, cfg: LlamaConfig, cos, sin):
     return _mlp(h, layer, cfg)
 
 
-def forward(params, tokens, cfg: LlamaConfig):
-    """tokens: (B, T) int32 -> logits (B, T, vocab)."""
+def forward_hidden(params, tokens, cfg: LlamaConfig):
+    """tokens: (B, T) int32 -> final hidden states (B, T, D) (pre-lm_head)."""
     B, T = tokens.shape
     h = ops.embedding(tokens, params["tok_embedding"])  # (B, T, D)
     from thunder_tpu.distributed import current_cp
@@ -183,9 +183,12 @@ def forward(params, tokens, cfg: LlamaConfig):
     for layer in params["layers"]:
         h = _block(h, layer, cfg, cos, sin)
 
-    h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
-    logits = ops.linear(h, params["lm_head"])
-    return logits
+    return ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+
+
+def forward(params, tokens, cfg: LlamaConfig):
+    """tokens: (B, T) int32 -> logits (B, T, vocab)."""
+    return ops.linear(forward_hidden(params, tokens, cfg), params["lm_head"])
 
 
 def loss_fn(params, tokens, targets, cfg: LlamaConfig):
@@ -193,6 +196,21 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     B, T, V = logits.shape
     logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
     return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
+
+
+def fused_loss_fn(params, tokens, targets, cfg: LlamaConfig, chunk: int = 8192):
+    """Chunked-vocab loss: lm_head projection fused into the cross-entropy
+    (``nn.fused_linear_cross_entropy``) — the (B*T, vocab) logits are never
+    materialized. Drop-in for ``loss_fn`` when activation memory is the
+    constraint (large vocab / long sequence)."""
+    from thunder_tpu.ops import nn as tnn
+
+    h = forward_hidden(params, tokens, cfg)
+    B, T, D = h.shape
+    loss, _lse = tnn.fused_linear_cross_entropy(
+        ops.reshape(h, (B * T, D)), params["lm_head"],
+        ops.reshape(targets, (B * T,)), chunk=chunk)
+    return loss
 
 
 def stack_layers(params):

@@ -555,3 +555,106 @@ def interpolate_nearest(a, scale_factor: int):
     out = ops.movedim(out, -1, 0)
     out = ops.repeat_interleave_dim0(out, s)
     return ops.movedim(out, 0, -1)
+
+
+@opsymbol(id="nn.fused_linear_cross_entropy")
+def fused_linear_cross_entropy(h, w, target, *, chunk: int = 8192,
+                               ignore_index: int = -100):
+    """Mean softmax-cross-entropy of ``h @ w.T`` computed one vocab chunk at
+    a time — the (N, V) logits are NEVER materialized (live memory is
+    O(N * chunk)); the custom VJP below recomputes per chunk in backward.
+
+    Beyond the reference: its fused-CE executors (apex/triton,
+    ``thunder/executors/apex_entropyex.py:99``) still take materialized
+    logits; fusing the lm_head projection removes the dominant activation
+    of large-vocab training (N*V f32 — e.g. 1 GB at N=2048, V=128k).
+
+    h: (N, D) hidden states; w: (V, D) head weight; target: (N,) int ids.
+    """
+    N, D = h.shape
+    V = w.shape[0]
+    tgt = ops.convert_element_type(target, dtypes.int32)
+    hf = ops.convert_element_type(h, dtypes.float32)
+
+    m = ops.full((N,), float("-inf"), dtype=dtypes.float32)
+    s = ops.full((N,), 0.0, dtype=dtypes.float32)
+    picked = ops.full((N,), 0.0, dtype=dtypes.float32)
+    for c0 in range(0, V, chunk):
+        cw = min(chunk, V - c0)
+        wc = ops.convert_element_type(ops.narrow(w, 0, c0, cw), dtypes.float32)
+        lg = prims.dot_general(hf, wc, contract_dims=((1,), (1,)))  # (N, cw) f32
+        mc = ops.amax(lg, -1)
+        m_new = ops.maximum(m, mc)
+        alpha = ops.exp(ops.sub(m, m_new))
+        e = ops.exp(ops.sub(lg, ops.unsqueeze(m_new, 1)))
+        s = ops.add(ops.mul(s, alpha), ops.sum(e, -1))
+        m = m_new
+        idx = ops.sub(tgt, c0)
+        valid = ops.logical_and(ops.ge(idx, 0), ops.lt(idx, cw))
+        safe = ops.clamp(idx, 0, cw - 1)
+        pc = ops.squeeze(prims.take_along_axis(lg, ops.unsqueeze(safe, 1), 1), (1,))
+        picked = ops.add(picked, ops.where(valid, pc, ops.zeros_like(pc)))
+
+    lse = ops.add(m, ops.log(s))
+    nll = ops.sub(lse, picked)
+    ok = ops.ne(tgt, ignore_index)
+    nll = ops.where(ok, nll, ops.zeros_like(nll))
+    count = ops.maximum(ops.sum(ops.convert_element_type(ok, dtypes.float32)), 1.0)
+    return ops.true_divide(ops.sum(nll), count), lse
+
+
+@register_vjp("nn.fused_linear_cross_entropy")
+def _flce_vjp(h, w, target, *, chunk: int = 8192, ignore_index: int = -100):
+    loss, lse = fused_linear_cross_entropy(h, w, target, chunk=chunk,
+                                           ignore_index=ignore_index)
+    N, D = h.shape
+    V = w.shape[0]
+
+    def pullback(g):
+        gl, glse = (g[0], g[1]) if isinstance(g, (tuple, list)) else (g, None)
+        if gl is None and glse is None:
+            return []
+        tgt = ops.convert_element_type(target, dtypes.int32)
+        hf = ops.convert_element_type(h, dtypes.float32)
+        ok = ops.ne(tgt, ignore_index)
+        okf = ops.convert_element_type(ok, dtypes.float32)
+        count = ops.maximum(ops.sum(okf), 1.0)
+        # per-row scale for the nll term: d(mean nll)/d(logit) rows;
+        # ignored rows contribute 0
+        if gl is not None:
+            gs = ops.true_divide(ops.convert_element_type(gl, dtypes.float32), count)
+            srow = ops.mul(okf, gs)                                 # (N,)
+        else:
+            srow = ops.full((N,), 0.0, dtype=dtypes.float32)
+        # the lse output is differentiable too (z-loss etc.): d lse/d logit
+        # is the softmax row, so its cotangent simply adds to the softmax
+        # coefficient (the one-hot term belongs to the nll alone)
+        coef = srow if glse is None else             ops.add(srow, ops.convert_element_type(glse, dtypes.float32))
+        dh = ops.full((N, D), 0.0, dtype=dtypes.float32)
+        dw_chunks = []
+        for c0 in range(0, V, chunk):
+            cw = min(chunk, V - c0)
+            wc = ops.convert_element_type(ops.narrow(w, 0, c0, cw), dtypes.float32)
+            lg = prims.dot_general(hf, wc, contract_dims=((1,), (1,)))
+            p = ops.exp(ops.sub(lg, ops.unsqueeze(lse, 1)))         # (N, cw) softmax
+            ps = ops.mul(p, ops.unsqueeze(coef, 1))
+            # softmax part: dh += ps @ wc; dw_c = ps^T @ h_scaled? No —
+            # dw_c = ps^T @ h (h unscaled: ps already carries the row scale)
+            dh = ops.add(dh, prims.dot_general(ps, wc, contract_dims=((1,), (0,))))
+            dw_c = prims.dot_general(ps, hf, contract_dims=((0,), (0,)))  # (cw, D)
+            # one-hot part: rows whose target lives in this chunk
+            idx = ops.sub(tgt, c0)
+            valid = ops.logical_and(ops.ge(idx, 0), ops.lt(idx, cw))
+            safe = ops.clamp(idx, 0, cw - 1)
+            vrow = ops.mul(srow, ops.convert_element_type(valid, dtypes.float32))
+            # dh -= wc[target] * srow   (rows with target in chunk)
+            dh = ops.sub(dh, ops.mul(prims.take(wc, safe, 0), ops.unsqueeze(vrow, 1)))
+            # dw_c[target] -= h * srow
+            neg_rows = ops.mul(hf, ops.unsqueeze(ops.neg(vrow), 1))
+            dw_c = prims.index_add(dw_c, safe, neg_rows, 0)
+            dw_chunks.append(dw_c)
+        dw = ops.cat(dw_chunks, 0)
+        return [(h, ops.convert_element_type(dh, h.dtype)),
+                (w, ops.convert_element_type(dw, w.dtype))]
+
+    return (loss, lse), pullback
