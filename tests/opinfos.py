@@ -586,3 +586,132 @@ register(OpInfo("pixel_shuffle", ops_nn.pixel_shuffle,
 register(OpInfo("interpolate_nearest", ops_nn.interpolate_nearest,
                 lambda a, s: jnp.repeat(jnp.repeat(a, s, axis=-2), s, axis=-1),
                 lambda rng: [SampleInput((_t(rng, 2, 3, 4, 4), 2))]))
+
+
+# -- batch 3: remaining composite coverage (toward the reference's 197) ------
+
+def _i(rng, *shape, hi=10):
+    return rng.randint(0, hi, size=shape).astype(np.int32)
+
+
+register(OpInfo("argsort", ops.argsort,
+                lambda a, dim=-1, descending=False: jnp.argsort(-a if descending else a, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 4, 6),)),
+                             SampleInput((_t(rng, 5),), {"dim": 0})],
+                supports_grad=False))
+register(OpInfo("atleast_1d", ops.atleast_1d, jnp.atleast_1d,
+                lambda rng: [SampleInput((_t(rng, 3),))], supports_grad=False))
+register(OpInfo("atleast_2d", ops.atleast_2d, jnp.atleast_2d,
+                lambda rng: [SampleInput((_t(rng, 3),))], supports_grad=False))
+register(OpInfo("atleast_3d", ops.atleast_3d, jnp.atleast_3d,
+                lambda rng: [SampleInput((_t(rng, 3, 4),))], supports_grad=False))
+register(OpInfo("bitwise_and", ops.bitwise_and, jnp.bitwise_and,
+                lambda rng: [SampleInput((_i(rng, 4, 4), _i(rng, 4, 4)))],
+                supports_grad=False))
+register(OpInfo("bitwise_or", ops.bitwise_or, jnp.bitwise_or,
+                lambda rng: [SampleInput((_i(rng, 4, 4), _i(rng, 4, 4)))],
+                supports_grad=False))
+register(OpInfo("bitwise_xor", ops.bitwise_xor, jnp.bitwise_xor,
+                lambda rng: [SampleInput((_i(rng, 4, 4), _i(rng, 4, 4)))],
+                supports_grad=False))
+register(OpInfo("bitwise_not", ops.bitwise_not, jnp.bitwise_not,
+                lambda rng: [SampleInput((_i(rng, 4, 4),))], supports_grad=False))
+register(OpInfo("logical_and", ops.logical_and, jnp.logical_and,
+                lambda rng: [SampleInput((_t(rng, 4) > 0, _t(rng, 4) > 0))],
+                supports_grad=False))
+register(OpInfo("logical_or", ops.logical_or, jnp.logical_or,
+                lambda rng: [SampleInput((_t(rng, 4) > 0, _t(rng, 4) > 0))],
+                supports_grad=False))
+register(OpInfo("logical_not", ops.logical_not, jnp.logical_not,
+                lambda rng: [SampleInput((_t(rng, 4) > 0,))], supports_grad=False))
+register(OpInfo("clip", ops.clip, jnp.clip,
+                lambda rng: [SampleInput((_t(rng, 4, 4), -0.5, 0.5))]))
+register(OpInfo("diag", ops.diag, jnp.diag,
+                lambda rng: [SampleInput((_t(rng, 5),)), SampleInput((_t(rng, 4, 4),))]))
+register(OpInfo("dstack", ops.dstack, jnp.dstack,
+                lambda rng: [SampleInput(([_t(rng, 3, 4), _t(rng, 3, 4)],))],
+                supports_grad=False))
+register(OpInfo("flatten", ops.flatten,
+                lambda a, start_dim=0, end_dim=-1: jnp.reshape(
+                    a, a.shape[:start_dim] + (-1,) + a.shape[(end_dim % a.ndim) + 1:]),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4),)),
+                             SampleInput((_t(rng, 2, 3, 4), 1)),
+                             SampleInput((_t(rng, 2, 3, 4), 0, 1))]))
+register(OpInfo("float_power", ops.float_power,
+                lambda a, b: jnp.float_power(a, b).astype(jnp.float32),
+                lambda rng: [SampleInput((_t(rng, 4, lo=0.2, hi=2.0), 2.0))], atol=1e-4))
+register(OpInfo("floor_divide", ops.floor_divide, jnp.floor_divide,
+                lambda rng: [SampleInput((_t(rng, 4, lo=1.0, hi=8.0), _t(rng, 4, lo=1.0, hi=3.0)))],
+                supports_grad=False))
+register(OpInfo("full_like", ops.full_like, jnp.full_like,
+                lambda rng: [SampleInput((_t(rng, 3, 3), 2.5))], supports_grad=False))
+register(OpInfo("ones_like", ops.ones_like, jnp.ones_like,
+                lambda rng: [SampleInput((_t(rng, 3, 3),))], supports_grad=False))
+register(OpInfo("zeros_like", ops.zeros_like, jnp.zeros_like,
+                lambda rng: [SampleInput((_t(rng, 3, 3),))], supports_grad=False))
+register(OpInfo("index_select", ops.index_select,
+                lambda a, idx, dim=0: jnp.take(a, idx, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 5, 4), _i(rng, 3, hi=5), 0))]))
+register(OpInfo("lerp", ops.lerp,
+                lambda a, b, w: a + w * (b - a),
+                lambda rng: [SampleInput((_t(rng, 4, 4), _t(rng, 4, 4), 0.3))]))
+register(OpInfo("lgamma", ops.lgamma, jax.scipy.special.gammaln,
+                lambda rng: [SampleInput((_t(rng, 4, lo=0.5, hi=4.0),))], atol=1e-4,
+                supports_grad=False))
+register(OpInfo("erfinv", ops.erfinv, jax.scipy.special.erfinv,
+                lambda rng: [SampleInput((_t(rng, 4, lo=-0.9, hi=0.9),))], atol=1e-4,
+                supports_grad=False))
+register(OpInfo("masked_fill", ops.masked_fill,
+                lambda a, m, v: jnp.where(m, v, a),
+                lambda rng: [SampleInput((_t(rng, 4, 4), _t(rng, 4, 4) > 0, 1.5))]))
+register(OpInfo("norm", ops.norm,
+                lambda a, ord=2, dim=None, keepdim=False: jnp.linalg.norm(
+                    a, ord=None if ord == 2 else ord, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((_t(rng, 4, 4),)),
+                             SampleInput((_t(rng, 4, 4),), {"dim": 1})], atol=1e-4))
+register(OpInfo("permute", ops.permute, lambda a, dims: jnp.transpose(a, dims),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 4), (2, 0, 1)))]))
+register(OpInfo("positive", ops.positive, lambda a: +a,
+                lambda rng: [SampleInput((_t(rng, 4),))]))
+register(OpInfo("signbit", ops.signbit, jnp.signbit,
+                lambda rng: [SampleInput((_t(rng, 4),))], supports_grad=False))
+register(OpInfo("split", ops.split,
+                lambda a, n, dim=0: jnp.split(a, a.shape[dim] // n, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 6, 4), 2))], supports_grad=False))
+register(OpInfo("chunk", ops.chunk,
+                lambda a, n, dim=0: jnp.split(a, n, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 6, 4), 3))], supports_grad=False))
+register(OpInfo("var_mean", ops.var_mean,
+                lambda a, dim=None, correction=1: (jnp.var(a, axis=dim, ddof=correction),
+                                                   jnp.mean(a, axis=dim)),
+                lambda rng: [SampleInput((_t(rng, 4, 5),), {"dim": 1})], atol=1e-4,
+                supports_grad=False))
+register(OpInfo("aminmax", ops.aminmax,
+                lambda a, dim=None, keepdim=False: (jnp.min(a, axis=dim, keepdims=keepdim),
+                                                    jnp.max(a, axis=dim, keepdims=keepdim)),
+                lambda rng: [SampleInput((_t(rng, 4, 5),), {"dim": 1})],
+                supports_grad=False))
+register(OpInfo("addcdiv", ops.addcdiv,
+                lambda a, t1, t2, value=1.0: a + value * t1 / t2,
+                lambda rng: [SampleInput((_t(rng, 4), _t(rng, 4), _t(rng, 4, lo=0.5, hi=2.0)))]))
+register(OpInfo("addmv", ops.addmv,
+                lambda a, m, v, beta=1.0, alpha=1.0: beta * a + alpha * (m @ v),
+                lambda rng: [SampleInput((_t(rng, 4), _t(rng, 4, 5), _t(rng, 5)))], atol=1e-5))
+register(OpInfo("einsum_matmul", partial(ops.einsum, "ij,jk->ik"),
+                partial(jnp.einsum, "ij,jk->ik"),
+                lambda rng: [SampleInput((_t(rng, 4, 5), _t(rng, 5, 3)))], atol=1e-4))
+register(OpInfo("take_along_axis", ops.take_along_axis,
+                jnp.take_along_axis,
+                lambda rng: [SampleInput((_t(rng, 4, 5), _i(rng, 4, 2, hi=5), 1))]))
+def _scatter_add_ref(a, dim, idx, src):
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    grids[dim] = jnp.asarray(idx)
+    return jnp.asarray(a).at[tuple(grids)].add(src)
+
+
+register(OpInfo("scatter_add", ops.scatter_add, _scatter_add_ref,
+                lambda rng: [SampleInput((np.zeros((5, 4), np.float32), 0,
+                                          _i(rng, 3, 4, hi=5), _t(rng, 3, 4)))]))
+register(OpInfo("tril_mask", ops.tril_mask,
+                lambda n, m, diagonal=0: jnp.tril(jnp.ones((n, m), bool), k=diagonal),
+                lambda rng: [SampleInput((4, 4))], supports_grad=False))
