@@ -332,3 +332,25 @@ def test_checkpoint_reshard_on_load(tmp_path, eight_devices):
     assert float(restored["step"]) == 3.0
     # restored arrays carry the TEMPLATE's sharding, not the saved one
     assert restored["w"].sharding.spec == P("z", "y")
+
+
+def test_examine_torch_coverage_report():
+    """Reference examine() use case: report which torch ops a module calls
+    and which the interop dialect lacks (thunder/examine/__init__.py:49)."""
+    import torch
+
+    from thunder_tpu.examine import examine_torch
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(8, 8)
+
+        def forward(self, x):
+            y = torch.relu(self.lin(x))
+            return torch.igamma(y.abs() + 1.0, y.abs() + 1.0)  # igamma: unsupported
+
+    rep = examine_torch(M(), torch.randn(2, 8))
+    assert any("relu" in k or "linear" in k for k in rep["supported"]), rep["supported"]
+    assert any("igamma" in k for k in rep["unsupported"]), rep["unsupported"]
+    assert 0.0 < rep["coverage"] < 1.0

@@ -104,3 +104,51 @@ def estimate_memory(trc: TraceCtx) -> dict:
                 current -= live.pop(v)
     return {"peak_bytes": peak, "output_bytes": sum(
         p.numel * p.dtype.bytes for p in out_flat if isinstance(p, TensorProxy))}
+
+
+def examine_torch(fn, *args, **kwargs) -> dict:
+    """The reference's core ``examine()`` use case
+    (``thunder/examine/__init__.py:49``): run a torch function/module under a
+    ``TorchFunctionMode`` collector and report which called torch operations
+    the torch-interop dialect supports vs lacks — the coverage-gap tool.
+
+    Runs the REAL torch eagerly (CPU) while recording; nothing is compiled.
+    """
+    import torch
+    from torch.overrides import TorchFunctionMode, resolve_name
+
+    from thunder_tpu.torch import _TENSOR_METHODS, _torch_to_thunder_function_map
+
+    called: Counter = Counter()
+    unsupported: Counter = Counter()
+
+    class _Collector(TorchFunctionMode):
+        def __torch_function__(self, func, types, f_args=(), f_kwargs=None):
+            name = resolve_name(func) or getattr(func, "__name__", repr(func))
+            called[name] += 1
+            base = getattr(func, "__wrapped__", func)
+            if func not in _torch_to_thunder_function_map \
+                    and base not in _torch_to_thunder_function_map \
+                    and not isinstance(func, str) \
+                    and getattr(func, "__name__", "") not in ("__get__",):
+                # the method table only answers for ACTUAL Tensor methods —
+                # a torch-namespace fn sharing a method's name (torch.dot,
+                # torch.clamp_min, ...) is still a gap the interop dispatch
+                # would raise on
+                meth = getattr(func, "__name__", "")
+                is_method = (name or "").startswith("torch.Tensor.")
+                if not (is_method and meth in _TENSOR_METHODS):
+                    unsupported[name] += 1
+            return func(*f_args, **(f_kwargs or {}))
+
+    with _Collector():
+        fn(*args, **kwargs)
+
+    supported = {k: v for k, v in called.items() if k not in unsupported}
+    report = {
+        "ops_called": dict(called),
+        "supported": supported,
+        "unsupported": dict(unsupported),
+        "coverage": (len(supported) / max(len(called), 1)),
+    }
+    return report
