@@ -39,20 +39,32 @@ class BenchmarkRunStatistics:
                 f"compile {self.compile_s:.2f} s, n={len(self.times_s)})")
 
 
+def _sync(out):
+    """block_until_ready PLUS a one-element host readback of the first leaf:
+    on tunneled platforms (axon) block_until_ready is a no-op and only a
+    readback truly fences device work."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    jax.block_until_ready(out)
+    leaves = [l for l in jax.tree_util.tree_leaves(out) if hasattr(l, "shape")]
+    if leaves:
+        _np.asarray(jnp.ravel(leaves[0])[0] if getattr(leaves[0], "ndim", 0) else leaves[0])
+    return out
+
+
 def time_fn(fn: Callable, *args, warmup: int = 2, iters: int = 10, name: str = "fn",
             **kwargs) -> BenchmarkRunStatistics:
-    import jax
-
     t0 = time.perf_counter()
-    out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    _sync(fn(*args, **kwargs))
     compile_s = time.perf_counter() - t0
     for _ in range(max(0, warmup - 1)):
-        jax.block_until_ready(fn(*args, **kwargs))
+        _sync(fn(*args, **kwargs))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args, **kwargs))
+        _sync(fn(*args, **kwargs))
         times.append(time.perf_counter() - t0)
     return BenchmarkRunStatistics(name, times, compile_s)
 

@@ -28,9 +28,22 @@ def main():
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--peak-tflops", type=float, default=197.0,
                    help="per-chip peak bf16 TFLOP/s (v5e=197, v5p=459)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="force an N-device virtual CPU mesh (hermetic "
+                        "distributed benchmarking without hardware)")
     args = p.parse_args()
 
     import jax
+
+    if args.devices:
+        # jax is already imported (package __init__ pulls jax.numpy) but the
+        # backend is not initialized until first use: XLA_FLAGS is read
+        # lazily at backend init, and the platform switches via jax.config
+        import os
+
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" --xla_force_host_platform_device_count={args.devices}")
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     import thunder_tpu as tt
@@ -49,7 +62,8 @@ def main():
 
     n_dev = len(jax.devices())
     if args.mode == "single":
-        jstep = tt.jit(train_step)
+        # donated params/opt-state: in-place updates, halves weight memory
+        jstep = tt.jit(train_step, donate_argnums=(0, 1))
     elif args.mode == "fsdp":
         from thunder_tpu.distributed import fsdp
 
@@ -77,15 +91,27 @@ def main():
     tokens = rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq)).astype(np.int32)
     targets = np.roll(tokens, -1, 1).astype(np.int32)
 
+    def force(x):
+        # block_until_ready is a no-op on tunneled platforms; a ONE-ELEMENT
+        # host readback (device-side slice first) is the honest sync point
+        # (same as the repo-root bench.py driver metric)
+        import jax.numpy as jnp
+
+        return float(np.asarray(jnp.ravel(x)[0]))
+
+    def force_chain(loss, params):
+        force(loss)
+        force(jax.tree_util.tree_leaves(params)[0])  # whole dependency chain
+
     t0 = time.perf_counter()
     loss, params, opt_state = jstep(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
+    force_chain(loss, params)
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         loss, params, opt_state = jstep(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
+    force_chain(loss, params)
     dt = (time.perf_counter() - t0) / args.steps
 
     base_cfg = llama.CONFIGS[args.model]
