@@ -300,3 +300,39 @@ def test_fsdp_zero3_regathers_in_backward(eight_devices):
     p2 = llama.init_params(cfg, seed=4, scale_layers=2)
     jstep2(p2, opt.init(p2), tokens, targets)
     assert all("= regather" not in t.python() for t in tt.last_traces(jstep2))
+
+
+def test_hsdp_2d_mesh_matches_single_device(eight_devices):
+    """HSDP (NEW capability): params shard over fsdp (4), replicate over
+    dp (2); batch shards over all 8; training matches single-device and
+    the trace composes both synchronize VJPs (all-reduce across replicas +
+    reduce-scatter within shards)."""
+    from thunder_tpu.distributed import hsdp
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=6, scale_layers=2)
+    opt = AdamW(lr=3e-3)
+    tokens, targets = _data(cfg, N, 8, seed=6)
+
+    ref_losses, ref_params = _run_steps(tt.jit(_make_step(cfg, opt)), params,
+                                        opt.init(params), tokens, targets)
+
+    jstep = hsdp(_make_step(cfg, opt), MeshSpec.make(dp=2, fsdp=4))
+    p = llama.init_params(cfg, seed=6, scale_layers=2)
+    s = opt.init(p)
+    losses = []
+    for _ in range(3):
+        loss, p, s = jstep(p, s, tokens, targets)
+        losses.append(float(np.asarray(loss)))
+    np.testing.assert_allclose(ref_losses, losses, atol=1e-5, rtol=1e-5)
+
+    flat_ref = jax.tree_util.tree_flatten(ref_params)[0]
+    flat_h = jax.tree_util.tree_flatten(p)[0]
+    for r, d in zip(flat_ref, flat_h):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=1e-5, rtol=1e-4)
+
+    # structure: both collectives appear — reduce_scatter (fsdp axis) AND a
+    # grad all_reduce on the replica axis
+    src = tt.last_traces(jstep)[0].python()
+    assert "reduce_scatter" in src
+    assert src.count("'dp'") >= 2 or src.count('"dp"') >= 2, "replica-axis collectives missing"

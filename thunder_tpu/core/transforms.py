@@ -238,7 +238,7 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
             if isinstance(leaf, TensorProxy):
                 p = TensorProxy(shape=leaf.shape, dtype=leaf.dtype, device=leaf.device,
                                 distparallel_type=leaf.distparallel_type)
-                for attr in ("dist_axis", "dist_size"):
+                for attr in ("dist_axis", "dist_size", "dist_replica_axis", "dist_replica_size"):
                     if hasattr(leaf, attr):
                         setattr(p, attr, getattr(leaf, attr))
                 proxies.append(p)
@@ -252,8 +252,20 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
                         and getattr(p, "dist_axis", None) is not None):
                     from thunder_tpu.distributed import prims as dist_prims
 
-                    passed.append(dist_prims.synchronize(p, p.dist_axis, p.distparallel_type,
-                                                         p.dist_size))
+                    # HSDP: a REPLICATED synchronize over the replica axis
+                    # APPLIED TO THE SHARD (inside the gather) — identity
+                    # forward, grad all-reduce-mean backward. Order matters
+                    # for bandwidth, not math (both VJPs are linear): inside,
+                    # the replica all-reduce (the cross-pod/DCN hop) moves
+                    # shard-sized grads; outside it would move gathered-size.
+                    synced = p
+                    if getattr(p, "dist_replica_axis", None) is not None:
+                        synced = dist_prims.synchronize(
+                            synced, p.dist_replica_axis, DistParallelType.REPLICATED,
+                            p.dist_replica_size)
+                    synced = dist_prims.synchronize(synced, p.dist_axis,
+                                                    p.distparallel_type, p.dist_size)
+                    passed.append(synced)
                 else:
                     passed.append(p)
             elif isinstance(leaf, Proxy):

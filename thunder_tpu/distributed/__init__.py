@@ -103,6 +103,7 @@ def pipeline_ctx(axis: str, size: int):
 # transforms; imported last to keep the dependency order acyclic
 from thunder_tpu.distributed import prims  # noqa: E402,F401
 from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
+    hsdp,
     DistributedFunction,
     context_parallel,
     ddp,

@@ -54,14 +54,15 @@ def _P(*args):
 class LeafPlan:
     """How one flat input leaf participates in the mesh."""
 
-    __slots__ = ("kind", "spec", "mark", "shard_dim")
+    __slots__ = ("kind", "spec", "mark", "shard_dim", "shard_size")
 
     def __init__(self, kind: str, spec, mark: DistParallelType = DistParallelType.NONE,
-                 shard_dim: int | None = None):
+                 shard_dim: int | None = None, shard_size: int | None = None):
         self.kind = kind  # "param_shard" | "data_shard" | "replicate" | "column" | "row"
         self.spec = spec
         self.mark = mark
         self.shard_dim = shard_dim
+        self.shard_size = shard_size  # divisor for shard_dim (defaults to the axis size)
 
 
 class _Zero3Transform(Transform):
@@ -79,7 +80,11 @@ class DistributedFunction(ThunderTPUFunction):
                  params_argnums: Sequence[int] = (0,), column_patterns=(), row_patterns=(),
                  expert_patterns=(), stage_patterns=(), shard_data: bool = True,
                  data_argnums: Sequence[int] | None = None,
+                 replica_axis: str | None = None,
                  zero: int = 2, **jit_kwargs):
+        self.replica_axis = replica_axis
+        self.replica_size = (dict(zip(mesh_spec.axis_names, mesh_spec.axis_sizes))[replica_axis]
+                             if replica_axis else 1)
         self.data_argnums = tuple(data_argnums) if data_argnums is not None else None
         self.expert_re = re.compile("|".join(expert_patterns)) if expert_patterns else None
         self.stage_re = re.compile("|".join(stage_patterns)) if stage_patterns else None
@@ -99,7 +104,7 @@ class DistributedFunction(ThunderTPUFunction):
 
         def wrapped(*args, **kwargs):
             out = orig_fn(*args, **kwargs)
-            if self.size > 1 and mode in ("fsdp", "ddp", "cp", "ep"):
+            if self.size * self.replica_size > 1 and mode in ("fsdp", "ddp", "cp", "ep", "hsdp"):
                 out = tree_map(self._mean_scalar_across_replicas, out)
             return out
 
@@ -107,7 +112,7 @@ class DistributedFunction(ThunderTPUFunction):
         check(jit_kwargs.get("cache", "constant values") != "symbolic values",
               "symbolic-values caching is not supported under distributed transforms "
               "(leaf plans and shard specs are built per concrete call)")
-        if mode == "fsdp" and zero == 3:
+        if mode in ("fsdp", "hsdp") and zero == 3:
             jit_kwargs["transforms"] = tuple(jit_kwargs.get("transforms", ())) + (_Zero3Transform(),)
         super().__init__(wrapped, **jit_kwargs)
         self._orig_fn = fn
@@ -119,7 +124,11 @@ class DistributedFunction(ThunderTPUFunction):
 
         if isinstance(leaf, TensorProxy) and leaf.ndim == 0 and leaf.dtype.is_inexact:
             red = dist_prims.wait(dist_prims.all_reduce(leaf, self.axis, "sum"))
-            return ops.true_divide(red, float(self.size))
+            total = self.size
+            if self.replica_axis:
+                red = dist_prims.wait(dist_prims.all_reduce(red, self.replica_axis, "sum"))
+                total *= self.replica_size
+            return ops.true_divide(red, float(total))
         return leaf
 
     # -- leaf classification -------------------------------------------------
@@ -154,7 +163,29 @@ class DistributedFunction(ThunderTPUFunction):
                     continue
                 plans.append(LeafPlan("replicate", _P()))
                 continue
-            if self.mode == "fsdp" and in_params:
+            if self.mode == "hsdp" and not in_params:
+                import numpy as _np
+
+                # batch data shards over BOTH axes (every rank its own
+                # microbatch); float non-param state (optimizer moments)
+                # mirrors the params: shard axis only, replicated across dp.
+                # int dtype is the batch heuristic; data_argnums overrides it
+                # for float batch inputs (images etc.)
+                if self.data_argnums is not None:
+                    is_batch = (len(path) >= 2 and getattr(path[0], "idx", None) == 0
+                                and getattr(path[1], "idx", None) in self.data_argnums)
+                else:
+                    is_batch = _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)
+                both = n * self.replica_size
+                if is_batch and len(shape) >= 1 and shape[0] % both == 0 and shape[0] >= both:
+                    plans.append(LeafPlan("data_shard", _P((self.replica_axis, self.axis)),
+                                          shard_dim=0, shard_size=both))
+                elif not is_batch and len(shape) >= 1 and shape[0] % n == 0 and shape[0] >= n:
+                    plans.append(LeafPlan("data_shard", _P(self.axis), shard_dim=0))
+                else:
+                    plans.append(LeafPlan("replicate", _P()))
+                continue
+            if self.mode in ("fsdp", "hsdp") and in_params:
                 if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
                     plans.append(LeafPlan("param_shard", _P(self.axis),
                                           DistParallelType.FULLY_SHARDED, 0))
@@ -256,15 +287,20 @@ class DistributedFunction(ThunderTPUFunction):
     def _make_input_proxy(self, i: int, leaf) -> TensorProxy:
         plan = self._plan[i]
         shape = list(leaf.shape)
+        divisor = plan.shard_size or self.size
         if plan.shard_dim is not None:
-            check(shape[plan.shard_dim] % self.size == 0,
-                  lambda: f"dim {plan.shard_dim} of {tuple(leaf.shape)} not divisible by mesh axis {self.size}")
-            shape[plan.shard_dim] //= self.size
+            check(shape[plan.shard_dim] % divisor == 0,
+                  lambda: f"dim {plan.shard_dim} of {tuple(leaf.shape)} not divisible by {divisor}")
+            shape[plan.shard_dim] //= divisor
         p = TensorProxy(shape=tuple(shape), dtype=dtypes.to_dtype(leaf.dtype),
                         distparallel_type=plan.mark)
         if plan.mark is not DistParallelType.NONE:
             p.dist_axis = self.axis
             p.dist_size = self.size
+            if self.mode == "hsdp" and plan.mark is DistParallelType.FULLY_SHARDED \
+                    and self.replica_axis:
+                p.dist_replica_axis = self.replica_axis
+                p.dist_replica_size = self.replica_size
         return p
 
     def _finalize_entry(self, entry: CacheEntry, flat, exec_trc) -> None:
@@ -279,7 +315,7 @@ class DistributedFunction(ThunderTPUFunction):
             plan = self._plan[i]
             if plan.shard_dim is not None:
                 shape = list(flat[i].shape)
-                shape[plan.shard_dim] //= self.size
+                shape[plan.shard_dim] //= (plan.shard_size or self.size)
                 sharded_local_shapes[tuple(shape)] = plan.spec
 
         def out_spec_for(leaf):
@@ -337,6 +373,24 @@ def fsdp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "fsdp",
     """
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="fsdp", axis=axis,
+                               params_argnums=params_argnums, zero=zero, **jit_kwargs)
+
+
+def hsdp(fn, mesh_spec: MeshSpec, *, axis: str = "fsdp", replica_axis: str = "dp",
+         params_argnums: Sequence[int] = (0,), zero: int = 2, **jit_kwargs) -> DistributedFunction:
+    """Hierarchical FSDP (HSDP; NEW capability — absent from the reference):
+    params/grads/optimizer state shard over ``axis`` (one ICI domain) and
+    REPLICATE across ``replica_axis`` (across domains/pods); the batch shards
+    over both. Gradient flow composes two synchronize VJPs: all-reduce-mean
+    across replicas, reduce-scatter-mean within the shard axis — how ZeRO
+    scales past the all-gather latency wall of one big flat axis
+    (``mesh_spec`` must name both axes, e.g. ``MeshSpec.make(dp=2, fsdp=4)``).
+    """
+    check(replica_axis in mesh_spec.axis_names and axis in mesh_spec.axis_names,
+          lambda: f"hsdp mesh must define axes {replica_axis!r} and {axis!r}; "
+                  f"got {mesh_spec.axis_names}")
+    return DistributedFunction(fn, mesh_spec, mode="hsdp", axis=axis,
+                               replica_axis=replica_axis,
                                params_argnums=params_argnums, zero=zero, **jit_kwargs)
 
 
