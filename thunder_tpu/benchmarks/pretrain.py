@@ -20,7 +20,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="tiny", help="llama config name")
     p.add_argument("--mode", default="single",
-                   choices=["single", "fsdp", "ddp", "tp", "cp"])
+                   choices=["single", "fsdp", "hsdp", "ddp", "tp", "cp"])
+    p.add_argument("--replicas", type=int, default=2,
+                   help="hsdp: replica-axis size (shard axis gets the rest)")
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--seq", type=int, default=256)
     p.add_argument("--layers", type=int, default=None)
@@ -68,6 +70,11 @@ def main():
         from thunder_tpu.distributed import fsdp
 
         jstep = fsdp(train_step, MeshSpec.make(fsdp=n_dev))
+    elif args.mode == "hsdp":
+        from thunder_tpu.distributed import hsdp
+
+        jstep = hsdp(train_step,
+                     MeshSpec.make(dp=args.replicas, fsdp=n_dev // args.replicas))
     elif args.mode == "ddp":
         from thunder_tpu.distributed import ddp
 
