@@ -56,6 +56,18 @@ def _sync(out):
 
 def time_fn(fn: Callable, *args, warmup: int = 2, iters: int = 10, name: str = "fn",
             **kwargs) -> BenchmarkRunStatistics:
+    import numpy as _np
+    import jax.numpy as jnp
+
+    # device_put inputs ONCE (the whole pytree): numpy args would otherwise
+    # re-upload per call (hundreds of MB over a tunneled platform — that's
+    # the loader's job, not the op under measurement)
+    import jax
+
+    conv = lambda a: jnp.asarray(a) if isinstance(a, _np.ndarray) else a
+    args = tuple(jax.tree_util.tree_map(conv, a) for a in args)
+    kwargs = {k: jax.tree_util.tree_map(conv, v) for k, v in kwargs.items()}
+    _sync(args)
     t0 = time.perf_counter()
     _sync(fn(*args, **kwargs))
     compile_s = time.perf_counter() - t0

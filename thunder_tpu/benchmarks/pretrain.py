@@ -73,6 +73,9 @@ def main():
     elif args.mode == "hsdp":
         from thunder_tpu.distributed import hsdp
 
+        if n_dev % args.replicas or n_dev // args.replicas < 1:
+            raise SystemExit(f"--replicas {args.replicas} must divide the "
+                             f"device count {n_dev} (and leave a shard axis)")
         jstep = hsdp(train_step,
                      MeshSpec.make(dp=args.replicas, fsdp=n_dev // args.replicas))
     elif args.mode == "ddp":
