@@ -265,7 +265,7 @@ register(OpInfo("conv2d", ops.conv2d,
                 lambda rng: [SampleInput((_t(rng, 2, 3, 8, 8), _t(rng, 4, 3, 3, 3))),
                              SampleInput((_t(rng, 2, 3, 8, 8), _t(rng, 4, 3, 3, 3), _t(rng, 4)),
                                          {"stride": 2, "padding": 1})],
-                supports_grad=False))
+                atol=1e-4))
 
 # -- nn ----------------------------------------------------------------------
 register(OpInfo("embedding", ops.embedding,
@@ -715,3 +715,109 @@ register(OpInfo("scatter_add", ops.scatter_add, _scatter_add_ref,
 register(OpInfo("tril_mask", ops.tril_mask,
                 lambda n, m, diagonal=0: jnp.tril(jnp.ones((n, m), bool), k=diagonal),
                 lambda rng: [SampleInput((4, 4))], supports_grad=False))
+
+# -- wider-surface batch 4 (special fns, scatter family, pools, conv Nd) ------
+
+from jax.scipy import special as _jsp  # noqa: E402
+
+register(OpInfo("digamma", ops.digamma, _jsp.digamma, _unary_samples(0.5, 3),
+                atol=1e-4, rtol=1e-4))
+register(OpInfo("ndtri", ops.ndtri, _jsp.ndtri, _unary_samples(0.1, 0.9),
+                atol=1e-4, rtol=1e-4))
+register(OpInfo("erfcinv", ops.erfcinv, lambda a: _jsp.erfinv(1.0 - a),
+                _unary_samples(0.2, 1.8), atol=1e-4, rtol=1e-4))
+register(OpInfo("polygamma", partial(ops.polygamma, 1),
+                partial(_jsp.polygamma, 1), _unary_samples(0.5, 3),
+                atol=1e-3, rtol=1e-3))
+register(OpInfo("zeta", ops.zeta, _jsp.zeta, _binary_samples(1.5, 4),
+                supports_grad=False, atol=1e-4))
+register(OpInfo("nextafter", ops.nextafter, jnp.nextafter, _binary_samples(-2, 2),
+                supports_grad=False))
+register(OpInfo("cumprod", ops.cumprod,
+                lambda a, dim: jnp.cumprod(a, axis=dim),
+                lambda rng: [SampleInput((_t(rng, 3, 5, lo=0.3, hi=2), 1)),
+                             SampleInput((_t(rng, 4, lo=0.3, hi=2), 0))], atol=1e-4))
+
+
+def _scatter_ref(a, dim, idx, src):
+    return jnp.put_along_axis(jnp.asarray(a), jnp.asarray(idx), jnp.asarray(src),
+                              axis=dim, inplace=False)
+
+
+register(OpInfo("scatter", ops.scatter, _scatter_ref,
+                lambda rng: [SampleInput((np.zeros((5, 4), np.float32), 0,
+                                          np.stack([rng.permutation(5)[:3] for _ in range(4)],
+                                                   axis=1).astype(np.int32),
+                                          _t(rng, 3, 4)))]))
+register(OpInfo("index_copy", ops.index_copy,
+                lambda a, dim, idx, src: jnp.asarray(a).at[jnp.asarray(idx)].set(src),
+                lambda rng: [SampleInput((_t(rng, 5, 4), 0,
+                                          rng.permutation(5)[:3].astype(np.int32),
+                                          _t(rng, 3, 4)))]))
+register(OpInfo("index_add", ops.index_add,
+                lambda a, dim, idx, src: jnp.asarray(a).at[jnp.asarray(idx)].add(src),
+                lambda rng: [SampleInput((_t(rng, 5, 4), 0, _i(rng, 3, hi=5), _t(rng, 3, 4)))]))
+register(OpInfo("unfold", ops.unfold,
+                lambda a, dim, size, step: jnp.moveaxis(
+                    jnp.stack([jax.lax.slice_in_dim(a, i * step, i * step + size, axis=dim)
+                               for i in range((a.shape[dim] - size) // step + 1)], axis=dim),
+                    dim + 1, -1),
+                lambda rng: [SampleInput((_t(rng, 2, 10), 1, 4, 3)),
+                             SampleInput((_t(rng, 6), 0, 2, 2))]))
+register(OpInfo("min_with_indices", ops.min_with_indices,
+                lambda a, dim, keepdim=False: (jnp.min(a, axis=dim, keepdims=keepdim),
+                                               jnp.argmin(a, axis=dim, keepdims=keepdim)),
+                lambda rng: [SampleInput((_t(rng, 4, 5), 1))], supports_grad=False))
+register(OpInfo("conv1d", ops.conv1d,
+                lambda a, w, b=None, stride=1, padding=0, dilation=1, groups=1:
+                    jax.lax.conv_general_dilated(
+                        a, w, window_strides=(stride,), padding=[(padding, padding)],
+                        rhs_dilation=(dilation,),
+                        dimension_numbers=("NCH", "OIH", "NCH"),
+                        feature_group_count=groups) + (0 if b is None else b[None, :, None]),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 10), _t(rng, 4, 3, 3))),
+                             SampleInput((_t(rng, 2, 3, 10), _t(rng, 4, 3, 3), _t(rng, 4)),
+                                         {"stride": 2, "padding": 1})], atol=1e-4))
+register(OpInfo("conv3d", ops.conv3d,
+                lambda a, w, b=None, stride=1, padding=0, dilation=1, groups=1:
+                    jax.lax.conv_general_dilated(
+                        a, w, window_strides=(stride,) * 3,
+                        padding=[(padding, padding)] * 3, rhs_dilation=(dilation,) * 3,
+                        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+                        feature_group_count=groups) + (
+                            0 if b is None else b[None, :, None, None, None]),
+                lambda rng: [SampleInput((_t(rng, 1, 2, 5, 6, 7), _t(rng, 3, 2, 2, 2, 2)))],
+                atol=1e-4))
+register(OpInfo("convolution", ops.convolution,
+                lambda a, w, b=None, stride=1, padding=0, dilation=1, groups=1:
+                    jax.lax.conv_general_dilated(
+                        a, w, window_strides=(stride,) * 2,
+                        padding=[(padding, padding)] * 2, rhs_dilation=(dilation,) * 2,
+                        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                        feature_group_count=groups),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 8, 8), _t(rng, 4, 3, 3, 3)),
+                                         {"stride": 2})], atol=1e-4))
+register(OpInfo("max_pool1d", ops_nn.max_pool1d,
+                lambda a, k, stride=None, padding=0: jax.lax.reduce_window(
+                    a, -jnp.inf, jax.lax.max, (1, 1, k), (1, 1, stride or k),
+                    [(0, 0), (0, 0), (padding, padding)]),
+                lambda rng: [SampleInput((_t(rng, 2, 3, 10), 2)),
+                             SampleInput((_t(rng, 2, 3, 11), 3), {"stride": 2, "padding": 1})],
+                atol=1e-5))
+register(OpInfo("avg_pool1d", ops_nn.avg_pool1d,
+                lambda a, k, stride=None, padding=0, count_include_pad=True:
+                    jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k),
+                                          (1, 1, stride or k), [(0, 0), (0, 0), (0, 0)]) / k,
+                lambda rng: [SampleInput((_t(rng, 2, 3, 10), 2))], atol=1e-5))
+register(OpInfo("max_pool3d", ops_nn.max_pool3d,
+                lambda a, k, stride=None, padding=0: jax.lax.reduce_window(
+                    a, -jnp.inf, jax.lax.max, (1, 1, k, k, k),
+                    (1, 1, stride or k, stride or k, stride or k),
+                    [(0, 0)] * 5),
+                lambda rng: [SampleInput((_t(rng, 1, 2, 6, 6, 6), 2))], atol=1e-5))
+register(OpInfo("avg_pool3d", ops_nn.avg_pool3d,
+                lambda a, k, stride=None, padding=0, count_include_pad=True:
+                    jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k, k, k),
+                                          (1, 1, stride or k, stride or k, stride or k),
+                                          [(0, 0)] * 5) / (k ** 3),
+                lambda rng: [SampleInput((_t(rng, 1, 2, 6, 6, 6), 2))], atol=1e-5))

@@ -183,3 +183,15 @@ def test_fused_linear_cross_entropy_lse_cotangent():
     assert abs(float(lf) - float(ln)) < 1e-4
     np.testing.assert_allclose(np.asarray(dhf), np.asarray(dhn), atol=1e-4)
     np.testing.assert_allclose(np.asarray(dwf), np.asarray(dwn), atol=1e-4)
+
+
+def test_cumprod_grad_exact_at_zeros():
+    """The naive reverse-cumsum(g*out)/a formula is NaN wherever ``a`` has a
+    zero; the CUMPROD_GRAD prim must stay finite and exact there."""
+    from thunder_tpu import ops
+
+    a = np.array([[0.5, 0.0, 2.0, 3.0], [1.0, 2.0, 0.0, 0.0]], dtype=np.float32)
+    g = tt.jit(tt.grad(lambda x: ops.sum(ops.cumprod(x, 1))))(a)
+    ref = jax.grad(lambda x: jnp.cumprod(x, axis=1).sum())(jnp.asarray(a))
+    assert np.all(np.isfinite(np.asarray(g)))
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref), atol=1e-5)

@@ -55,8 +55,8 @@ class PrimIDs(Enum):
     # shape
     BROADCAST_IN_DIM = auto(); CAT = auto(); FLIP = auto(); RESHAPE = auto(); SLICE = auto()
     SQUEEZE = auto(); TRANSPOSE = auto(); PAD = auto()
-    TAKE = auto(); TAKE_ALONG_AXIS = auto(); SCATTER_ADD = auto(); INDEX_PUT = auto()
-    INDEX_ADD = auto()
+    TAKE = auto(); TAKE_ALONG_AXIS = auto(); SCATTER_ADD = auto(); SCATTER = auto()
+    INDEX_PUT = auto(); INDEX_ADD = auto()
     DYNAMIC_SLICE = auto(); DYNAMIC_UPDATE_SLICE = auto()
     # elementwise unary
     ABS = auto(); ACOS = auto(); ACOSH = auto(); ASIN = auto(); ASINH = auto(); ATAN = auto()
@@ -66,18 +66,21 @@ class PrimIDs(Enum):
     LOG1P = auto(); LOG2 = auto(); LOGICAL_NOT = auto(); NEG = auto(); RECIPROCAL = auto()
     ROUND = auto(); RSQRT = auto(); SIGN = auto(); SIGNBIT = auto(); SIN = auto(); SINH = auto()
     SQRT = auto(); TAN = auto(); TANH = auto(); TRUNC = auto()
+    DIGAMMA = auto(); NDTRI = auto(); POLYGAMMA = auto()
     # elementwise binary
     ADD = auto(); ATAN2 = auto(); BITWISE_AND = auto(); BITWISE_OR = auto(); BITWISE_XOR = auto()
     COPYSIGN = auto(); DIV = auto(); EQ = auto(); FMOD = auto(); GE = auto(); GT = auto(); LE = auto()
     LT = auto(); MAXIMUM = auto(); MINIMUM = auto(); MUL = auto(); NE = auto(); POW = auto()
     REMAINDER = auto(); SHIFT_LEFT = auto(); SHIFT_RIGHT = auto(); SUB = auto()
+    ZETA = auto(); NEXTAFTER = auto()
     # ternary
     WHERE = auto()
     # reductions
     SUM = auto(); PROD = auto(); AMAX = auto(); AMIN = auto(); ARGMAX = auto(); ARGMIN = auto()
-    CUMSUM = auto(); SORT = auto(); ARGSORT = auto(); TOPK = auto()
+    CUMSUM = auto(); CUMPROD = auto(); CUMPROD_GRAD = auto()
+    SORT = auto(); ARGSORT = auto(); TOPK = auto()
     # linalg / nn
-    DOT_GENERAL = auto(); CONVOLUTION = auto(); EINSUM = auto()
+    DOT_GENERAL = auto(); CONVOLUTION = auto(); CONVOLUTION_BACKWARD = auto(); EINSUM = auto()
     # host interaction
     ITEM = auto()
 
@@ -448,6 +451,15 @@ def _scatter_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, 
 scatter_add = make_prim(PrimIDs.SCATTER_ADD, "scatter_add", _scatter_add_meta)
 
 
+def _scatter_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, dim: int) -> TensorProxy:
+    """torch.scatter semantics (replace, not accumulate): per-element index
+    tensor along ``dim``. Reference: thunder/core/prims.py scatter family."""
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+scatter = make_prim(PrimIDs.SCATTER, "scatter", _scatter_meta)
+
+
 def _index_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, dim: int) -> TensorProxy:
     """Row-wise scatter-add: ``indices`` is rank-1 (n,), ``value`` has ``a``'s
     shape with ``dim`` replaced by n; each slice ``value[..., i, ...]`` is
@@ -530,6 +542,20 @@ sqrt = _make_ew_unary(PrimIDs.SQRT, "sqrt", float_only=True)
 tan = _make_ew_unary(PrimIDs.TAN, "tan", float_only=True)
 tanh = _make_ew_unary(PrimIDs.TANH, "tanh", float_only=True)
 trunc = _make_ew_unary(PrimIDs.TRUNC, "trunc")
+digamma = _make_ew_unary(PrimIDs.DIGAMMA, "digamma", float_only=True)
+ndtri = _make_ew_unary(PrimIDs.NDTRI, "ndtri", float_only=True)
+
+
+def _polygamma_meta(a: TensorProxy, n: int) -> TensorProxy:
+    """torch.polygamma analog (reference: thunder/torch/__init__.py polygamma);
+    ``n`` is a static Python int — the derivative order."""
+    check(isinstance(n, int) and n >= 0, lambda: f"polygamma: order must be a non-negative int, got {n}")
+    check(a.dtype.is_inexact, lambda: f"polygamma requires floating dtype, got {a.dtype}")
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+polygamma = make_prim(PrimIDs.POLYGAMMA, "polygamma", _polygamma_meta,
+                      tags=(OpTags.ELEMENTWISE_OP,))
 
 # ---------------------------------------------------------------------------
 # elementwise binary
@@ -557,6 +583,8 @@ remainder = _make_ew_binary(PrimIDs.REMAINDER, "remainder")
 shift_left = _make_ew_binary(PrimIDs.SHIFT_LEFT, "shift_left")
 shift_right = _make_ew_binary(PrimIDs.SHIFT_RIGHT, "shift_right")
 sub = _make_ew_binary(PrimIDs.SUB, "sub")
+zeta = _make_ew_binary(PrimIDs.ZETA, "zeta")
+nextafter = _make_ew_binary(PrimIDs.NEXTAFTER, "nextafter")
 
 
 # ---------------------------------------------------------------------------
@@ -616,6 +644,24 @@ def _cumsum_meta(a: TensorProxy, dim: int) -> TensorProxy:
 
 
 cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", _cumsum_meta)
+
+
+def _cumprod_meta(a: TensorProxy, dim: int) -> TensorProxy:
+    check(0 <= dim < a.ndim, lambda: f"cumprod: dim {dim} out of range for rank {a.ndim}")
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+cumprod = make_prim(PrimIDs.CUMPROD, "cumprod", _cumprod_meta)
+
+
+def _cumprod_grad_meta(g: TensorProxy, a: TensorProxy, dim: int) -> TensorProxy:
+    """Exact cumprod input-grad (finite even when ``a`` has zeros — the naive
+    reverse-cumsum(g*out)/a formula is NaN there); lowered via XLA's scan
+    linearization."""
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+cumprod_grad = make_prim(PrimIDs.CUMPROD_GRAD, "cumprod_grad", _cumprod_grad_meta)
 
 
 def _sort_meta(a: TensorProxy, dim: int, descending: bool) -> TensorProxy:
@@ -685,6 +731,20 @@ def _convolution_meta(a: TensorProxy, w: TensorProxy, bias: TensorProxy | None, 
 
 
 convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", _convolution_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _convolution_backward_meta(g: TensorProxy, a: TensorProxy, w: TensorProxy, *, stride: Sequence[int],
+                               padding: Sequence[tuple[int, int]], dilation: Sequence[int],
+                               groups: int) -> tuple[TensorProxy, TensorProxy]:
+    """Input+weight grads of CONVOLUTION (torch ``convolution_backward``
+    analog; bias grad is a plain reduction expressed at the ops layer).
+    Kept a prim so XLA lowers it to its native transposed-conv kernels."""
+    return (TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device),
+            TensorProxy(shape=w.shape, dtype=w.dtype, device=w.device))
+
+
+convolution_backward = make_prim(PrimIDs.CONVOLUTION_BACKWARD, "convolution_backward",
+                                 _convolution_backward_meta, tags=(OpTags.MATMUL_OP,))
 
 
 def _einsum_meta(equation: str, *operands) -> TensorProxy:

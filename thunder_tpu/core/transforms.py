@@ -106,7 +106,8 @@ _NONDIFF = {
     PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
     PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE, PrimIDs.UNPACK_TRIVIAL,
     PrimIDs.PYTHON_PRINT, PrimIDs.COMMENT, PrimIDs.SINK, PrimIDs.DEVICE_PUT,
-    PrimIDs.SHARDING_CONSTRAINT, PrimIDs.SORT, PrimIDs.CUMSUM,
+    PrimIDs.SHARDING_CONSTRAINT, PrimIDs.SORT,
+    PrimIDs.ZETA, PrimIDs.NEXTAFTER,
 }
 
 
@@ -449,6 +450,50 @@ _register_unary(PrimIDs.ERFC, prims.erfc,
                                                               _O().exp(_O().neg(_O().mul(a, a)))))))
 _register_unary(PrimIDs.RECIPROCAL, prims.reciprocal,
                 lambda g, a, o: _O().neg(_O().mul(g, _O().mul(o, o))))
+# d/dx erfinv(x) = sqrt(pi)/2 * exp(erfinv(x)^2)
+_register_unary(PrimIDs.ERFINV, prims.erfinv,
+                lambda g, a, o: _O().mul(g, _O().mul(math.sqrt(math.pi) / 2.0,
+                                                     _O().exp(_O().mul(o, o)))))
+_register_unary(PrimIDs.DIGAMMA, prims.digamma,
+                lambda g, a, o: _O().mul(g, prims.polygamma(a, 1)))
+# d/dx ndtri(x) = sqrt(2*pi) * exp(ndtri(x)^2 / 2)
+_register_unary(PrimIDs.NDTRI, prims.ndtri,
+                lambda g, a, o: _O().mul(g, _O().mul(math.sqrt(2.0 * math.pi),
+                                                     _O().exp(_O().mul(0.5, _O().mul(o, o))))))
+
+
+@register_vjp(PrimIDs.POLYGAMMA)
+def _polygamma_vjp(a, n):
+    out = prims.polygamma(a, n)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, ops.mul(g, prims.polygamma(a, n + 1))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.CUMSUM)
+def _cumsum_vjp(a, dim):
+    out = prims.cumsum(a, dim)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, ops.flip(ops.cumsum(ops.flip(g, dim), dim), dim)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.CUMPROD)
+def _cumprod_vjp(a, dim):
+    out = prims.cumprod(a, dim)
+
+    def pullback(g):
+        return _pairs((a, prims.cumprod_grad(g, a, dim)))
+
+    return out, pullback
 
 
 @register_vjp(PrimIDs.ADD)
@@ -818,6 +863,22 @@ def _scatter_add_vjp(a, indices, value, dim):
     return out, pullback
 
 
+@register_vjp(PrimIDs.SCATTER)
+def _scatter_vjp(a, indices, value, dim):
+    out = prims.scatter(a, indices, value, dim)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        # scattered-to positions take their grad from ``value``; ``a``'s grad
+        # is g with those positions zeroed (replace semantics)
+        zeros = ops.zeros_like(value)
+        return _pairs((a, prims.scatter(g, indices, zeros, dim)),
+                      (value, prims.take_along_axis(g, indices, dim)))
+
+    return out, pullback
+
+
 # ---------------------------------------------------------------------------
 # forward-mode (jvp) and batching (vmap)
 # ---------------------------------------------------------------------------
@@ -1067,6 +1128,25 @@ def _topk_vjp(a, k, dim):
         return _pairs((a, prims.scatter_add(zeros, indices, g_vals, dim)))
 
     return (values, indices), pullback
+
+
+@register_vjp(PrimIDs.CONVOLUTION)
+def _convolution_vjp(a, w, bias, *, stride, padding, dilation, groups):
+    out = prims.convolution(a, w, bias, stride=stride, padding=padding,
+                            dilation=dilation, groups=groups)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        ga, gw = prims.convolution_backward(g, a, w, stride=stride, padding=padding,
+                                            dilation=dilation, groups=groups)
+        pairs = [(a, ga), (w, gw)]
+        if bias is not None:
+            # bias broadcasts over batch + spatial dims; its grad is the sum
+            pairs.append((bias, ops.sum(g, dim=(0,) + tuple(range(2, g.ndim)))))
+        return _pairs(*pairs)
+
+    return out, pullback
 
 
 @register_vjp(PrimIDs.DOT_GENERAL)

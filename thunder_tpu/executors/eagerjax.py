@@ -233,6 +233,13 @@ def _scatter_add(a, indices, value, dim):
     return a.at[tuple(idx)].add(value)
 
 
+@impl(PrimIDs.SCATTER)
+def _scatter(a, indices, value, dim):
+    idx = list(jnp.indices(indices.shape, sparse=True))
+    idx[dim] = indices
+    return a.at[tuple(idx)].set(value)
+
+
 @impl(PrimIDs.INDEX_ADD)
 def _index_add(a, indices, value, dim):
     if dim == 0:
@@ -274,7 +281,8 @@ _EW = {
     PrimIDs.RECIPROCAL: jnp.reciprocal, PrimIDs.ROUND: jnp.round, PrimIDs.RSQRT: lax.rsqrt,
     PrimIDs.SIGN: jnp.sign, PrimIDs.SIGNBIT: jnp.signbit, PrimIDs.SIN: jnp.sin,
     PrimIDs.SINH: jnp.sinh, PrimIDs.SQRT: jnp.sqrt, PrimIDs.TAN: jnp.tan, PrimIDs.TANH: jnp.tanh,
-    PrimIDs.TRUNC: jnp.trunc,
+    PrimIDs.TRUNC: jnp.trunc, PrimIDs.DIGAMMA: jax.scipy.special.digamma,
+    PrimIDs.NDTRI: jax.scipy.special.ndtri,
     PrimIDs.ADD: jnp.add, PrimIDs.ATAN2: jnp.arctan2, PrimIDs.BITWISE_AND: jnp.bitwise_and,
     PrimIDs.BITWISE_OR: jnp.bitwise_or, PrimIDs.BITWISE_XOR: jnp.bitwise_xor,
     PrimIDs.COPYSIGN: jnp.copysign, PrimIDs.DIV: jnp.true_divide, PrimIDs.EQ: jnp.equal,
@@ -283,6 +291,7 @@ _EW = {
     PrimIDs.MINIMUM: jnp.minimum, PrimIDs.MUL: jnp.multiply, PrimIDs.NE: jnp.not_equal,
     PrimIDs.POW: jnp.power, PrimIDs.REMAINDER: jnp.remainder, PrimIDs.SHIFT_LEFT: jnp.left_shift,
     PrimIDs.SHIFT_RIGHT: jnp.right_shift, PrimIDs.SUB: jnp.subtract,
+    PrimIDs.ZETA: jax.scipy.special.zeta, PrimIDs.NEXTAFTER: jnp.nextafter,
     PrimIDs.WHERE: jnp.where,
 }
 _impls.update(_EW)
@@ -323,6 +332,22 @@ def _argmin(a, dim):
 @impl(PrimIDs.CUMSUM)
 def _cumsum(a, dim):
     return jnp.cumsum(a, axis=dim)
+
+
+@impl(PrimIDs.CUMPROD)
+def _cumprod(a, dim):
+    return jnp.cumprod(a, axis=dim)
+
+
+@impl(PrimIDs.CUMPROD_GRAD)
+def _cumprod_grad(g, a, dim):
+    _, vjp = jax.vjp(lambda x: jnp.cumprod(x, axis=dim), a)
+    return vjp(g)[0]
+
+
+@impl(PrimIDs.POLYGAMMA)
+def _polygamma(a, n):
+    return jax.scipy.special.polygamma(n, a)
 
 
 @impl(PrimIDs.SORT)
@@ -370,6 +395,16 @@ def _convolution(a, w, bias, *, stride, padding, dilation, groups):
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nspatial)
     return out
+
+
+@impl(PrimIDs.CONVOLUTION_BACKWARD)
+def _convolution_backward(g, a, w, *, stride, padding, dilation, groups):
+    def fwd(a_, w_):
+        return _convolution(a_, w_, None, stride=stride, padding=padding,
+                            dilation=dilation, groups=groups)
+
+    _, vjp = jax.vjp(fwd, a, w)
+    return vjp(g)
 
 
 # -- host --------------------------------------------------------------------

@@ -331,6 +331,22 @@ sqrt = _make_unary("sqrt", prims.sqrt, float_promote=True, py=math.sqrt)
 tan = _make_unary("tan", prims.tan, float_promote=True, py=math.tan)
 tanh = _make_unary("tanh", prims.tanh, float_promote=True, py=math.tanh)
 trunc = _make_unary("trunc", prims.trunc, py=math.trunc)
+digamma = _make_unary("digamma", prims.digamma, float_promote=True)
+ndtri = _make_unary("ndtri", prims.ndtri, float_promote=True)
+
+
+@opsymbol
+def polygamma(n, a):
+    """torch.polygamma(n, input): n-th derivative of digamma. Reference:
+    thunder/torch/__init__.py polygamma."""
+    a = _float_promote(a)
+    return prims.polygamma(a, int(pyval(n)))
+
+
+@opsymbol
+def erfcinv(a):
+    """Inverse of erfc: erfcinv(x) = erfinv(1 - x)."""
+    return erfinv(sub(1.0, _float_promote(a)))
 
 
 # ---------------------------------------------------------------------------
@@ -359,6 +375,8 @@ bitwise_xor = _make_binary("bitwise_xor", prims.bitwise_xor, py=_pyop.xor)
 copysign = _make_binary("copysign", prims.copysign, py=math.copysign)
 eq = _make_binary("eq", prims.eq, py=_pyop.eq)
 fmod = _make_binary("fmod", prims.fmod, py=math.fmod)
+zeta = _make_binary("zeta", prims.zeta, float_promote=True)
+nextafter = _make_binary("nextafter", prims.nextafter, py=math.nextafter)
 ge = _make_binary("ge", prims.ge, py=_pyop.ge)
 gt = _make_binary("gt", prims.gt, py=_pyop.gt)
 le = _make_binary("le", prims.le, py=_pyop.le)
@@ -592,6 +610,35 @@ def scatter_add(a, dim, index, src):
     return prims.scatter_add(a, index, src, canonicalize_dim(a.ndim, dim))
 
 
+def scatter(a, dim, index, src):
+    """torch.scatter (replace semantics). ``src`` may be a python scalar
+    (torch's ``value`` variant)."""
+    d = canonicalize_dim(a.ndim, dim)
+    if isinstance(src, Number):
+        src = full(index.shape, src, dtype=a.dtype, device=a.device)
+    return prims.scatter(a, index, src, d)
+
+
+def index_copy(a, dim, index, src):
+    """torch.index_copy: rank-1 ``index`` selects slices of ``a`` along
+    ``dim`` to be replaced by ``src``'s slices. Lowered to the SCATTER prim
+    with the index broadcast along the slice dims."""
+    d = canonicalize_dim(a.ndim, dim)
+    shape = [1] * a.ndim
+    shape[d] = int(index.shape[0])
+    idx = broadcast_to(reshape(index, tuple(shape)), src.shape)
+    return prims.scatter(a, idx, src, d)
+
+
+def index_add(a, dim, index, src, *, alpha=1):
+    """torch.index_add: row-wise scatter-add (1 index per slice) — lowers to
+    the INDEX_ADD prim, XLA's update_window_dims fast path."""
+    d = canonicalize_dim(a.ndim, dim)
+    if not (isinstance(alpha, Number) and pyval(alpha) == 1):
+        src = mul(src, alpha)
+    return prims.index_add(a, index, src, d)
+
+
 def index_put(a, indices, values, accumulate=False):
     return prims.index_put(a, tuple(indices), values, bool(accumulate))
 
@@ -786,6 +833,14 @@ def max_with_indices(a, dim, keepdim=False):
     return values, indices
 
 
+@opsymbol
+def min_with_indices(a, dim, keepdim=False):
+    d = canonicalize_dim(a.ndim, dim)
+    values = amin(a, dim, keepdim=keepdim)
+    indices = argmin(a, dim, keepdim=keepdim)
+    return values, indices
+
+
 def all_(a, dim=None, keepdim=False):
     b = _to_bool(a)
     return convert_element_type(amin(convert_element_type(b, dtypes.uint8), dim, keepdim=keepdim), dtypes.bool8)
@@ -798,6 +853,10 @@ def any_(a, dim=None, keepdim=False):
 
 def cumsum(a, dim):
     return prims.cumsum(a, canonicalize_dim(a.ndim, dim))
+
+
+def cumprod(a, dim):
+    return prims.cumprod(a, canonicalize_dim(a.ndim, dim))
 
 
 def sort(a, dim=-1, descending=False):
@@ -946,6 +1005,34 @@ def conv1d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
     s = (stride,) if isinstance(stride, int) else tuple(stride)
     d = (dilation,) if isinstance(dilation, int) else tuple(dilation)
     p = (padding,) if isinstance(padding, int) else tuple(padding)
+    return prims.convolution(a, w, bias, stride=s, padding=tuple((pi, pi) for pi in p),
+                             dilation=d, groups=groups)
+
+
+@opsymbol
+def conv3d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    a, w, bias = maybe_autocast(a, w, bias)
+
+    def _triple(x):
+        return (x, x, x) if isinstance(x, int) else tuple(x)
+
+    s, d, p = _triple(stride), _triple(dilation), _triple(padding)
+    return prims.convolution(a, w, bias, stride=s, padding=tuple((pi, pi) for pi in p),
+                             dilation=d, groups=groups)
+
+
+@opsymbol
+def convolution(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    """Generic N-d convolution over the CONVOLUTION prim (spatial rank
+    inferred from the input, torch ``convolution``-style int-or-sequence
+    args)."""
+    nd = a.ndim - 2
+    check(nd >= 1, "convolution: input must have at least one spatial dim")
+
+    def _tup(x):
+        return (x,) * nd if isinstance(x, int) else tuple(x)
+
+    s, d, p = _tup(stride), _tup(dilation), _tup(padding)
     return prims.convolution(a, w, bias, stride=s, padding=tuple((pi, pi) for pi in p),
                              dilation=d, groups=groups)
 
@@ -1320,6 +1407,23 @@ def vstack(tensors):
 
 def dstack(tensors):
     return cat([atleast_3d(t) for t in tensors], dim=2)
+
+
+def unfold(a, dim, size, step):
+    """Tensor.unfold: sliding windows of ``size`` every ``step`` along
+    ``dim``; the window axis becomes the LAST dim (torch semantics)."""
+    d = canonicalize_dim(a.ndim, dim)
+    length = int(a.shape[d])
+    size, step = int(pyval(size)), int(pyval(step))
+    check(0 < size <= length, lambda: f"unfold: size {size} out of range for dim of {length}")
+    check(step > 0, lambda: f"unfold: step must be > 0, got {step}")
+    n = (length - size) // step + 1
+    windows = [narrow(a, d, i * step, size) for i in range(n)]
+    return movedim(stack(windows, dim=d), d + 1, -1)
+
+
+def numel(a):
+    return int(a.numel)
 
 
 def narrow(a, dim, start, length):

@@ -469,24 +469,26 @@ def nll_loss(logp, target, weight=None, ignore_index: int = -100,
 # reads into one windowed reduce on TPU)
 # ---------------------------------------------------------------------------
 
-def _pool_windows(a, kernel_size, stride, padding, pad_value):
-    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+def _pool_windows(a, kernel_size, stride, padding, pad_value, nd=2):
+    """Sliding windows over the last ``nd`` spatial dims (1-, 2- or 3-d
+    pooling share this decomposition)."""
+    import itertools
+
+    ks = (kernel_size,) * nd if isinstance(kernel_size, int) else tuple(kernel_size)
     if stride is None:
-        stride = (kh, kw)
-    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
-    if ph or pw:
-        a = ops.pad(a, ((0, 0, 0), (0, 0, 0), (ph, ph, 0), (pw, pw, 0)), value=pad_value)
-    H, W = a.shape[-2], a.shape[-1]
-    out_h = (H - kh) // sh + 1
-    out_w = (W - kw) // sw + 1
+        stride = ks
+    ss = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    ps = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    if any(ps):
+        cfg = tuple((0, 0, 0) for _ in range(a.ndim - nd)) + tuple((p, p, 0) for p in ps)
+        a = ops.pad(a, cfg, value=pad_value)
+    outs = [(a.shape[a.ndim - nd + i] - ks[i]) // ss[i] + 1 for i in range(nd)]
     windows = []
-    for i in range(kh):
-        for j in range(kw):
-            idx = (Ellipsis, slice(i, i + (out_h - 1) * sh + 1, sh),
-                   slice(j, j + (out_w - 1) * sw + 1, sw))
-            windows.append(ops.getitem(a, idx))
-    return windows, kh * kw
+    for offs in itertools.product(*(range(k) for k in ks)):
+        idx = (Ellipsis,) + tuple(
+            slice(offs[i], offs[i] + (outs[i] - 1) * ss[i] + 1, ss[i]) for i in range(nd))
+        windows.append(ops.getitem(a, idx))
+    return windows, math.prod(ks)
 
 
 @opsymbol(id="nn.max_pool2d")
@@ -502,6 +504,44 @@ def max_pool2d(a, kernel_size, stride=None, padding=0):
 def avg_pool2d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
     check(count_include_pad or padding == 0, "avg_pool2d: count_include_pad=False unsupported")
     windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0)
+    out = windows[0]
+    for w in windows[1:]:
+        out = ops.add(out, w)
+    return ops.true_divide(out, float(n))
+
+
+@opsymbol(id="nn.max_pool1d")
+def max_pool1d(a, kernel_size, stride=None, padding=0):
+    windows, _ = _pool_windows(a, kernel_size, stride, padding, float("-inf"), nd=1)
+    out = windows[0]
+    for w in windows[1:]:
+        out = ops.maximum(out, w)
+    return out
+
+
+@opsymbol(id="nn.max_pool3d")
+def max_pool3d(a, kernel_size, stride=None, padding=0):
+    windows, _ = _pool_windows(a, kernel_size, stride, padding, float("-inf"), nd=3)
+    out = windows[0]
+    for w in windows[1:]:
+        out = ops.maximum(out, w)
+    return out
+
+
+@opsymbol(id="nn.avg_pool1d")
+def avg_pool1d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
+    check(count_include_pad or padding == 0, "avg_pool1d: count_include_pad=False unsupported")
+    windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0, nd=1)
+    out = windows[0]
+    for w in windows[1:]:
+        out = ops.add(out, w)
+    return ops.true_divide(out, float(n))
+
+
+@opsymbol(id="nn.avg_pool3d")
+def avg_pool3d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
+    check(count_include_pad or padding == 0, "avg_pool3d: count_include_pad=False unsupported")
+    windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0, nd=3)
     out = windows[0]
     for w in windows[1:]:
         out = ops.add(out, w)

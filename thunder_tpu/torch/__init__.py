@@ -1062,7 +1062,31 @@ for _tf, _fn in {
     F.cross_entropy: _t_cross_entropy, F.nll_loss: _t_nll_loss, F.mse_loss: _t_mse_loss,
     F.one_hot: _t_one_hot, F.normalize: _t_normalize,
     F.conv1d: _t_conv1d, F.conv2d: _t_conv2d, F.pad: _t_pad,
+    F.conv3d: (lambda a, w, bias=None, stride=1, padding=0, dilation=1, groups=1:
+               ops.conv3d(a, w, bias, stride, padding, dilation, groups)),
     F.batch_norm: _f_batch_norm,
+    torch.relu: (lambda a: ops.relu(a)),
+    torch.erfinv: _make_simple(ops.erfinv),
+    torch.celu: (lambda a, alpha=1.0: ops.celu(a, alpha)),
+    torch.selu: (lambda a: ops.selu(a)),
+    torch.clamp_min: (lambda a, m: ops.maximum(a, m)),
+    torch.clamp_max: (lambda a, m: ops.minimum(a, m)),
+    torch.digamma: _make_simple(ops.digamma),
+    torch.polygamma: (lambda n, a: ops.polygamma(n, a)),
+    torch.nextafter: (lambda a, b: ops.nextafter(a, b)),
+    torch.cumprod: (lambda a, dim, *, dtype=None, out=None: ops.cumprod(a, dim)),
+    torch.scatter: (lambda a, dim, index, src: ops.scatter(a, dim, index, src)),
+    torch.scatter_add: (lambda a, dim, index, src: ops.scatter_add(a, dim, index, src)),
+    torch.index_copy: (lambda a, dim, index, src: ops.index_copy(a, dim, index, src)),
+    torch.index_add: (lambda a, dim, index, src, *, alpha=1:
+                      ops.index_add(a, dim, index, src, alpha=alpha)),
+    torch.numel: (lambda a: ops.numel(a)),
+    torch.special.digamma: _make_simple(ops.digamma),
+    torch.special.psi: _make_simple(ops.digamma),
+    torch.special.polygamma: (lambda n, a: ops.polygamma(n, a)),
+    torch.special.ndtri: _make_simple(ops.ndtri),
+    torch.special.erfinv: _make_simple(ops.erfinv),
+    torch.special.zeta: (lambda a, b: ops.zeta(a, b)),
 }.items():
     _torch_to_thunder_function_map[_tf] = _fn
 
@@ -1445,6 +1469,33 @@ def _t_max_pool2d(a, kernel_size, stride=None, padding=0, dilation=1,
     return ops_nn.max_pool2d(a, kernel_size, stride, padding)
 
 
+def _t_max_pool1d(a, kernel_size, stride=None, padding=0, dilation=1,
+                  ceil_mode=False, return_indices=False):
+    check(dilation == 1 and not ceil_mode and not return_indices,
+          "max_pool1d: dilation/ceil_mode/return_indices unsupported")
+    return ops_nn.max_pool1d(a, kernel_size, stride, padding)
+
+
+def _t_max_pool3d(a, kernel_size, stride=None, padding=0, dilation=1,
+                  ceil_mode=False, return_indices=False):
+    check(dilation == 1 and not ceil_mode and not return_indices,
+          "max_pool3d: dilation/ceil_mode/return_indices unsupported")
+    return ops_nn.max_pool3d(a, kernel_size, stride, padding)
+
+
+def _t_avg_pool1d(a, kernel_size, stride=None, padding=0, ceil_mode=False,
+                  count_include_pad=True):
+    check(not ceil_mode, "avg_pool1d: ceil_mode unsupported")
+    return ops_nn.avg_pool1d(a, kernel_size, stride, padding, count_include_pad)
+
+
+def _t_avg_pool3d(a, kernel_size, stride=None, padding=0, ceil_mode=False,
+                  count_include_pad=True, divisor_override=None):
+    check(not ceil_mode and divisor_override is None,
+          "avg_pool3d: ceil_mode/divisor_override unsupported")
+    return ops_nn.avg_pool3d(a, kernel_size, stride, padding, count_include_pad)
+
+
 def _t_interpolate(a, size=None, scale_factor=None, mode="nearest", align_corners=None,
                    recompute_scale_factor=None, antialias=False):
     check(mode == "nearest", "interpolate: only mode='nearest' supported")
@@ -1546,6 +1597,10 @@ for _tf, _fn in {
     # pooling / vision
     F.max_pool2d: _t_max_pool2d,
     F.avg_pool2d: _t_avg_pool2d,
+    F.max_pool1d: _t_max_pool1d,
+    F.max_pool3d: _t_max_pool3d,
+    F.avg_pool1d: _t_avg_pool1d,
+    F.avg_pool3d: _t_avg_pool3d,
     F.adaptive_avg_pool2d: (lambda a, output_size: ops_nn.adaptive_avg_pool2d(a, output_size)),
     F.instance_norm: _t_instance_norm,
     F.pixel_shuffle: (lambda a, r: ops_nn.pixel_shuffle(a, r)),
@@ -1555,6 +1610,14 @@ for _tf, _fn in {
 
 _EXTRA_METHODS = {
     "frac": _make_simple(ops.frac), "square": _make_simple(ops.square),
+    "unfold": (lambda a, dim, size, step: ops.unfold(a, dim, size, step)),
+    "scatter": (lambda a, dim, index, src: ops.scatter(a, dim, index, src)),
+    "index_copy": (lambda a, dim, index, src: ops.index_copy(a, dim, index, src)),
+    "index_add": (lambda a, dim, index, src, *, alpha=1:
+                  ops.index_add(a, dim, index, src, alpha=alpha)),
+    "cumprod": (lambda a, dim, *, dtype=None: ops.cumprod(a, dim)),
+    "digamma": _make_simple(ops.digamma),
+    "nextafter": (lambda a, b: ops.nextafter(a, b)),
     "nan_to_num": (lambda a, nan=0.0, posinf=None, neginf=None: ops.nan_to_num(a, nan, posinf, neginf)),
     "logsumexp": _t_logsumexp, "norm": _t_norm, "median": _t_median,
     "count_nonzero": (lambda a, dim=None: ops.count_nonzero(a, dim)),
