@@ -34,3 +34,37 @@ def test_op_eager_executor(opinfo):
     want = opinfo.ref(*sample.args, **sample.kwargs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=opinfo.atol, rtol=opinfo.rtol)
+
+
+def test_getitem_tensor_advanced_indexing():
+    """a[int_tensor] used to crash: `Ellipsis in idx` traced through
+    TensorProxy.__eq__. Identity-based checks must keep this working."""
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+
+    a = np.random.rand(5, 3).astype(np.float32)
+    i = np.array([2, 0, 4], dtype=np.int32)
+    r = tt.jit(lambda x, idx: ops.getitem(x, idx))(a, i)
+    np.testing.assert_allclose(np.asarray(r), a[i])
+    r2 = tt.jit(lambda x, idx: ops.getitem(x, (slice(1, 4), idx)))(a, i[:2])
+    np.testing.assert_allclose(np.asarray(r2), a[1:4][:, i[:2]])
+    # ints before the tensor are squeezed; Nones insert axes — the take dim
+    # must be computed in the recursed output's coordinates
+    b = np.random.rand(4, 3, 6).astype(np.float32)
+    t = np.array([2, 0], dtype=np.int32)
+    r3 = tt.jit(lambda x, idx: ops.getitem(x, (1, idx)))(b, t)
+    np.testing.assert_allclose(np.asarray(r3), b[1, t])
+    r4 = tt.jit(lambda x, idx: ops.getitem(x, (None, idx)))(b, t)
+    np.testing.assert_allclose(np.asarray(r4), b[None, t])
+    r5 = tt.jit(lambda x, idx: ops.getitem(x, (slice(0, 3), 2, idx)))(b, t)
+    np.testing.assert_allclose(np.asarray(r5), b[0:3, 2, :][:, t])
+
+
+def test_getitem_bool_mask_raises_clearly():
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    import pytest as _pytest
+
+    a = np.random.rand(4).astype(np.float32)
+    with _pytest.raises(NotImplementedError, match="data-dependent shape"):
+        tt.jit(lambda x: ops.getitem(x, ops.gt(x, 0.5)))(a)

@@ -275,3 +275,52 @@ def test_max_min_sort_narrow_torch_conventions():
     ref = fn(x)
     for g, r in zip(got, ref):
         assert_close(g, r)
+
+
+def test_torch_function_coverage_batch5():
+    """Top-level torch fns surfaced by the coverage diff vs the reference's
+    276-symbol dialect (reference: thunder/torch/__init__.py)."""
+    x = torch.rand(3, 4) + 0.5
+    i = torch.tensor([0, 2], dtype=torch.int32)
+    ii = torch.tensor([[0, 2]], dtype=torch.long)
+    cases = [
+        (lambda a: torch.acosh(a + 1), (x,)),
+        (lambda a: torch.asinh(a), (x,)),
+        (lambda a: torch.atanh(a * 0.5), (x,)),
+        (lambda a: torch.relu(a - 1), (x,)),
+        (lambda a: torch.erfinv(a * 0.5), (x,)),
+        (lambda a: torch.selu(a), (x,)),
+        (lambda a: torch.celu(a, 0.5), (x,)),
+        (lambda a: torch.clamp_min(a, 1.0), (x,)),
+        (lambda a: torch.clamp_max(a, 1.0), (x,)),
+        (lambda a: torch.bitwise_and(a, a), (i,)),
+        (lambda a: torch.bitwise_not(a), (i,)),
+        (lambda a, w: torch.convolution(a, w, None, [1, 1], [0, 0], [1, 1],
+                                        False, [0, 0], 1),
+         (torch.rand(1, 2, 6, 6), torch.rand(3, 2, 3, 3))),
+        (lambda a: torch.copysign(a, -a), (x,)),
+        (lambda a: torch.exp2(a), (x,)),
+        (lambda a, idx: a.index_put((idx,), torch.tensor(0.0)), (x, i)),
+        (lambda a: torch.lgamma(a), (x,)),
+        (lambda a: torch.signbit(-a), (x,)),
+        (lambda a, idx: torch.take_along_dim(a, idx, 1), (x, ii)),
+        (lambda a: torch.real(a), (x,)),
+        (lambda a: torch.digamma(a), (x,)),
+        (lambda a: torch.polygamma(1, a), (x,)),
+        (lambda a: torch.nextafter(a, a + 1), (x,)),
+        (lambda a: torch.special.ndtri(a * 0.5), (x,)),
+        (lambda a: torch.special.zeta(a + 1.5, a), (x,)),
+    ]
+    for fn, args in cases:
+        got = ttorch.jit(fn)(*args)
+        ref = fn(*args)
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                                   np.asarray(ref, dtype=np.float32),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_dynamic_shape_ops_raise_clearly():
+    x = torch.rand(3, 4)
+
+    with pytest.raises(NotImplementedError, match="data-dependent shape"):
+        ttorch.jit(lambda a: torch.masked_select(a, a > 0.5))(x)

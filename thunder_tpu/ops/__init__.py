@@ -648,10 +648,12 @@ def getitem(a, idx):
     advanced indexing. Decomposes to slice/squeeze/take prims."""
     if not isinstance(idx, tuple):
         idx = (idx,)
-    # expand Ellipsis
+    # expand Ellipsis (identity checks only: `in`/`==` would trace through
+    # TensorProxy.__eq__ when idx holds an advanced-indexing tensor)
     n_specified = len([i for i in idx if i is not None and i is not Ellipsis])
-    if Ellipsis in idx:
-        pos = idx.index(Ellipsis)
+    ell = [i for i, x in enumerate(idx) if x is Ellipsis]
+    if ell:
+        pos = ell[0]
         fill = a.ndim - n_specified
         idx = idx[:pos] + (slice(None),) * fill + idx[pos + 1:]
     else:
@@ -661,12 +663,20 @@ def getitem(a, idx):
     tensor_positions = [i for i, x in enumerate(idx) if isinstance(x, TensorProxy)]
     if tensor_positions:
         check(len(tensor_positions) == 1, "only single-tensor advanced indexing is supported")
+        for i in tensor_positions:
+            check(idx[i].dtype is not dtypes.bool8,
+                  "boolean-mask indexing produces a data-dependent shape, which XLA "
+                  "cannot compile; rewrite with ops.where / masked_fill, or multiply "
+                  "by the mask", NotImplementedError)
         tp = tensor_positions[0]
-        dim = len([x for x in idx[:tp] if x is not None])
+        # the take dim is in OUT's coordinates: ints before tp are squeezed
+        # away by the recursive getitem, Nones insert axes
+        dim = len([x for x in idx[:tp] if isinstance(x, slice) or x is None])
         rest = list(idx)
         t = rest[tp]
         rest[tp] = slice(None)
-        out = getitem(a, tuple(rest)) if any(x != slice(None) for x in rest if x is not None) or None in rest else a
+        nontrivial = any(not (isinstance(x, slice) and x == slice(None)) for x in rest)
+        out = getitem(a, tuple(rest)) if nontrivial else a
         return take(out, t, dim)
 
     starts, ends, strides = [], [], []

@@ -1010,6 +1010,30 @@ for _tf, _fn in {
     torch.atan: _make_simple(ops.atan), torch.atan2: (lambda a, b: ops.atan2(a, b)),
     torch.sinh: _make_simple(ops.sinh), torch.cosh: _make_simple(ops.cosh),
     torch.erf: _make_simple(ops.erf), torch.erfc: _make_simple(ops.erfc),
+    torch.acosh: _make_simple(ops.acosh), torch.asinh: _make_simple(ops.asinh),
+    torch.atanh: _make_simple(ops.atanh), torch.arccosh: _make_simple(ops.acosh),
+    torch.arcsinh: _make_simple(ops.asinh), torch.arctanh: _make_simple(ops.atanh),
+    torch.exp2: _make_simple(ops.exp2), torch.lgamma: _make_simple(ops.lgamma),
+    torch.signbit: _make_simple(ops.signbit),
+    torch.copysign: (lambda a, b: ops.copysign(a, b)),
+    torch.bitwise_and: (lambda a, b: ops.bitwise_and(a, b)),
+    torch.bitwise_or: (lambda a, b: ops.bitwise_or(a, b)),
+    torch.bitwise_xor: (lambda a, b: ops.bitwise_xor(a, b)),
+    torch.bitwise_not: (lambda a: ops.bitwise_not(a)),
+    torch.bernoulli: (lambda a, *, generator=None, out=None:
+                      ops.bernoulli(a, a.shape, dtype=a.dtype)),
+    torch.take_along_dim: (lambda a, idx, dim=None:
+                           ops.take_along_axis(a, idx, dim) if dim is not None
+                           else ops.take_along_axis(ops.reshape(a, (a.numel,)),
+                                                    ops.reshape(idx, (idx.numel,)), 0)),
+    torch.real: (lambda a: a),  # complex dtypes unsupported; real of a real tensor
+    torch.index_put: (lambda a, indices, values, accumulate=False:
+                      ops.index_put(a, indices, values, accumulate)),
+    torch.masked_select: (lambda a, mask, *, out=None: _t_masked_select(a, mask)),
+    torch.convolution: (lambda a, w, bias, stride, padding, dilation, transposed,
+                        output_padding, groups:
+                        _t_convolution(a, w, bias, stride, padding, dilation,
+                                       transposed, output_padding, groups)),
     torch.sigmoid: _t_sigmoid, torch.floor: _make_simple(ops.floor),
     torch.ceil: _make_simple(ops.ceil), torch.round: _make_simple(ops.round),
     torch.trunc: _make_simple(ops.trunc), torch.sign: _make_simple(ops.sign),
@@ -1147,6 +1171,8 @@ _TENSOR_METHODS: dict[str, Callable] = {
     "flip": _t_flip, "roll": _t_roll, "repeat": _t_repeat,
     "repeat_interleave": _t_repeat_interleave,
     "split": _t_split, "chunk": _t_chunk, "unbind": _t_unbind,
+    "index_put": (lambda a, indices, values, accumulate=False:
+                  ops.index_put(a, indices, values, accumulate)),
     "narrow": _t_narrow, "select": _t_select, "scatter_add": (
         lambda a, dim, index, src: ops.scatter_add(a, dim, index, src)),
     "masked_select": None,  # data-dependent shape: unsupported by design (XLA)
@@ -1467,6 +1493,20 @@ def _t_max_pool2d(a, kernel_size, stride=None, padding=0, dilation=1,
     check(dilation == 1 and not ceil_mode and not return_indices,
           "max_pool2d: dilation/ceil_mode/return_indices unsupported")
     return ops_nn.max_pool2d(a, kernel_size, stride, padding)
+
+
+def _t_masked_select(a, mask, *, out=None):
+    raise NotImplementedError(
+        "masked_select produces a data-dependent shape, which XLA cannot compile; "
+        "rewrite with torch.where(mask, a, fill) or multiply by the mask")
+
+
+def _t_convolution(a, w, bias, stride, padding, dilation, transposed,
+                   output_padding, groups):
+    """torch.convolution (the aten-level generic entry)."""
+    check(not transposed, "convolution: transposed=True unsupported")
+    check(not any(output_padding), "convolution: output_padding unsupported")
+    return ops.convolution(a, w, bias, tuple(stride), tuple(padding), tuple(dilation), groups)
 
 
 def _t_max_pool1d(a, kernel_size, stride=None, padding=0, dilation=1,
