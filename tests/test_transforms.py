@@ -102,3 +102,47 @@ def test_einsum_grad():
     np.testing.assert_allclose(np.asarray(lv), np.asarray(jl), atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(ga), np.asarray(jga), atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(gb), np.asarray(jgb), atol=1e-4, rtol=1e-4)
+
+
+def test_jvp_cumprod_scatter_convolution():
+    """Structural jvp rules for the non-elementwise batch-4 prims."""
+    a = np.random.rand(3, 4).astype(np.float32) + 0.5
+    ta = np.random.rand(3, 4).astype(np.float32)
+
+    fn = lambda x: ops.sum(ops.cumprod(x, 1))
+    a[1, 2] = 0.0  # the tangent must stay exact and finite at zeros
+    _, tg = tt.jit(lambda x, t: tt.jvp(fn)((x,), (t,)))(a, ta)
+    _, ref = jax.jvp(lambda x: jnp.cumprod(x, axis=1).sum(),
+                     (jnp.asarray(a),), (jnp.asarray(ta),))
+    assert np.isfinite(float(tg))
+    assert abs(float(tg) - float(ref)) < 1e-3
+
+    idx = np.array([[1, 0], [2, 3], [0, 1]], np.int32)
+    src = np.random.rand(3, 2).astype(np.float32)
+    f2 = lambda x: ops.sum(ops.square(ops.scatter_add(x, 1, idx, src)))
+    _, tg2 = tt.jit(lambda x, t: tt.jvp(f2)((x,), (t,)))(a, ta)
+
+    def jf2(x):
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        grids[1] = jnp.asarray(idx)
+        return (x.at[tuple(grids)].add(src) ** 2).sum()
+
+    _, ref2 = jax.jvp(jf2, (jnp.asarray(a),), (jnp.asarray(ta),))
+    assert abs(float(tg2) - float(ref2)) < 1e-2
+
+    c = np.random.rand(1, 2, 6, 6).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    tc, tw, tb = (np.random.rand(*x.shape).astype(np.float32) for x in (c, w, b))
+    f3 = lambda x, ww, bb: ops.sum(ops.conv2d(x, ww, bb))
+    _, tg3 = tt.jit(lambda x, ww, bb, t1, t2, t3:
+                    tt.jvp(f3)((x, ww, bb), (t1, t2, t3)))(c, w, b, tc, tw, tb)
+
+    def jf3(x, ww, bb):
+        o = jax.lax.conv_general_dilated(x, ww, (1, 1), [(0, 0), (0, 0)],
+                                         dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return (o + bb[None, :, None, None]).sum()
+
+    _, ref3 = jax.jvp(jf3, (jnp.asarray(c), jnp.asarray(w), jnp.asarray(b)),
+                      (jnp.asarray(tc), jnp.asarray(tw), jnp.asarray(tb)))
+    assert abs(float(tg3) - float(ref3)) / abs(float(ref3)) < 1e-4

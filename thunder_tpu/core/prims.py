@@ -77,7 +77,7 @@ class PrimIDs(Enum):
     WHERE = auto()
     # reductions
     SUM = auto(); PROD = auto(); AMAX = auto(); AMIN = auto(); ARGMAX = auto(); ARGMIN = auto()
-    CUMSUM = auto(); CUMPROD = auto(); CUMPROD_GRAD = auto()
+    CUMSUM = auto(); CUMPROD = auto(); CUMPROD_GRAD = auto(); CUMPROD_TANGENT = auto()
     SORT = auto(); ARGSORT = auto(); TOPK = auto()
     # linalg / nn
     DOT_GENERAL = auto(); CONVOLUTION = auto(); CONVOLUTION_BACKWARD = auto(); EINSUM = auto()
@@ -662,6 +662,15 @@ def _cumprod_grad_meta(g: TensorProxy, a: TensorProxy, dim: int) -> TensorProxy:
 
 
 cumprod_grad = make_prim(PrimIDs.CUMPROD_GRAD, "cumprod_grad", _cumprod_grad_meta)
+
+
+def _cumprod_tangent_meta(a: TensorProxy, t: TensorProxy, dim: int) -> TensorProxy:
+    """Exact forward-mode tangent of cumprod (finite at zeros, like
+    CUMPROD_GRAD; the naive out*cumsum(t/a) formula is NaN there)."""
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+cumprod_tangent = make_prim(PrimIDs.CUMPROD_TANGENT, "cumprod_tangent", _cumprod_tangent_meta)
 
 
 def _sort_meta(a: TensorProxy, dim: int, descending: bool) -> TensorProxy:

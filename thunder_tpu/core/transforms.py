@@ -995,6 +995,42 @@ def jvp_call(fn, primals: tuple, tangents: tuple):
                         continue
                     term = op_with(i, t)
                     t_out = term if t_out is None else ops.add(t_out, term)
+            elif sym_id is PrimIDs.CUMPROD:
+                t_out = prims.cumprod_tangent(flat_margs[0], arg_tans[0], margs[1])
+            elif sym_id in (PrimIDs.SCATTER, PrimIDs.SCATTER_ADD, PrimIDs.INDEX_ADD):
+                # jointly linear in (a, value); indices are constant
+                a_, idx_, v_, dim_ = margs
+                ta = arg_tans[0]
+                tv = None
+                for i, fa in enumerate(flat_margs):
+                    if fa is v_:
+                        tv = arg_tans[i]
+                if tv is None and sym_id is not PrimIDs.SCATTER:
+                    t_out = ta  # scatter-add of a zero value is the identity
+                else:
+                    ta = ta if ta is not None else ops.zeros_like(a_)
+                    tv = tv if tv is not None else ops.zeros_like(v_)
+                    t_out = bsym.sym(ta, idx_, tv, dim_)
+            elif sym_id is PrimIDs.CONVOLUTION:
+                a_, w_, b_ = margs[0], margs[1], margs[2]
+                ta, tw = arg_tans[0], arg_tans[1]
+                terms = []
+                if ta is not None:
+                    terms.append(prims.convolution(ta, w_, None, **mkwargs))
+                if tw is not None:
+                    terms.append(prims.convolution(a_, tw, None, **mkwargs))
+                tb = None
+                if b_ is not None:
+                    for i, fa in enumerate(flat_margs):
+                        if fa is b_:
+                            tb = arg_tans[i]
+                if tb is not None:
+                    terms.append(ops.reshape(tb, (1, -1) + (1,) * (a_.ndim - 2)))
+                t_out = terms[0]
+                for term in terms[1:]:
+                    t_out = ops.add(t_out, term)
+                if tuple(t_out.shape) != tuple(out.shape):  # bias-only tangent
+                    t_out = ops.add(t_out, ops.zeros_like(out))
             elif sym_id in _vjp_rules and OpTags.ELEMENTWISE_OP in bsym.sym.tags:
                 res = _vjp_rules[sym_id](*margs, **mkwargs)
                 if res is NotImplemented or res is None:

@@ -345,6 +345,11 @@ def _cumprod_grad(g, a, dim):
     return vjp(g)[0]
 
 
+@impl(PrimIDs.CUMPROD_TANGENT)
+def _cumprod_tangent(a, t, dim):
+    return jax.jvp(lambda x: jnp.cumprod(x, axis=dim), (a,), (t,))[1]
+
+
 @impl(PrimIDs.POLYGAMMA)
 def _polygamma(a, n):
     return jax.scipy.special.polygamma(n, a)
