@@ -146,3 +146,61 @@ def test_jvp_cumprod_scatter_convolution():
     _, ref3 = jax.jvp(jf3, (jnp.asarray(c), jnp.asarray(w), jnp.asarray(b)),
                       (jnp.asarray(tc), jnp.asarray(tw), jnp.asarray(tb)))
     assert abs(float(tg3) - float(ref3)) / abs(float(ref3)) < 1e-4
+
+
+def test_visitor_transform_and_bsym_dag():
+    """visitor_transform splices per-bsym edits; bsym DAG + toposort give
+    custom scheduling hooks (reference transforms.py:356,120,217)."""
+    from thunder_tpu.core.transform_common import (
+        VisitType, visitor_transform, bsym_list_to_dag, toposort_bsym_dag)
+    from thunder_tpu.core import prims
+
+    jf = tt.jit(lambda x: ops.mul(ops.add(x, 1.0), ops.sin(x)))
+    a = np.random.rand(3).astype(np.float32)
+    jf(a)
+    trc = tt.last_traces(jf)[0]
+
+    # INSERT_AFTER: marker comment lands right after each add
+    def visit(bsym):
+        if bsym.sym.name == "add":
+            prims.comment("post-add marker")
+            return VisitType.INSERT_AFTER
+        return VisitType.NO_OP
+
+    new = visitor_transform(trc, visit, provenance="comment after adds")
+    src = new.python()
+    assert "post-add marker" in src
+    names = [b.sym.name for b in new.bound_symbols]
+    assert names.index("comment") == names.index("add") + 1
+
+    # REPLACE: swap sin -> cos; downstream consumers (mul, return) must be
+    # rebound to the replacement's outputs — the rewritten trace EXECUTES
+    def visit2(bsym):
+        if bsym.sym.name == "sin":
+            prims.cos(bsym.args[0])
+            return VisitType.REPLACE
+        return VisitType.NO_OP
+
+    new2 = visitor_transform(trc, visit2)
+    names2 = [b.sym.name for b in new2.bound_symbols]
+    assert "cos" in names2 and "sin" not in names2
+    got = new2.python_callable()(a)
+    np.testing.assert_allclose(np.asarray(got), (a + 1.0) * np.cos(a), rtol=1e-5)
+
+    # DAG: add/sin are roots (consume only trace inputs), return is the leaf
+    roots, leaves = bsym_list_to_dag(trc.bound_symbols)
+    assert sorted(r.bsym.sym.name for r in roots) == ["add", "sin"]
+    assert [l.bsym.sym.name for l in leaves] == ["python_return"]
+
+    # both orders yield a valid schedule of the same length
+    top = toposort_bsym_dag(roots, "top_down")
+    bot = toposort_bsym_dag(leaves, "bottom_up")
+    assert len(top) == len(bot) == len(trc.bound_symbols)
+    assert top.index(next(b for b in top if b.sym.name == "mul")) \
+        > max(top.index(next(b for b in top if b.sym.name == n)) for n in ("add", "sin"))
+
+    # selector hook: prefer sin first among eligible roots
+    sel = lambda elig: next((i for i, x in enumerate(elig)
+                             if x.bsym.sym.name == "sin"), 0)
+    top2 = toposort_bsym_dag(roots, "top_down", selector=sel)
+    assert top2[0].sym.name == "sin"

@@ -8,6 +8,9 @@ in-place methods are rewritten functionally at the ops layer.
 
 from __future__ import annotations
 
+from enum import Enum, auto
+
+from thunder_tpu.core.baseutils import check
 from thunder_tpu.core.prims import OpTags, PrimIDs
 from thunder_tpu.core.proxies import Proxy, Variable
 from thunder_tpu.core.symbol import BoundSymbol
@@ -76,3 +79,130 @@ class Transform:
 
     def transform_module(self, model):
         return model
+
+
+# ---------------------------------------------------------------------------
+# visitor transform + bsym DAG utilities
+# (reference: thunder/core/transforms.py visitor_transform :356,
+#  bsym_list_to_dag :120, toposort_bsym_dag :217)
+# ---------------------------------------------------------------------------
+
+class VisitType(Enum):
+    """What ``visitor_transform``'s visit callback asked for, per bsym."""
+
+    NO_OP = auto()          # keep the original bsym; discard anything emitted
+    REPLACE = auto()        # drop the original; splice in the emitted ops
+    INSERT_BEFORE = auto()  # emitted ops go before the original
+    INSERT_AFTER = auto()   # emitted ops go after the original
+
+
+def visitor_transform(trc: TraceCtx, visit, *, provenance: str | None = None) -> TraceCtx:
+    """Rebuild ``trc`` by running ``visit(bsym) -> VisitType`` per bound
+    symbol. Ops the callback records (by calling ops/prims under the trace
+    ctx) are spliced according to the returned VisitType. The workhorse for
+    ad-hoc trace rewrites that don't warrant a pattern (reference
+    ``visitor_transform``)."""
+    from thunder_tpu.core.trace import tracectx
+
+    new = from_trace(trc)
+    swap: dict[Variable, Proxy] = {}
+    with tracectx(new):
+        for bsym in trc.bound_symbols:
+            if swap:
+                bsym = bsym.from_bsym_swap_proxies(swap, skip_output=True)
+            scope: list[BoundSymbol] = []
+            new.push_scope(scope)
+            try:
+                vt = visit(bsym)
+            finally:
+                new.pop_scope()
+            if vt is VisitType.REPLACE:
+                new.bound_symbols.extend(scope)
+                # rebind downstream consumers of the replaced bsym's outputs
+                # to the last emitted op's outputs (positional pairing)
+                if scope:
+                    for old, repl in zip(bsym.flat_proxy_outs(),
+                                         scope[-1].flat_proxy_outs()):
+                        if old is not repl:
+                            swap[Variable(old)] = repl
+            elif vt is VisitType.INSERT_BEFORE:
+                new.bound_symbols.extend(scope)
+                new.bound_symbols.append(bsym)
+            elif vt is VisitType.INSERT_AFTER:
+                new.bound_symbols.append(bsym)
+                new.bound_symbols.extend(scope)
+            else:
+                new.bound_symbols.append(bsym)
+    if provenance is not None:
+        new.set_provenance(provenance)
+    return new
+
+
+class Node:
+    """DAG node wrapping one bsym (parents produce its inputs, children
+    consume its outputs)."""
+
+    __slots__ = ("bsym", "parents", "children")
+
+    def __init__(self, bsym: BoundSymbol):
+        self.bsym = bsym
+        self.parents: list[Node] = []
+        self.children: list[Node] = []
+
+    def __repr__(self):
+        return f"Node({self.bsym.sym.name})"
+
+
+def bsym_list_to_dag(bsyms) -> tuple[list[Node], list[Node]]:
+    """Dataflow DAG over a bsym list; returns (roots, leaves)."""
+    from thunder_tpu.core.utils import producers as _producers, consumers as _consumers
+
+    bsyms = list(bsyms)
+    prod = _producers(bsyms)
+    cons = _consumers(bsyms)
+    nodes = [Node(b) for b in bsyms]
+    by_bsym = {id(b): n for b, n in zip(bsyms, nodes)}
+    roots, leaves = [], []
+    for node in nodes:
+        seen_parents = set()
+        for v in consumed_vars(node.bsym):
+            p = prod.get(v)
+            if p is not None and id(p) != id(node.bsym) and id(p) not in seen_parents:
+                seen_parents.add(id(p))
+                node.parents.append(by_bsym[id(p)])
+        seen_children = set()
+        for v in produced_vars(node.bsym):
+            for c in cons.get(v, ()):
+                if id(c) != id(node.bsym) and id(c) not in seen_children:
+                    seen_children.add(id(c))
+                    node.children.append(by_bsym[id(c)])
+        if not node.parents:
+            roots.append(node)
+        if not node.children:
+            leaves.append(node)
+    return roots, leaves
+
+
+def toposort_bsym_dag(start_nodes: list[Node], order: str = "top_down",
+                      selector=None) -> list[BoundSymbol]:
+    """Topological sort of a bsym DAG. ``order`` is "top_down" (start from
+    roots) or "bottom_up" (start from leaves; result is still returned in
+    top-to-bottom execution order). ``selector(eligible) -> int`` chooses
+    among the currently schedulable nodes — the hook for custom scheduling
+    policies (e.g. hoisting collectives early, sinking waits late)."""
+    check(order in ("top_down", "bottom_up"), lambda: f"unknown toposort order {order!r}")
+    if selector is None:
+        selector = lambda eligible: 0
+    done: set[int] = set()
+    out: list[BoundSymbol] = []
+    eligible = list(start_nodes)
+    while eligible:
+        node = eligible.pop(selector(eligible))
+        out.append(node.bsym)
+        done.add(id(node))
+        nxt = node.parents if order == "bottom_up" else node.children
+        for cand in nxt:
+            deps = cand.children if order == "bottom_up" else cand.parents
+            if id(cand) not in done and all(id(d) in done for d in deps):
+                eligible.append(cand)
+    return list(reversed(out)) if order == "bottom_up" else out

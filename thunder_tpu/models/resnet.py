@@ -1,0 +1,173 @@
+"""ResNet-style CNN model family (beyond the reference's model zoo, which is
+transformer-only — added once CONVOLUTION grew a VJP so conv nets train
+end-to-end; exercises conv/pool/batch-norm through the whole trace pipeline).
+
+TPU-first design notes:
+- purely functional: batch-norm running statistics are explicit state threaded
+  through the step (``forward(..., state) -> (logits, new_state)``), the same
+  state-threading discipline the FP8 amax history uses — no module mutation.
+- NCHW layout with channel counts that keep XLA's conv tiling on the MXU;
+  bf16-friendly (stats accumulate in f32).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    name: str = "resnet-tiny"
+    num_classes: int = 10
+    in_channels: int = 3
+    width: int = 8                      # channels of the first stage
+    stage_blocks: tuple = (1, 1, 1)     # residual blocks per stage (stride-2 between)
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    dtype: dtypes.dtype = dtypes.float32
+
+
+CONFIGS = {
+    "resnet-tiny": ResNetConfig(),
+    "resnet18": ResNetConfig(name="resnet18", num_classes=1000, width=64,
+                             stage_blocks=(2, 2, 2, 2)),
+    "resnet34": ResNetConfig(name="resnet34", num_classes=1000, width=64,
+                             stage_blocks=(3, 4, 6, 3)),
+}
+
+
+def _conv_init(key, cout, cin, k):
+    import jax
+    import jax.numpy as jnp
+
+    fan_in = cin * k * k
+    std = (2.0 / fan_in) ** 0.5
+    return jax.random.normal(key, (cout, cin, k, k), jnp.float32) * std
+
+
+def init_bn_state(cfg: ResNetConfig):
+    """Identity batch-norm statistics (zeros mean / ones var) — the cheap
+    stateless-inference fallback; no RNG or weight allocation."""
+    import jax.numpy as jnp
+
+    def bn_state(c):
+        return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+
+    state = {"stem": bn_state(cfg.width), "stages": []}
+    for si, n_blocks in enumerate(cfg.stage_blocks):
+        c_out = cfg.width * (2 ** si)
+        state["stages"].append([{"bn1": bn_state(c_out), "bn2": bn_state(c_out)}
+                                for _ in range(n_blocks)])
+    return state
+
+
+def init_params(cfg: ResNetConfig, seed: int = 0):
+    """Returns (params, bn_state). ``bn_state`` holds running mean/var per
+    norm layer — thread it through ``forward`` during training."""
+    import jax
+    import jax.numpy as jnp
+
+    jd = cfg.dtype.jax
+    key = jax.random.PRNGKey(seed)
+    n_convs = 1 + sum(cfg.stage_blocks) * 2 + sum(1 for i in range(len(cfg.stage_blocks)) if i > 0)
+    keys = iter(jax.random.split(key, n_convs + 1))
+
+    def bn(c):
+        return {"scale": jnp.ones((c,), jd), "bias": jnp.zeros((c,), jd)}
+
+    def bn_state(c):
+        return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+
+    params = {"stem": {"w": _conv_init(next(keys), cfg.width, cfg.in_channels, 3).astype(jd),
+                       "bn": bn(cfg.width)},
+              "stages": [], "fc": None}
+    state = {"stem": bn_state(cfg.width), "stages": []}
+
+    c_in = cfg.width
+    for si, n_blocks in enumerate(cfg.stage_blocks):
+        c_out = cfg.width * (2 ** si)
+        stage_p, stage_s = [], []
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk = {"conv1": {"w": _conv_init(next(keys), c_out, c_in, 3).astype(jd), "bn": bn(c_out)},
+                   "conv2": {"w": _conv_init(next(keys), c_out, c_out, 3).astype(jd), "bn": bn(c_out)},
+                   "down": None}
+            sblk = {"bn1": bn_state(c_out), "bn2": bn_state(c_out)}
+            if stride != 1 or c_in != c_out:
+                blk["down"] = {"w": _conv_init(next(keys), c_out, c_in, 1).astype(jd)}
+            stage_p.append(blk)
+            stage_s.append(sblk)
+            c_in = c_out
+        params["stages"].append(stage_p)
+        state["stages"].append(stage_s)
+
+    fc_key = next(keys)
+    params["fc"] = {"w": (jax.random.normal(fc_key, (cfg.num_classes, c_in), jnp.float32)
+                          * (1.0 / c_in) ** 0.5).astype(jd),
+                    "b": jnp.zeros((cfg.num_classes,), jd)}
+    return params, state
+
+
+def _batch_norm(x, p, s, cfg, training):
+    """Functional batch-norm; returns (normalized, new_state)."""
+    if training:
+        xf = ops.convert_element_type(x, dtypes.float32)
+        mean = ops.mean(xf, dim=(0, 2, 3))
+        var = ops.var(xf, dim=(0, 2, 3), correction=0)
+        m = cfg.bn_momentum
+        new_s = {"mean": ops.add(ops.mul(s["mean"], 1.0 - m), ops.mul(mean, m)),
+                 "var": ops.add(ops.mul(s["var"], 1.0 - m), ops.mul(var, m))}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = ops.rsqrt(ops.add(var, cfg.bn_eps))
+    scale = ops.mul(p["scale"], ops.convert_element_type(inv, x.dtype))
+    shift = ops.sub(p["bias"], ops.mul(ops.convert_element_type(mean, x.dtype), scale))
+
+    def bcast(v):
+        return ops.reshape(v, (1, -1, 1, 1))
+
+    return ops.add(ops.mul(x, bcast(scale)), bcast(shift)), new_s
+
+
+def forward(params, x, cfg: ResNetConfig, state=None, training: bool = False):
+    """x: (N, C, H, W) -> (logits, new_state)."""
+    if state is None:
+        training = False
+        state = init_bn_state(cfg)  # inference fallback: identity stats
+    new_state = {"stem": None, "stages": []}
+
+    h = ops.conv2d(x, params["stem"]["w"], stride=1, padding=1)
+    h, new_state["stem"] = _batch_norm(h, params["stem"]["bn"], state["stem"], cfg, training)
+    h = ops.relu(h)
+
+    for si, (stage_p, stage_s) in enumerate(zip(params["stages"], state["stages"])):
+        ns_stage = []
+        for bi, (blk, sblk) in enumerate(zip(stage_p, stage_s)):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            r = h
+            o = ops.conv2d(h, blk["conv1"]["w"], stride=stride, padding=1)
+            o, ns1 = _batch_norm(o, blk["conv1"]["bn"], sblk["bn1"], cfg, training)
+            o = ops.relu(o)
+            o = ops.conv2d(o, blk["conv2"]["w"], stride=1, padding=1)
+            o, ns2 = _batch_norm(o, blk["conv2"]["bn"], sblk["bn2"], cfg, training)
+            if blk["down"] is not None:
+                r = ops.conv2d(r, blk["down"]["w"], stride=stride, padding=0)
+            h = ops.relu(ops.add(o, r))
+            ns_stage.append({"bn1": ns1, "bn2": ns2})
+        new_state["stages"].append(ns_stage)
+
+    h = ops.mean(h, dim=(2, 3))  # global average pool
+    logits = ops.add(ops.matmul(h, ops.transpose(params["fc"]["w"], (1, 0))), params["fc"]["b"])
+    return logits, new_state
+
+
+def loss_fn(params, x, targets, cfg: ResNetConfig, state=None, training: bool = True):
+    """Cross-entropy loss; returns (loss, new_state)."""
+    from thunder_tpu.ops import nn
+
+    logits, new_state = forward(params, x, cfg, state=state, training=training)
+    return nn.cross_entropy(ops.convert_element_type(logits, dtypes.float32), targets), new_state
