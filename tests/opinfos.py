@@ -821,3 +821,58 @@ register(OpInfo("avg_pool3d", ops_nn.avg_pool3d,
                                           (1, 1, stride or k, stride or k, stride or k),
                                           [(0, 0)] * 5) / (k ** 3),
                 lambda rng: [SampleInput((_t(rng, 1, 2, 6, 6, 6), 2))], atol=1e-5))
+
+# -- batch 5: factories, casting, logical reductions, bit shifts, index_put --
+
+register(OpInfo("all", ops.all_,
+                lambda a, dim=None, keepdim=False: jnp.all(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((np.array([[1.0, 0.0], [2.0, 3.0]], np.float32),)),
+                             SampleInput((np.array([[1.0, 0.0], [2.0, 3.0]], np.float32), 1))],
+                supports_grad=False))
+register(OpInfo("any", ops.any_,
+                lambda a, dim=None, keepdim=False: jnp.any(a, axis=dim, keepdims=keepdim),
+                lambda rng: [SampleInput((np.array([[0.0, 0.0], [2.0, 0.0]], np.float32),)),
+                             SampleInput((np.array([[0.0, 0.0], [2.0, 0.0]], np.float32), 0))],
+                supports_grad=False))
+register(OpInfo("arange", ops.arange,
+                lambda *a, **k: jnp.arange(*a, **k),
+                lambda rng: [SampleInput((5,)), SampleInput((2, 9, 3)),
+                             SampleInput((0.0, 1.0, 0.25))],
+                supports_grad=False))
+register(OpInfo("full_factory", ops.full, jnp.full,
+                lambda rng: [SampleInput(((3, 4), 2.5))], supports_grad=False))
+register(OpInfo("ones", ops.ones, lambda *s: jnp.ones(s),
+                lambda rng: [SampleInput((2, 3))], supports_grad=False))
+register(OpInfo("zeros", ops.zeros, lambda *s: jnp.zeros(s),
+                lambda rng: [SampleInput((2, 3))], supports_grad=False))
+register(OpInfo("to", lambda a, dt: ops.to(a, dt),
+                lambda a, dt: a.astype({"float32": np.float32, "int32": np.int32}[dt.name]),
+                lambda rng: [SampleInput((_t(rng, 3, 4), __import__("thunder_tpu").core.dtypes.int32))],
+                supports_grad=False))
+register(OpInfo("shift_left", ops.shift_left, jnp.left_shift,
+                lambda rng: [SampleInput((_i(rng, 4, hi=8), _i(rng, 4, hi=3)))],
+                supports_grad=False))
+register(OpInfo("shift_right", ops.shift_right, jnp.right_shift,
+                lambda rng: [SampleInput((_i(rng, 4, hi=64), _i(rng, 4, hi=3)))],
+                supports_grad=False))
+register(OpInfo("index_put", ops.index_put,
+                lambda a, idxs, v, accumulate=False:
+                    jnp.asarray(a).at[tuple(jnp.asarray(i) for i in idxs)].add(v)
+                    if accumulate else
+                    jnp.asarray(a).at[tuple(jnp.asarray(i) for i in idxs)].set(v),
+                lambda rng: [SampleInput((_t(rng, 5, 4), (np.array([1, 3], np.int32),),
+                                          _t(rng, 2, 4))),
+                             SampleInput((_t(rng, 5, 4), (np.array([1, 3], np.int32),),
+                                          _t(rng, 2, 4), True)),
+                             # values broadcast against the indexed slice
+                             SampleInput((_t(rng, 5, 4), (np.array([0, 2], np.int32),),
+                                          _t(rng, 4))),
+                             # duplicate indices: last write wins, grads mask
+                             SampleInput((_t(rng, 5, 4), (np.array([1, 1], np.int32),),
+                                          _t(rng, 2, 4)))]))
+register(OpInfo("max_with_indices", ops.max_with_indices,
+                lambda a, dim, keepdim=False: (jnp.max(a, axis=dim, keepdims=keepdim),
+                                               jnp.argmax(a, axis=dim, keepdims=keepdim)),
+                lambda rng: [SampleInput((_t(rng, 4, 5), 1))], supports_grad=False))
+register(OpInfo("div", ops.div,
+                jnp.true_divide, _binary_samples(0.5, 2), supports_grad=True))

@@ -853,6 +853,51 @@ def _index_add_vjp(a, indices, value, dim):
     return out, pullback
 
 
+@register_vjp(PrimIDs.INDEX_PUT)
+def _index_put_vjp(a, indices, values, accumulate):
+    out = prims.index_put(a, indices, values, accumulate)
+
+    def pullback(g):
+        from thunder_tpu import ops
+        from thunder_tpu.core import dtypes as _dt
+
+        check(len(indices) == 1,
+              "index_put VJP supports a single index tensor (multi-tensor "
+              "advanced indexing grads are not implemented)",
+              NotImplementedError)
+        idx = indices[0]
+        n = int(idx.shape[0])
+        g_sel = prims.take(g, idx, 0)
+        if accumulate:
+            g_a = g
+        else:
+            # replace semantics: with duplicate indices only the winning
+            # write affects the output — replay the scatter with writer ids
+            # and zero the grads of overwritten rows
+            ids = prims.iota(n, dtype=_dt.int32, device=a.device)
+            writer = prims.index_put(
+                ops.full((int(a.shape[0]),), -1, dtype=_dt.int32, device=a.device),
+                indices, ids, False)
+            win = ops.eq(prims.take(writer, idx, 0), ids)
+            g_sel = ops.where(ops.reshape(win, (n,) + (1,) * (g_sel.ndim - 1)),
+                              g_sel, ops.zeros_like(g_sel))
+            g_a = prims.index_put(g, indices, ops.zeros_like(g_sel), False)
+        if not isinstance(values, TensorProxy):
+            return _pairs((a, g_a))
+        # values may have broadcast against the indexed slice: sum-to-shape
+        if tuple(g_sel.shape) != tuple(values.shape):
+            extra = g_sel.ndim - values.ndim
+            if extra:
+                g_sel = ops.sum(g_sel, dim=tuple(range(extra)))
+            reduce_dims = tuple(i for i, (gs, vs) in enumerate(
+                zip(g_sel.shape, values.shape)) if gs != vs)
+            if reduce_dims:
+                g_sel = ops.sum(g_sel, dim=reduce_dims, keepdim=True)
+        return _pairs((a, g_a), (values, g_sel))
+
+    return out, pullback
+
+
 @register_vjp(PrimIDs.SCATTER_ADD)
 def _scatter_add_vjp(a, indices, value, dim):
     out = prims.scatter_add(a, indices, value, dim)
