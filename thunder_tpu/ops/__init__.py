@@ -643,11 +643,67 @@ def index_put(a, indices, values, accumulate=False):
     return prims.index_put(a, tuple(indices), values, bool(accumulate))
 
 
+def _getitem_multi_tensor(a, idx, tensor_positions):
+    """Multi-tensor advanced indexing, torch/numpy semantics for a
+    CONTIGUOUS block of index tensors (``a[i, j]``, ``a[:, i, j]``): the
+    index tensors broadcast together, their joint result dims replace the
+    indexed dims in place. TPU-first lowering: linearize the broadcast
+    indices over the indexed dims' row-major strides, flatten those dims of
+    ``a``, and gather with ONE take — a single XLA gather, no scatter loops.
+    Entries before/after the block must be full slices (apply other basic
+    indexing in a separate step)."""
+    p0, pk = tensor_positions[0], tensor_positions[-1]
+    check(tensor_positions == list(range(p0, pk + 1)),
+          "advanced indexing tensors must be contiguous (split non-adjacent "
+          "tensor indices into separate getitem steps)", NotImplementedError)
+    check(all(isinstance(x, slice) and x == slice(None)
+              for i, x in enumerate(idx) if i not in tensor_positions),
+          "mixing tensor indices with other non-trivial indices is "
+          "unsupported — apply slices/ints in a separate getitem step",
+          NotImplementedError)
+    tensors = [idx[i] for i in tensor_positions]
+    sizes = [int(a.shape[i]) for i in tensor_positions]
+    bshape = tensors[0].shape
+    for t in tensors[1:]:
+        bshape = compute_broadcast_shape(bshape, t.shape)
+    # linear index over the indexed dims (normalize negatives via mod);
+    # computed in int32 regardless of the index dtype — narrow dtypes would
+    # overflow in the stride multiply
+    flat_len = 1
+    for s in sizes:
+        flat_len *= s
+    check(flat_len < 2 ** 31, lambda: f"indexed extent {flat_len} overflows int32 "
+          "linearization", NotImplementedError)
+    linear = None
+    stride_acc = 1
+    strides = []
+    for s in reversed(sizes):
+        strides.append(stride_acc)
+        stride_acc *= s
+    strides = list(reversed(strides))
+    for t, s, st in zip(tensors, sizes, strides):
+        t = convert_element_type(t, dtypes.int32)
+        t = broadcast_to(remainder(t, s), bshape)
+        term = mul(t, st) if st != 1 else t
+        linear = term if linear is None else add(linear, term)
+    pre = tuple(int(s) for s in a.shape[:p0])
+    post = tuple(int(s) for s in a.shape[pk + 1:])
+    flat = reshape(a, pre + (flat_len,) + post)
+    nb = len(bshape)
+    lin_flat = reshape(linear, (-1,)) if nb != 1 else linear
+    out = take(flat, lin_flat, len(pre))
+    return reshape(out, pre + tuple(bshape) + post) if nb != 1 else out
+
+
 def getitem(a, idx):
-    """Basic indexing (ints, slices, None, Ellipsis) + single integer-tensor
-    advanced indexing. Decomposes to slice/squeeze/take prims."""
+    """Basic indexing (ints, slices, None, Ellipsis) + integer-tensor
+    advanced indexing (single tensor anywhere; multiple contiguous tensors
+    broadcast jointly). Decomposes to slice/squeeze/take prims."""
     if not isinstance(idx, tuple):
         idx = (idx,)
+    # concrete index arrays (np/jax constants) become trace constants
+    idx = tuple(_lift_arrays(x) if not isinstance(x, (slice, type(Ellipsis)))
+                else x for x in idx)
     # expand Ellipsis (identity checks only: `in`/`==` would trace through
     # TensorProxy.__eq__ when idx holds an advanced-indexing tensor)
     n_specified = len([i for i in idx if i is not None and i is not Ellipsis])
@@ -659,15 +715,16 @@ def getitem(a, idx):
     else:
         idx = idx + (slice(None),) * (a.ndim - n_specified)
 
-    # advanced indexing with one integer tensor
+    # advanced indexing with integer tensor(s)
     tensor_positions = [i for i, x in enumerate(idx) if isinstance(x, TensorProxy)]
     if tensor_positions:
-        check(len(tensor_positions) == 1, "only single-tensor advanced indexing is supported")
         for i in tensor_positions:
             check(idx[i].dtype is not dtypes.bool8,
                   "boolean-mask indexing produces a data-dependent shape, which XLA "
                   "cannot compile; rewrite with ops.where / masked_fill, or multiply "
                   "by the mask", NotImplementedError)
+        if len(tensor_positions) > 1:
+            return _getitem_multi_tensor(a, idx, tensor_positions)
         tp = tensor_positions[0]
         # the take dim is in OUT's coordinates: ints before tp are squeezed
         # away by the recursive getitem, Nones insert axes
