@@ -267,6 +267,23 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
                     synced = dist_prims.synchronize(synced, p.dist_axis,
                                                     p.distparallel_type, p.dist_size)
                     passed.append(synced)
+                elif (p.distparallel_type in (DistParallelType.COLUMN_WISE,
+                                              DistParallelType.ROW_WISE)
+                      and getattr(p, "dist_replica_axis", None) is not None):
+                    # TP×DP: the tp boundary comms live in ops.linear; here
+                    # only the data-parallel mean of the shard grads is
+                    # needed (identity forward, all-reduce-mean backward)
+                    from thunder_tpu.distributed import prims as dist_prims
+
+                    synced = dist_prims.synchronize(
+                        p, p.dist_replica_axis, DistParallelType.REPLICATED,
+                        p.dist_replica_size)
+                    # the identity sync must not strip the TP mark ops.linear
+                    # keys its boundary collectives on
+                    synced.distparallel_type = p.distparallel_type
+                    synced.dist_axis = p.dist_axis
+                    synced.dist_size = p.dist_size
+                    passed.append(synced)
                 else:
                     passed.append(p)
             elif isinstance(leaf, Proxy):

@@ -104,7 +104,8 @@ class DistributedFunction(ThunderTPUFunction):
 
         def wrapped(*args, **kwargs):
             out = orig_fn(*args, **kwargs)
-            if self.size * self.replica_size > 1 and mode in ("fsdp", "ddp", "cp", "ep", "hsdp"):
+            if self.size * self.replica_size > 1 and mode in ("fsdp", "ddp", "cp", "ep",
+                                                              "hsdp", "tp_dp"):
                 out = tree_map(self._mean_scalar_across_replicas, out)
             return out
 
@@ -132,6 +133,18 @@ class DistributedFunction(ThunderTPUFunction):
         return leaf
 
     # -- leaf classification -------------------------------------------------
+    def _is_batch_leaf(self, path, leaf) -> bool:
+        """Batch-data heuristic shared by the data-sharding modes: integer
+        dtype means batch (token ids/targets); ``data_argnums`` overrides it
+        for float batches (images) and non-batch integer inputs (position
+        ids, masks)."""
+        import numpy as _np
+
+        if self.data_argnums is not None:
+            return (len(path) >= 2 and getattr(path[0], "idx", None) == 0
+                    and getattr(path[1], "idx", None) in self.data_argnums)
+        return _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)
+
     def _build_plan(self, args, kwargs) -> list[LeafPlan]:
         flat_with_paths, _ = jtu.tree_flatten_with_path((args, kwargs))
         # leaf ranges per positional arg: path[0] is SequenceKey into (args, kwargs),
@@ -147,7 +160,7 @@ class DistributedFunction(ThunderTPUFunction):
                 plans.append(LeafPlan("const", None))
                 continue
             shape = tuple(leaf.shape)
-            if self.mode == "tp":
+            if self.mode in ("tp", "tp_dp"):
                 # pattern-match params AND optimizer-state leaves (state pytrees
                 # mirror the param key names, so moments shard with their param)
                 mark_ok = in_params  # only real params get the TP type mark
@@ -161,21 +174,25 @@ class DistributedFunction(ThunderTPUFunction):
                     plans.append(LeafPlan("row", _P(None, self.axis),
                                           DistParallelType.ROW_WISE if mark_ok else DistParallelType.NONE, 1))
                     continue
+                if self.mode == "tp_dp":
+                    if in_params:
+                        # non-TP params replicate; grads all-reduce-mean over dp
+                        plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
+                        continue
+                    dpn = self.replica_size
+                    if (self._is_batch_leaf(path, leaf) and len(shape) >= 1
+                            and shape[0] % dpn == 0 and shape[0] >= dpn):
+                        # batch data shards over the dp axis
+                        plans.append(LeafPlan("data_shard", _P(self.replica_axis),
+                                              shard_dim=0, shard_size=dpn))
+                        continue
                 plans.append(LeafPlan("replicate", _P()))
                 continue
             if self.mode == "hsdp" and not in_params:
-                import numpy as _np
-
                 # batch data shards over BOTH axes (every rank its own
                 # microbatch); float non-param state (optimizer moments)
-                # mirrors the params: shard axis only, replicated across dp.
-                # int dtype is the batch heuristic; data_argnums overrides it
-                # for float batch inputs (images etc.)
-                if self.data_argnums is not None:
-                    is_batch = (len(path) >= 2 and getattr(path[0], "idx", None) == 0
-                                and getattr(path[1], "idx", None) in self.data_argnums)
-                else:
-                    is_batch = _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)
+                # mirrors the params: shard axis only, replicated across dp
+                is_batch = self._is_batch_leaf(path, leaf)
                 both = n * self.replica_size
                 if is_batch and len(shape) >= 1 and shape[0] % both == 0 and shape[0] >= both:
                     plans.append(LeafPlan("data_shard", _P((self.replica_axis, self.axis)),
@@ -203,10 +220,8 @@ class DistributedFunction(ThunderTPUFunction):
                 if in_params:
                     plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
                     continue
-                import numpy as _np
-
-                if (len(shape) >= 1 and shape[0] % n == 0 and shape[0] >= n
-                        and _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)):
+                if (self._is_batch_leaf(path, leaf) and len(shape) >= 1
+                        and shape[0] % n == 0 and shape[0] >= n):
                     plans.append(LeafPlan("data_shard", _P(self.axis), shard_dim=0))
                 else:
                     plans.append(LeafPlan("replicate", _P()))
@@ -233,10 +248,8 @@ class DistributedFunction(ThunderTPUFunction):
                 continue
             if self.mode == "cp":
                 # context parallel: shard the sequence dim of batch arrays
-                import numpy as _np
-
-                if (len(shape) >= 2 and shape[1] % n == 0 and shape[1] >= n
-                        and _np.issubdtype(_np.dtype(leaf.dtype), _np.integer)):
+                if (self._is_batch_leaf(path, leaf) and len(shape) >= 2
+                        and shape[1] % n == 0 and shape[1] >= n):
                     plans.append(LeafPlan("data_shard", _P(None, self.axis), shard_dim=1))
                 else:
                     plans.append(LeafPlan("replicate", _P()))
@@ -301,6 +314,17 @@ class DistributedFunction(ThunderTPUFunction):
                     and self.replica_axis:
                 p.dist_replica_axis = self.replica_axis
                 p.dist_replica_size = self.replica_size
+            if self.mode == "tp_dp" and self.replica_axis:
+                if plan.mark is DistParallelType.REPLICATED:
+                    # replicated params' grads reduce over dp, not tp (grads
+                    # are already identical across tp ranks)
+                    p.dist_axis = self.replica_axis
+                    p.dist_size = self.replica_size
+                elif plan.mark in (DistParallelType.COLUMN_WISE, DistParallelType.ROW_WISE):
+                    # tp-sharded params ALSO need the dp-mean of their
+                    # shard grads — the replica synchronize supplies it
+                    p.dist_replica_axis = self.replica_axis
+                    p.dist_replica_size = self.replica_size
         return p
 
     def _finalize_entry(self, entry: CacheEntry, flat, exec_trc) -> None:
@@ -447,11 +471,32 @@ def pipeline_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "pp"
 
 def tensor_parallel(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "tp",
                     column_patterns: Sequence[str] = (), row_patterns: Sequence[str] = (),
-                    params_argnums: Sequence[int] = (0,), **jit_kwargs) -> DistributedFunction:
+                    params_argnums: Sequence[int] = (0,),
+                    data_parallel_axis: str | None = None,
+                    data_argnums: Sequence[int] | None = None, **jit_kwargs) -> DistributedFunction:
     """Megatron-style tensor parallelism (reference
     ``thunder/distributed/tensor_parallel/``): params matching
     ``column_patterns`` shard out-features (dim 0), ``row_patterns`` shard
-    in-features (dim 1); ``ops.linear`` inserts the boundary collectives."""
+    in-features (dim 1); ``ops.linear`` inserts the boundary collectives.
+
+    ``data_parallel_axis``: composes TP with data parallelism over a second
+    mesh axis (Megatron 2D, NEW capability — the reference applies TP and
+    DDP one-at-a-time): TP params shard over ``axis`` and replicate across
+    the dp axis (their shard grads all-reduce-mean over dp via the replica
+    synchronize); non-TP params replicate with dp-mean grads; the batch
+    shards over dp. ``mesh_spec`` must name both axes, e.g.
+    ``MeshSpec.make(dp=2, tp=4)``.
+    """
+    if data_parallel_axis is not None:
+        check(mesh_spec is not None and data_parallel_axis in mesh_spec.axis_names
+              and axis in mesh_spec.axis_names,
+              lambda: f"tp×dp mesh must define axes {axis!r} and {data_parallel_axis!r}")
+        return DistributedFunction(fn, mesh_spec, mode="tp_dp", axis=axis,
+                                   replica_axis=data_parallel_axis,
+                                   params_argnums=params_argnums,
+                                   column_patterns=column_patterns, row_patterns=row_patterns,
+                                   data_argnums=data_argnums,
+                                   **jit_kwargs)
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="tp", axis=axis,
                                params_argnums=params_argnums,
