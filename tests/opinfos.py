@@ -876,3 +876,55 @@ register(OpInfo("max_with_indices", ops.max_with_indices,
                 lambda rng: [SampleInput((_t(rng, 4, 5), 1))], supports_grad=False))
 register(OpInfo("div", ops.div,
                 jnp.true_divide, _binary_samples(0.5, 2), supports_grad=True))
+
+# -- batch 6: first-class norm composites ------------------------------------
+
+register(OpInfo("group_norm", ops_nn.group_norm,
+                lambda a, g, w=None, b=None, eps=1e-5: _group_norm_ref(a, g, w, b, eps),
+                lambda rng: [SampleInput((_t(rng, 2, 6, 4, 4), 3)),
+                             SampleInput((_t(rng, 2, 6, 5), 2, _t(rng, 6), _t(rng, 6)))],
+                atol=1e-4, rtol=1e-4))
+
+
+def _group_norm_ref(a, g, w, b, eps):
+    n, c = a.shape[0], a.shape[1]
+    x = a.reshape(n, g, c // g, *a.shape[2:])
+    axes = tuple(range(2, x.ndim))
+    m = x.mean(axis=axes, keepdims=True)
+    v = x.var(axis=axes, keepdims=True)
+    out = ((x - m) / jnp.sqrt(v + eps)).reshape(a.shape)
+    shape = (1, c) + (1,) * (a.ndim - 2)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    return out
+
+
+def _batch_norm_ref(a, rm=None, rv=None, w=None, b=None, training=False,
+                    momentum=0.1, eps=1e-5):
+    axes = (0,) + tuple(range(2, a.ndim))
+    if training or rm is None:
+        m, v = a.mean(axis=axes), a.var(axis=axes)
+    else:
+        m, v = rm, rv
+    shape = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+    out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + eps)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    return out
+
+
+register(OpInfo("batch_norm_eval",
+                lambda a, rm, rv, w, b: ops_nn.batch_norm(a, rm, rv, w, b, False)[0],
+                lambda a, rm, rv, w, b: _batch_norm_ref(a, rm, rv, w, b, False),
+                lambda rng: [SampleInput((_t(rng, 4, 3, 5), _t(rng, 3, lo=-0.2, hi=0.2),
+                                          _t(rng, 3, lo=0.5, hi=1.5), _t(rng, 3), _t(rng, 3)))],
+                atol=1e-4, rtol=1e-4))
+register(OpInfo("batch_norm_train",
+                lambda a: ops_nn.batch_norm(a, training=True)[0],
+                lambda a: _batch_norm_ref(a, training=True),
+                lambda rng: [SampleInput((_t(rng, 4, 3, 5),))],
+                atol=1e-4, rtol=1e-4))

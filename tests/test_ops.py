@@ -109,3 +109,29 @@ def test_getitem_multi_tensor_advanced_indexing():
                        argnums=0))(a, i, j)
     gr = jax.grad(lambda x: (x[jnp.asarray(i), jnp.asarray(j)] ** 2).sum())(jnp.asarray(a))
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-6)
+
+
+def test_batch_norm_running_stats_contract():
+    """nn.batch_norm's (out, (new_mean, new_var)) training contract: momentum
+    blend with UNBIASED variance, matching torch's running-stat update."""
+    import torch
+    import thunder_tpu as tt
+    import thunder_tpu.ops.nn as ops_nn
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(8, 3, 5).astype(np.float32)
+    rm = rng.randn(3).astype(np.float32) * 0.1
+    rv = (rng.rand(3).astype(np.float32) + 0.5)
+
+    def f(x, m, v):
+        out, (nm, nv) = ops_nn.batch_norm(x, m, v, training=True, momentum=0.2)
+        return out, nm, nv
+
+    out, nm, nv = tt.jit(f)(a, rm, rv)
+    tm = torch.tensor(rm.copy())
+    tv = torch.tensor(rv.copy())
+    ref = torch.nn.functional.batch_norm(
+        torch.tensor(a), tm, tv, training=True, momentum=0.2)
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(nm), tm.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nv), tv.numpy(), atol=1e-4)

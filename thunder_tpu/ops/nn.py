@@ -698,3 +698,55 @@ def _flce_vjp(h, w, target, *, chunk: int = 8192, ignore_index: int = -100):
                 (w, ops.convert_element_type(dw, w.dtype))]
 
     return (loss, lse), pullback
+
+
+@opsymbol(id="nn.group_norm")
+def group_norm(a, num_groups: int, weight=None, bias=None, eps: float = 1e-5):
+    """GroupNorm over (N, C, *spatial) — reference
+    ``thunder/torch/__init__.py`` group_norm; first-class nn id so executors
+    can claim a fused kernel for it."""
+    n, c = a.shape[0], a.shape[1]
+    check(c % num_groups == 0, "group_norm: channels not divisible by groups")
+    grouped = ops.reshape(a, (n, num_groups, c // num_groups) + tuple(a.shape[2:]))
+    dims = tuple(range(2, grouped.ndim))
+    var, mean = ops.var_mean(grouped, dim=dims, correction=0, keepdim=True)
+    out = ops.true_divide(ops.sub(grouped, mean), ops.sqrt(ops.add(var, eps)))
+    out = ops.reshape(out, tuple(a.shape))
+    bshape = (1, c) + (1,) * (a.ndim - 2)
+    if weight is not None:
+        out = ops.mul(out, ops.reshape(weight, bshape))
+    if bias is not None:
+        out = ops.add(out, ops.reshape(bias, bshape))
+    return out
+
+
+@opsymbol(id="nn.batch_norm")
+def batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.1, eps: float = 1e-5):
+    """Functional BatchNorm: returns ``(out, new_stats)`` where ``new_stats``
+    is ``(new_running_mean, new_running_var)`` in training mode with stats
+    provided, else None — running statistics are explicit state (no module
+    mutation; the torch dialect's F.batch_norm adapter rebinds buffer
+    wrappers from this return)."""
+    dims = (0,) + tuple(range(2, a.ndim))
+    if training or running_mean is None:
+        var, mean = ops.var_mean(a, dim=dims, correction=0, keepdim=False)
+    else:
+        mean, var = running_mean, running_var
+    bshape = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+    out = ops.true_divide(ops.sub(a, ops.reshape(mean, bshape)),
+                          ops.sqrt(ops.add(ops.reshape(var, bshape), eps)))
+    if weight is not None:
+        out = ops.mul(out, ops.reshape(weight, bshape))
+    if bias is not None:
+        out = ops.add(out, ops.reshape(bias, bshape))
+    new_stats = None
+    if training and running_mean is not None:
+        n = 1
+        for d in dims:
+            n *= a.shape[d]
+        unbiased_var = ops.mul(var, float(n) / max(n - 1, 1))
+        new_mean = ops.add(ops.mul(running_mean, 1 - momentum), ops.mul(mean, momentum))
+        new_var = ops.add(ops.mul(running_var, 1 - momentum), ops.mul(unbiased_var, momentum))
+        new_stats = (new_mean, new_var)
+    return out, new_stats

@@ -623,47 +623,16 @@ def _t_rms_norm(a, normalized_shape, weight=None, eps=None):
 
 
 def _t_group_norm(a, num_groups, weight=None, bias=None, eps=1e-5):
-    n, c = a.shape[0], a.shape[1]
-    check(c % num_groups == 0, "group_norm: channels not divisible by groups")
-    grouped = ops.reshape(a, (n, num_groups, c // num_groups) + tuple(a.shape[2:]))
-    dims = tuple(range(2, grouped.ndim))
-    var, mean = ops.var_mean(grouped, dim=dims, correction=0, keepdim=True)
-    out = ops.true_divide(ops.sub(grouped, mean), ops.sqrt(ops.add(var, eps)))
-    out = ops.reshape(out, tuple(a.shape))
-    bshape = (1, c) + (1,) * (a.ndim - 2)
-    if weight is not None:
-        out = ops.mul(out, ops.reshape(weight, bshape))
-    if bias is not None:
-        out = ops.add(out, ops.reshape(bias, bshape))
-    return out
+    return ops_nn.group_norm(a, num_groups, weight, bias, eps)
 
 
 def _t_batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
                   training=False, momentum=0.1, eps=1e-5):
-    """Composite batch_norm. Running-stat updates are returned by mutating the
-    TorchProxy wrappers (callers pass wrappers; see F.batch_norm adapter)."""
-    dims = (0,) + tuple(range(2, a.ndim))
-    if training or running_mean is None:
-        var, mean = ops.var_mean(a, dim=dims, correction=0, keepdim=False)
-    else:
-        mean, var = running_mean, running_var
-    bshape = (1, a.shape[1]) + (1,) * (a.ndim - 2)
-    out = ops.true_divide(ops.sub(a, ops.reshape(mean, bshape)),
-                          ops.sqrt(ops.add(ops.reshape(var, bshape), eps)))
-    if weight is not None:
-        out = ops.mul(out, ops.reshape(weight, bshape))
-    if bias is not None:
-        out = ops.add(out, ops.reshape(bias, bshape))
-    new_stats = None
-    if training and running_mean is not None:
-        n = 1
-        for d in dims:
-            n *= a.shape[d]
-        unbiased_var = ops.mul(var, float(n) / max(n - 1, 1))
-        new_mean = ops.add(ops.mul(running_mean, 1 - momentum), ops.mul(mean, momentum))
-        new_var = ops.add(ops.mul(running_var, 1 - momentum), ops.mul(unbiased_var, momentum))
-        new_stats = (new_mean, new_var)
-    return out, new_stats
+    """Composite batch_norm over ops_nn.batch_norm: returns (out, new_stats);
+    the F.batch_norm adapter (_f_batch_norm) rebinds the buffer wrappers from
+    new_stats so the mutation surfaces in the epilogue."""
+    return ops_nn.batch_norm(a, running_mean, running_var, weight, bias,
+                             training, momentum, eps)
 
 
 def _f_batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
