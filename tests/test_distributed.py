@@ -390,3 +390,42 @@ def test_tensor_parallel_x_data_parallel_matches_single_device(eight_devices):
                              data_parallel_axis="dp", data_argnums=(2, 3))
     l2, _, _ = jstep2(params, opt.init(params), tokens, targets)
     np.testing.assert_allclose(float(np.asarray(l2)), ref_losses[0], atol=1e-5)
+
+
+def test_fsdp_non_divisible_param_grads_averaged(eight_devices):
+    """Params whose dim-0 doesn't divide the mesh replicate as a fallback —
+    their grads MUST still all-reduce-mean or the replicas silently diverge
+    (each rank would apply only its own microbatch's grad)."""
+    from thunder_tpu.distributed import hsdp
+
+    rng = np.random.RandomState(0)
+    params = {"W": rng.randn(7, 16).astype(np.float32) * 0.3,   # 7 % 8 != 0
+              "V": rng.randn(16, 16).astype(np.float32) * 0.3}  # sharded
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randn(16, 7).astype(np.float32)
+    opt = SGD(lr=0.1)
+
+    def step(p, s, xb, yb):
+        def loss_fn(pp):
+            h = tt.ops.relu(tt.ops.matmul(xb, pp["V"]))
+            out = tt.ops.matmul(h, tt.ops.transpose(pp["W"], (1, 0)))
+            return tt.ops.mean(tt.ops.square(tt.ops.sub(out, yb)))
+
+        loss, g = tt.value_and_grad(loss_fn)(p)
+        p2, s2 = opt.update(p, g, s)
+        return loss, p2, s2
+
+    rp, rs = params, opt.init(params)
+    ref_step = tt.jit(step)
+    for _ in range(3):
+        rl, rp, rs = ref_step(rp, rs, x, y)
+
+    for mk in (lambda: fsdp(step, MeshSpec.make(fsdp=8), data_argnums=(2, 3)),
+               lambda: hsdp(step, MeshSpec.make(dp=2, fsdp=4), data_argnums=(2, 3))):
+        js = mk()
+        dp_, ds = params, opt.init(params)
+        for _ in range(3):
+            dl, dp_, ds = js(dp_, ds, x, y)
+        np.testing.assert_allclose(float(dl), float(rl), atol=1e-5)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(dp_[k]), np.asarray(rp[k]), atol=1e-5)

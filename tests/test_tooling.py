@@ -354,3 +354,52 @@ def test_examine_torch_coverage_report():
     assert any("relu" in k or "linear" in k for k in rep["supported"]), rep["supported"]
     assert any("igamma" in k for k in rep["unsupported"]), rep["unsupported"]
     assert 0.0 < rep["coverage"] < 1.0
+
+
+def test_last_hlo_and_jaxpr():
+    """Per-stage lowering dumps (SURVEY §7: per-stage HLO/jaxpr dumping is
+    the multi-host debugging essential)."""
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+
+    jf = tt.jit(lambda a, b: ops.mul(ops.add(a, b), ops.sin(a)))
+    x = np.random.rand(4, 4).astype(np.float32)
+    jf(x, x)
+    hlo = tt.last_hlo(jf)
+    assert "sine" in hlo and "module" in hlo  # StableHLO text
+    opt = tt.last_hlo(jf, optimized=True)
+    assert len(opt) > 0
+    jx = tt.last_jaxpr(jf)
+    assert len(jx.jaxpr.eqns) >= 1
+
+    # entries that cannot lower report actionable errors
+    from thunder_tpu import ops as _ops
+    ji = tt.jit(lambda a: _ops.item(_ops.sum(a)))
+    ji(np.ones(3, np.float32))
+    with pytest.raises(RuntimeError, match="whole-program"):
+        tt.last_hlo(ji)
+
+
+def test_last_hlo_distributed_shows_collectives(eight_devices):
+    import thunder_tpu as tt
+    from thunder_tpu.distributed import fsdp, MeshSpec
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import SGD
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=1)
+    opt = SGD(lr=1e-2)
+
+    def step(p, s, tok, tgt):
+        loss, g = tt.value_and_grad(lambda pp: llama.loss_fn(pp, tok, tgt, cfg))(p)
+        p2, s2 = opt.update(p, g, s)
+        return loss, p2, s2
+
+    js = fsdp(step, MeshSpec.make(fsdp=8))
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    js(params, opt.init(params), tok, np.roll(tok, -1, 1))
+    hlo = tt.last_hlo(js)
+    assert "all_gather" in hlo or "all-gather" in hlo
+    with pytest.raises(RuntimeError, match="last_hlo"):
+        tt.last_jaxpr(js)  # per-shard jaxpr is not well-formed standalone

@@ -79,11 +79,13 @@ class CompileStats:
         self.last_prologue_traces: list[TraceCtx] = []
         self.last_interpreted_ns = 0
         self.last_transform_ns = 0
+        self.last_entry = None  # most recently compiled CacheEntry (for last_hlo)
 
 
 class CacheEntry:
     __slots__ = ("computation_fn", "run_fn", "tensor_indices", "uses_rng", "traces",
-                 "prologue_trace", "prologue_fn", "out_spec", "arg_of_flat")
+                 "prologue_trace", "prologue_fn", "out_spec", "arg_of_flat",
+                 "input_avals", "jit_obj", "is_sharded")
 
     def __init__(self, computation_fn, tensor_indices, uses_rng, traces, prologue_trace,
                  prologue_fn, out_spec):
@@ -96,6 +98,9 @@ class CacheEntry:
         self.prologue_fn = prologue_fn
         self.out_spec = out_spec
         self.arg_of_flat: dict[int, int] | None = None  # flat index -> positional argnum
+        self.input_avals = None  # jax.ShapeDtypeStructs of run_fn's inputs
+        self.jit_obj = None      # the jax.jit object (lowerable), when one exists
+        self.is_sharded = False  # True for shard_map-wrapped (distributed) entries
 
 
 def _is_arraylike(x) -> bool:
@@ -301,9 +306,20 @@ class ThunderTPUFunction:
         for i, (path, _leaf) in enumerate(flat_with_paths):
             if len(path) >= 2 and getattr(path[0], "idx", None) == 0:
                 entry.arg_of_flat[i] = getattr(path[1], "idx", None)
+        import jax as _jax
+
+        if all(hasattr(flat[i], "shape") for i in tensor_indices):
+            entry.input_avals = [
+                _jax.ShapeDtypeStruct(tuple(flat[i].shape), dtypes.to_dtype(flat[i].dtype).jax)
+                for i in tensor_indices]
+            if uses_rng:
+                entry.input_avals.append(_jax.ShapeDtypeStruct((2,), _np.uint32))
+        # else (symbolic-values caching: number inputs): no avals — last_hlo
+        # reports accordingly
         self._finalize_entry(entry, flat, exec_trc)
         self._stats.last_traces = traces
         self._stats.last_prologue_traces = [prologue]
+        self._stats.last_entry = entry
         return entry
 
     # -- subclass hooks (distributed wrappers override these) ---------------
@@ -354,6 +370,7 @@ class ThunderTPUFunction:
                 j for j, fi in enumerate(entry.tensor_indices)
                 if entry.arg_of_flat.get(fi) in donate_args)
         entry.run_fn = jax.jit(entry.computation_fn, donate_argnums=donate)
+        entry.jit_obj = entry.run_fn
 
     # -- introspection ------------------------------------------------------
     @property
@@ -465,6 +482,43 @@ def cache_misses(jfn) -> int:
 
 def compile_stats(jfn) -> CompileStats:
     return _as_tfn(jfn)._stats
+
+
+def last_hlo(jfn, *, optimized: bool = False) -> str:
+    """StableHLO (or XLA-optimized HLO with ``optimized=True``) of the most
+    recently compiled entry — the per-stage dump SURVEY §7 calls out as the
+    multi-host debugging essential (the trace prints Python; this is what XLA
+    actually receives/produces)."""
+    import jax
+
+    entry = _as_tfn(jfn)._stats.last_entry
+    check(entry is not None, "no compilation has run yet")
+    check(entry.input_avals is not None,
+          "entry has no recorded input shapes (symbolic-values caching)")
+    check(entry.jit_obj is not None,
+          "entry is not whole-program-jitted (device-sync ops in the trace or "
+          "whole_program_jit=False); no HLO available")
+    lowered = entry.jit_obj.lower(*entry.input_avals)
+    if optimized:
+        return lowered.compile().as_text()
+    return lowered.as_text()
+
+
+def last_jaxpr(jfn):
+    """Closed jaxpr of the most recently compiled entry's computation.
+    Single-program entries only — a distributed entry's computation runs
+    per-shard inside shard_map (its collectives are unbound outside it);
+    use ``last_hlo`` there."""
+    import jax
+
+    entry = _as_tfn(jfn)._stats.last_entry
+    check(entry is not None, "no compilation has run yet")
+    check(entry.input_avals is not None,
+          "entry has no recorded input shapes (symbolic-values caching)")
+    check(not getattr(entry, "is_sharded", False),
+          "distributed entries run per-shard inside shard_map — the jaxpr of "
+          "the local computation is not well-formed standalone; use last_hlo")
+    return jax.make_jaxpr(entry.computation_fn)(*entry.input_avals)
 
 
 def last_compile_options(jfn) -> str:

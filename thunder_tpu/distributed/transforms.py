@@ -207,7 +207,11 @@ class DistributedFunction(ThunderTPUFunction):
                     plans.append(LeafPlan("param_shard", _P(self.axis),
                                           DistParallelType.FULLY_SHARDED, 0))
                 else:
-                    plans.append(LeafPlan("replicate", _P()))
+                    # non-divisible params replicate — WITH the REPLICATED
+                    # mark: each rank computes grads from its own microbatch,
+                    # so without the all-reduce-mean synchronize the replicas
+                    # silently diverge
+                    plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
                 continue
             if self.mode == "ep":
                 # expert-dim-sharded leaves (params AND their optimizer state)
@@ -310,8 +314,11 @@ class DistributedFunction(ThunderTPUFunction):
         if plan.mark is not DistParallelType.NONE:
             p.dist_axis = self.axis
             p.dist_size = self.size
-            if self.mode == "hsdp" and plan.mark is DistParallelType.FULLY_SHARDED \
-                    and self.replica_axis:
+            if self.mode == "hsdp" and self.replica_axis \
+                    and plan.mark in (DistParallelType.FULLY_SHARDED,
+                                      DistParallelType.REPLICATED):
+                # REPLICATED (non-divisible) params: batch shards over BOTH
+                # axes, so grads mean over the shard axis AND the replicas
                 p.dist_replica_axis = self.replica_axis
                 p.dist_replica_size = self.replica_size
             if self.mode == "tp_dp" and self.replica_axis:
@@ -368,6 +375,8 @@ class DistributedFunction(ThunderTPUFunction):
                 return jitted(*inps)
 
         entry.run_fn = run
+        entry.jit_obj = jitted  # lowerable for tt.last_hlo
+        entry.is_sharded = True
 
 
 # ---------------------------------------------------------------------------
