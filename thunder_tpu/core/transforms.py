@@ -239,7 +239,8 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
             if isinstance(leaf, TensorProxy):
                 p = TensorProxy(shape=leaf.shape, dtype=leaf.dtype, device=leaf.device,
                                 distparallel_type=leaf.distparallel_type)
-                for attr in ("dist_axis", "dist_size", "dist_replica_axis", "dist_replica_size"):
+                for attr in ("dist_axis", "dist_size", "dist_replica_axis", "dist_replica_size",
+                             "dist_shard_axis", "dist_shard_size"):
                     if hasattr(leaf, attr):
                         setattr(p, attr, getattr(leaf, attr))
                 proxies.append(p)
@@ -269,17 +270,25 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
                     passed.append(synced)
                 elif (p.distparallel_type in (DistParallelType.COLUMN_WISE,
                                               DistParallelType.ROW_WISE)
-                      and getattr(p, "dist_replica_axis", None) is not None):
-                    # TP×DP: the tp boundary comms live in ops.linear; here
-                    # only the data-parallel mean of the shard grads is
-                    # needed (identity forward, all-reduce-mean backward)
+                      and (getattr(p, "dist_replica_axis", None) is not None
+                           or getattr(p, "dist_shard_axis", None) is not None)):
                     from thunder_tpu.distributed import prims as dist_prims
 
-                    synced = dist_prims.synchronize(
-                        p, p.dist_replica_axis, DistParallelType.REPLICATED,
-                        p.dist_replica_size)
-                    # the identity sync must not strip the TP mark ops.linear
-                    # keys its boundary collectives on
+                    synced = p
+                    if getattr(p, "dist_shard_axis", None) is not None:
+                        # FSDP×TP: all-gather the dim-0 fsdp shard of the tp
+                        # slice; the VJP reduce-scatters + means the grads
+                        # over the fsdp (data) axis
+                        synced = dist_prims.synchronize(
+                            synced, p.dist_shard_axis, DistParallelType.FULLY_SHARDED,
+                            p.dist_shard_size)
+                    if getattr(p, "dist_replica_axis", None) is not None:
+                        # TP×DP: identity forward, dp-mean of shard grads back
+                        synced = dist_prims.synchronize(
+                            synced, p.dist_replica_axis, DistParallelType.REPLICATED,
+                            p.dist_replica_size)
+                    # the sync must not strip the TP mark ops.linear keys its
+                    # boundary collectives on
                     synced.distparallel_type = p.distparallel_type
                     synced.dist_axis = p.dist_axis
                     synced.dist_size = p.dist_size

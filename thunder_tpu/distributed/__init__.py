@@ -109,6 +109,7 @@ from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
     ddp,
     expert_parallel,
     fsdp,
+    fsdp_tp,
     pipeline_parallel,
     tensor_parallel,
 )

@@ -54,15 +54,19 @@ def _P(*args):
 class LeafPlan:
     """How one flat input leaf participates in the mesh."""
 
-    __slots__ = ("kind", "spec", "mark", "shard_dim", "shard_size")
+    __slots__ = ("kind", "spec", "mark", "shard_dim", "shard_size",
+                 "shard_dim2", "shard_size2")
 
     def __init__(self, kind: str, spec, mark: DistParallelType = DistParallelType.NONE,
-                 shard_dim: int | None = None, shard_size: int | None = None):
+                 shard_dim: int | None = None, shard_size: int | None = None,
+                 shard_dim2: int | None = None, shard_size2: int | None = None):
         self.kind = kind  # "param_shard" | "data_shard" | "replicate" | "column" | "row"
         self.spec = spec
         self.mark = mark
         self.shard_dim = shard_dim
         self.shard_size = shard_size  # divisor for shard_dim (defaults to the axis size)
+        self.shard_dim2 = shard_dim2  # second sharded dim (2D layouts: fsdp x tp)
+        self.shard_size2 = shard_size2
 
 
 class _Zero3Transform(Transform):
@@ -105,7 +109,7 @@ class DistributedFunction(ThunderTPUFunction):
         def wrapped(*args, **kwargs):
             out = orig_fn(*args, **kwargs)
             if self.size * self.replica_size > 1 and mode in ("fsdp", "ddp", "cp", "ep",
-                                                              "hsdp", "tp_dp"):
+                                                              "hsdp", "tp_dp", "fsdp_tp"):
                 out = tree_map(self._mean_scalar_across_replicas, out)
             return out
 
@@ -160,6 +164,49 @@ class DistributedFunction(ThunderTPUFunction):
                 plans.append(LeafPlan("const", None))
                 continue
             shape = tuple(leaf.shape)
+            if self.mode == "fsdp_tp":
+                # llama3-style 2D: TP shards the megatron dim over tp; FSDP
+                # further shards dim 0 over fsdp (self.replica_axis holds the
+                # fsdp axis, self.axis the tp axis). self.size == tp size.
+                fn_, fa = self.replica_size, self.replica_axis
+                tpn, ta = self.size, self.axis
+                if self.column_re is not None and self.column_re.search(pathstr) \
+                        and len(shape) >= 1:
+                    check(shape[0] % (tpn * fn_) == 0,
+                          lambda: f"fsdp×tp: column param {pathstr} dim 0 "
+                                  f"({shape[0]}) must divide tp*fsdp = {tpn * fn_}")
+                    # dim 0 carries both: tp-major, fsdp-minor
+                    plans.append(LeafPlan("column", _P((ta, fa)),
+                                          DistParallelType.COLUMN_WISE if in_params
+                                          else DistParallelType.NONE,
+                                          0, tpn * fn_))
+                    continue
+                if self.row_re is not None and self.row_re.search(pathstr) \
+                        and len(shape) >= 2:
+                    check(shape[1] % tpn == 0 and shape[0] % fn_ == 0,
+                          lambda: f"fsdp×tp: row param {pathstr} needs dim 1 "
+                                  f"({shape[1]}) % tp ({tpn}) == 0 and dim 0 "
+                                  f"({shape[0]}) % fsdp ({fn_}) == 0")
+                    plans.append(LeafPlan("row", _P(fa, ta),
+                                          DistParallelType.ROW_WISE if in_params
+                                          else DistParallelType.NONE,
+                                          0, fn_, 1, tpn))
+                    continue
+                if in_params:
+                    if len(shape) >= 1 and shape[0] % fn_ == 0 and shape[0] > 0:
+                        plans.append(LeafPlan("param_shard", _P(fa),
+                                              DistParallelType.FULLY_SHARDED, 0, fn_))
+                    else:
+                        plans.append(LeafPlan("ddp_param", _P(), DistParallelType.REPLICATED))
+                    continue
+                # batch data AND float non-param state (plain-FSDP optimizer
+                # moments) both shard dim 0 over fsdp — the data axis and the
+                # ZeRO state axis coincide in this mode
+                if len(shape) >= 1 and shape[0] % fn_ == 0 and shape[0] >= fn_:
+                    plans.append(LeafPlan("data_shard", _P(fa), shard_dim=0, shard_size=fn_))
+                else:
+                    plans.append(LeafPlan("replicate", _P()))
+                continue
             if self.mode in ("tp", "tp_dp"):
                 # pattern-match params AND optimizer-state leaves (state pytrees
                 # mirror the param key names, so moments shard with their param)
@@ -309,6 +356,11 @@ class DistributedFunction(ThunderTPUFunction):
             check(shape[plan.shard_dim] % divisor == 0,
                   lambda: f"dim {plan.shard_dim} of {tuple(leaf.shape)} not divisible by {divisor}")
             shape[plan.shard_dim] //= divisor
+        if plan.shard_dim2 is not None:
+            check(shape[plan.shard_dim2] % plan.shard_size2 == 0,
+                  lambda: f"dim {plan.shard_dim2} of {tuple(leaf.shape)} not divisible "
+                          f"by {plan.shard_size2}")
+            shape[plan.shard_dim2] //= plan.shard_size2
         p = TensorProxy(shape=tuple(shape), dtype=dtypes.to_dtype(leaf.dtype),
                         distparallel_type=plan.mark)
         if plan.mark is not DistParallelType.NONE:
@@ -332,6 +384,17 @@ class DistributedFunction(ThunderTPUFunction):
                     # shard grads — the replica synchronize supplies it
                     p.dist_replica_axis = self.replica_axis
                     p.dist_replica_size = self.replica_size
+            if self.mode == "fsdp_tp" and self.replica_axis:
+                if plan.mark in (DistParallelType.FULLY_SHARDED,
+                                 DistParallelType.REPLICATED):
+                    # plain-FSDP / replicated params live on the fsdp axis
+                    p.dist_axis = self.replica_axis
+                    p.dist_size = self.replica_size
+                elif plan.mark in (DistParallelType.COLUMN_WISE, DistParallelType.ROW_WISE):
+                    # tp marks stay on the tp axis; the fsdp gather of the
+                    # dim-0 shard happens via dist_shard_axis
+                    p.dist_shard_axis = self.replica_axis
+                    p.dist_shard_size = self.replica_size
         return p
 
     def _finalize_entry(self, entry: CacheEntry, flat, exec_trc) -> None:
@@ -342,16 +405,30 @@ class DistributedFunction(ThunderTPUFunction):
             in_specs.append(_P())
 
         sharded_local_shapes: dict[tuple, Any] = {}
+        ambiguous: set[tuple] = set()
         for i in entry.tensor_indices:
             plan = self._plan[i]
             if plan.shard_dim is not None:
                 shape = list(flat[i].shape)
                 shape[plan.shard_dim] //= (plan.shard_size or self.size)
-                sharded_local_shapes[tuple(shape)] = plan.spec
+                if plan.shard_dim2 is not None:
+                    shape[plan.shard_dim2] //= plan.shard_size2
+                key = tuple(shape)
+                prev = sharded_local_shapes.get(key)
+                if prev is not None and prev != plan.spec:
+                    # two spec families share a local shape — shape-based
+                    # out-spec inference would silently pick one; refuse
+                    ambiguous.add(key)
+                sharded_local_shapes[key] = plan.spec
 
         def out_spec_for(leaf):
             if isinstance(leaf, TensorProxy):
                 if leaf.shape in sharded_local_shapes:
+                    check(leaf.shape not in ambiguous,
+                          lambda: f"output local shape {leaf.shape} is produced by "
+                                  "two different sharding layouts — out-spec inference "
+                                  "is ambiguous; make the global shapes distinct (e.g. "
+                                  "different widths) or replicate one of the params")
                     return sharded_local_shapes[leaf.shape]
                 return _P()
             return _P()
@@ -407,6 +484,37 @@ def fsdp(fn, mesh_spec: MeshSpec | None = None, *, axis: str = "fsdp",
     mesh_spec = mesh_spec or _default_mesh_spec(axis)
     return DistributedFunction(fn, mesh_spec, mode="fsdp", axis=axis,
                                params_argnums=params_argnums, zero=zero, **jit_kwargs)
+
+
+def fsdp_tp(fn, mesh_spec: MeshSpec, *, axis: str = "fsdp", tp_axis: str = "tp",
+            column_patterns: Sequence[str] = (), row_patterns: Sequence[str] = (),
+            params_argnums: Sequence[int] = (0,),
+            data_argnums: Sequence[int] | None = None, **jit_kwargs) -> DistributedFunction:
+    """FSDP×TP 2D sharding on one mesh (llama3-style; NEW capability — the
+    reference applies FSDP and TP one-at-a-time):
+
+    - ``column_patterns`` params: dim 0 sharded tp-major/fsdp-minor over
+      BOTH axes; the forward all-gathers the fsdp shard (dim 0) leaving the
+      tp slice, whose boundary collectives ``ops.linear`` inserts as usual.
+    - ``row_patterns`` params: dim 1 over tp, dim 0 over fsdp (gathered in
+      the forward).
+    - other params: plain FSDP over ``axis`` (REPLICATED fallback when dim 0
+      doesn't divide).
+    - batch shards over ``axis`` — fsdp IS the data axis; grads of every
+      param kind are fsdp-mean (reduce-scatter for shards, all-reduce for
+      replicated).
+    """
+    check(axis in mesh_spec.axis_names and tp_axis in mesh_spec.axis_names,
+          lambda: f"fsdp×tp mesh must define axes {axis!r} and {tp_axis!r}; "
+                  f"got {mesh_spec.axis_names}")
+    check(jit_kwargs.get("zero", 2) == 2,
+          "fsdp_tp supports zero=2 semantics (ZeRO-3 regather over the 2D "
+          "layout is not implemented)")
+    return DistributedFunction(fn, mesh_spec, mode="fsdp_tp", axis=tp_axis,
+                               replica_axis=axis,
+                               params_argnums=params_argnums,
+                               column_patterns=column_patterns, row_patterns=row_patterns,
+                               data_argnums=data_argnums, **jit_kwargs)
 
 
 def hsdp(fn, mesh_spec: MeshSpec, *, axis: str = "fsdp", replica_axis: str = "dp",
