@@ -458,3 +458,42 @@ def test_fsdp_x_tensor_parallel_matches_single_device(eight_devices):
     # the trace composes both comm families: fsdp gathers + tp boundary syncs
     src = tt.last_traces(js)[0].python()
     assert "synchronize_tp" in src and "synchronize(" in src
+
+
+def test_fsdp_grad_accumulation_matches_combined_batch(eight_devices):
+    """The reference's no_sync enables grad accumulation without per-step
+    sync; here accumulation is functional — two microbatch grad evaluations
+    averaged INSIDE one compiled fsdp step equal the combined-batch step
+    (psum is linear, so XLA sees sum-of-psums == psum-of-sums)."""
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=3, scale_layers=1)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 16, 8, seed=3)
+
+    def accum_step(p, s, tok, tgt):
+        # NOTE: tok/tgt are the LOCAL shards here (batch 16 / 8 ranks = 2
+        # rows); microbatches slice the local batch
+        half = tok.shape[0] // 2
+
+        def loss_fn_mb(pp, t_, g_):
+            return llama.loss_fn(pp, t_, g_, cfg)
+
+        l1, g1 = tt.value_and_grad(lambda pp: loss_fn_mb(pp, tok[:half], tgt[:half]))(p)
+        l2, g2 = tt.value_and_grad(lambda pp: loss_fn_mb(pp, tok[half:], tgt[half:]))(p)
+        g = jax.tree_util.tree_map(lambda a, b: tt.ops.mul(tt.ops.add(a, b), 0.5), g1, g2)
+        loss = tt.ops.mul(tt.ops.add(l1, l2), 0.5)
+        p2, s2 = opt.update(p, g, s)
+        return loss, p2, s2
+
+    def full_step(p, s, tok, tgt):
+        loss, g = tt.value_and_grad(lambda pp: llama.loss_fn(pp, tok, tgt, cfg))(p)
+        p2, s2 = opt.update(p, g, s)
+        return loss, p2, s2
+
+    ja = fsdp(accum_step, MeshSpec.make(fsdp=8), data_argnums=(2, 3))
+    jf = fsdp(full_step, MeshSpec.make(fsdp=8), data_argnums=(2, 3))
+    la, pa, _ = ja(params, opt.init(params), tokens, targets)
+    lf, pf, _ = jf(params, opt.init(params), tokens, targets)
+    np.testing.assert_allclose(float(la), float(lf), atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

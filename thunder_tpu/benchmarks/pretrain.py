@@ -20,9 +20,13 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="tiny", help="llama config name")
     p.add_argument("--mode", default="single",
-                   choices=["single", "fsdp", "hsdp", "ddp", "tp", "cp"])
+                   choices=["single", "fsdp", "hsdp", "ddp", "tp", "cp",
+                            "tp_dp", "fsdp_tp"])
     p.add_argument("--replicas", type=int, default=2,
                    help="hsdp: replica-axis size (shard axis gets the rest)")
+    p.add_argument("--tp", type=int, default=2,
+                   help="tp_dp/fsdp_tp: tensor-parallel axis size "
+                        "(the other axis gets the rest)")
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--seq", type=int, default=256)
     p.add_argument("--layers", type=int, default=None)
@@ -73,7 +77,7 @@ def main():
     elif args.mode == "hsdp":
         from thunder_tpu.distributed import hsdp
 
-        if n_dev % args.replicas or n_dev // args.replicas < 1:
+        if args.replicas < 1 or n_dev % args.replicas:
             raise SystemExit(f"--replicas {args.replicas} must divide the "
                              f"device count {n_dev} (and leave a shard axis)")
         jstep = hsdp(train_step,
@@ -94,6 +98,24 @@ def main():
         jstep = tensor_parallel(train_step, MeshSpec.make(tp=n_dev),
                                 column_patterns=llama.TP_COLUMN_PATTERNS,
                                 row_patterns=llama.TP_ROW_PATTERNS)
+    elif args.mode in ("tp_dp", "fsdp_tp"):
+        if args.tp < 1 or n_dev % args.tp:
+            raise SystemExit(f"--tp {args.tp} must divide the device count {n_dev}")
+        other = n_dev // args.tp
+        cfg = llama.tp_config(cfg, args.tp)
+        if args.mode == "tp_dp":
+            from thunder_tpu.distributed import tensor_parallel
+
+            jstep = tensor_parallel(train_step, MeshSpec.make(dp=other, tp=args.tp),
+                                    column_patterns=llama.TP_COLUMN_PATTERNS,
+                                    row_patterns=llama.TP_ROW_PATTERNS,
+                                    data_parallel_axis="dp")
+        else:
+            from thunder_tpu.distributed import fsdp_tp
+
+            jstep = fsdp_tp(train_step, MeshSpec.make(fsdp=other, tp=args.tp),
+                            column_patterns=llama.TP_COLUMN_PATTERNS,
+                            row_patterns=llama.TP_ROW_PATTERNS)
 
     params = llama.init_params(llama.CONFIGS[args.model], seed=0, scale_layers=n_layers)
     opt_state = opt.init(params)
