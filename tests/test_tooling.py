@@ -403,3 +403,22 @@ def test_last_hlo_distributed_shows_collectives(eight_devices):
     assert "all_gather" in hlo or "all-gather" in hlo
     with pytest.raises(RuntimeError, match="last_hlo"):
         tt.last_jaxpr(js)  # per-shard jaxpr is not well-formed standalone
+
+
+def test_compilation_cache_persists(tmp_path):
+    """tt.enable_compilation_cache writes XLA executables to disk (the
+    ENABLE_NVFUSER_SERIALIZATION analog; kills the 20-40s TPU first-compile
+    on warm starts)."""
+    import os
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+
+    cache = tmp_path / "xla-cache"
+    tt.enable_compilation_cache(str(cache), min_compile_secs=0.0)
+    try:
+        jf = tt.jit(lambda a: tt.ops.sum(ops.matmul(a, a)))
+        jf(np.random.rand(256, 256).astype(np.float32))
+        assert len(os.listdir(cache)) >= 1
+    finally:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)

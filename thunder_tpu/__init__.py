@@ -17,6 +17,7 @@ bytecode interpreter (JAX-style duck tracing).
 
 from __future__ import annotations
 
+import os as _os
 import time
 from numbers import Number
 from typing import Any, Callable, Sequence
@@ -50,6 +51,33 @@ _CACHE_OPTIONS = ("constant values", "symbolic values", "no caching")
 # ---------------------------------------------------------------------------
 
 _rng_state: dict[str, Any] = {"key": None}
+
+
+def enable_compilation_cache(directory: str, *, min_compile_secs: float = 1.0) -> None:
+    """Persist XLA executables across processes (the reference's analog is
+    nvFuser's ``ENABLE_NVFUSER_SERIALIZATION``; on TPU first-compiles run
+    20-40s, so a warm on-disk cache removes them entirely). Honored
+    automatically when ``THUNDER_TPU_COMPILATION_CACHE`` is set in the
+    environment (read at import)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(directory))
+    for opt in ("jax_persistent_cache_min_compile_time_secs",
+                "jax_compilation_cache_min_compile_time_secs"):  # older spelling
+        try:
+            jax.config.update(opt, float(min_compile_secs))
+            break
+        except AttributeError:
+            continue
+    else:
+        import warnings
+
+        warnings.warn("could not set the persistent-cache compile-time threshold; "
+                      "jax's default (1s) applies — sub-second compiles won't persist")
+
+
+if _os.environ.get("THUNDER_TPU_COMPILATION_CACHE"):
+    enable_compilation_cache(_os.environ["THUNDER_TPU_COMPILATION_CACHE"])
 
 
 def manual_seed(seed: int) -> None:
