@@ -324,3 +324,56 @@ def test_dynamic_shape_ops_raise_clearly():
 
     with pytest.raises(NotImplementedError, match="data-dependent shape"):
         ttorch.jit(lambda a: torch.masked_select(a, a > 0.5))(x)
+
+
+def test_torch_multihead_attention_and_transformer_encoder():
+    """Unmodified torch.nn.MultiheadAttention / TransformerEncoder jit
+    through the dialect (F.multi_head_attention_forward composite)."""
+    torch.manual_seed(0)
+    x = torch.randn(2, 10, 32)
+
+    m2 = nn.MultiheadAttention(32, 4, batch_first=True)
+    m2.eval()
+    got, w = ttorch.jit(lambda q: m2(q, q, q))(x)
+    ref, rw = m2(x, x, x)
+    np.testing.assert_allclose(np.asarray(got), ref.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(w), rw.detach().numpy(), atol=1e-5)
+
+    # causal attn_mask + key_padding_mask (torch bool semantics: True=mask out)
+    kpm = torch.zeros(2, 10, dtype=torch.bool)
+    kpm[:, -2:] = True
+    am = torch.triu(torch.ones(10, 10, dtype=torch.bool), diagonal=1)
+    got3, _ = ttorch.jit(lambda q: m2(q, q, q, key_padding_mask=kpm, attn_mask=am))(x)
+    ref3, _ = m2(x, x, x, key_padding_mask=kpm, attn_mask=am)
+    np.testing.assert_allclose(np.asarray(got3), ref3.detach().numpy(), atol=1e-5)
+
+    layer = nn.TransformerEncoderLayer(d_model=32, nhead=4, dim_feedforward=64,
+                                       batch_first=True, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    enc.eval()
+    got4 = ttorch.jit(enc)(x)
+    np.testing.assert_allclose(np.asarray(got4), enc(x).detach().numpy(), atol=1e-5)
+
+
+def test_torch_transformer_encoder_trains():
+    """Grad parity + compiled training step for a torch TransformerEncoderLayer."""
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+
+    torch.manual_seed(1)
+    m = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32,
+                                   batch_first=True, dropout=0.0)
+    m.eval()
+    x = torch.randn(4, 6, 16)
+    params = {k: ttorch.tensor_to_jax(v) for k, v in m.named_parameters()}
+
+    def loss_fn(p):
+        out, _ = ttorch.functional_call(m, p, (x,))
+        return ops.mean(ops.square(out))
+
+    _, g = tt.jit(tt.value_and_grad(loss_fn))(params)
+    m.zero_grad()
+    (m(x) ** 2).mean().backward()
+    for name, pt in m.named_parameters():
+        np.testing.assert_allclose(np.asarray(g[name]), pt.grad.numpy(),
+                                   atol=1e-5, rtol=1e-4)

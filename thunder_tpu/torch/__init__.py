@@ -1464,6 +1464,82 @@ def _t_max_pool2d(a, kernel_size, stride=None, padding=0, dilation=1,
     return ops_nn.max_pool2d(a, kernel_size, stride, padding)
 
 
+def _t_multi_head_attention_forward(
+        query, key, value, embed_dim_to_check, num_heads, in_proj_weight, in_proj_bias,
+        bias_k, bias_v, add_zero_attn, dropout_p, out_proj_weight, out_proj_bias,
+        training=True, key_padding_mask=None, need_weights=True, attn_mask=None,
+        use_separate_proj_weight=False, q_proj_weight=None, k_proj_weight=None,
+        v_proj_weight=None, static_k=None, static_v=None, average_attn_weights=True,
+        is_causal=False):
+    """F.multi_head_attention_forward composite — what nn.MultiheadAttention
+    and nn.TransformerEncoder/DecoderLayer lower to. Inputs arrive
+    (seq, batch, embed) (torch transposes batch_first before this call)."""
+    import math as _math
+
+    check(bias_k is None and bias_v is None and not add_zero_attn,
+          "multi_head_attention: bias_k/bias_v/add_zero_attn unsupported")
+    check(static_k is None and static_v is None,
+          "multi_head_attention: static_k/static_v unsupported")
+    check(not training or dropout_p == 0.0,
+          "multi_head_attention: attention dropout unsupported (set dropout=0)")
+    L, N, E = query.shape
+    S = key.shape[0]
+    H = int(num_heads)
+    hd = E // H
+    check(E == embed_dim_to_check and E % H == 0, "multi_head_attention: bad embed dim")
+
+    if use_separate_proj_weight:
+        wq, wk, wv = q_proj_weight, k_proj_weight, v_proj_weight
+    else:
+        wq = ops.getitem(in_proj_weight, slice(0, E))
+        wk = ops.getitem(in_proj_weight, slice(E, 2 * E))
+        wv = ops.getitem(in_proj_weight, slice(2 * E, 3 * E))
+    bq = bk = bv = None
+    if in_proj_bias is not None:
+        bq = ops.getitem(in_proj_bias, slice(0, E))
+        bk = ops.getitem(in_proj_bias, slice(E, 2 * E))
+        bv = ops.getitem(in_proj_bias, slice(2 * E, 3 * E))
+
+    def heads(x, w, b, seq):
+        p = ops.linear(x, w, b)                       # (seq, N, E)
+        p = ops.reshape(p, (seq, N, H, hd))
+        return ops.transpose(p, (1, 2, 0, 3))          # (N, H, seq, hd)
+
+    q = heads(query, wq, bq, L)
+    k = heads(key, wk, bk, S)
+    v = heads(value, wv, bv, S)
+    scores = ops.mul(ops.matmul(q, ops.transpose(k, (0, 1, 3, 2))),
+                     1.0 / _math.sqrt(hd))             # (N, H, L, S)
+    neg = ops.full_like(scores, -float("inf"))
+    if is_causal:
+        causal = ops.tril_mask(L, S, 0)
+        scores = ops.where(ops.expand_to(causal, scores.shape), scores, neg)
+    if attn_mask is not None:
+        from thunder_tpu.core import dtypes as _dt
+
+        if attn_mask.dtype is _dt.bool8:
+            # torch: True = masked OUT
+            mask = ops.reshape(attn_mask, (1, 1, L, S)) if attn_mask.ndim == 2 \
+                else ops.reshape(attn_mask, (N, H, L, S))
+            scores = ops.where(ops.expand_to(mask, scores.shape), neg, scores)
+        else:
+            mask = ops.reshape(attn_mask, (1, 1, L, S)) if attn_mask.ndim == 2 \
+                else ops.reshape(attn_mask, (N, H, L, S))
+            scores = ops.add(scores, mask)
+    if key_padding_mask is not None:
+        # (N, S) bool, True = ignore this key
+        kpm = ops.reshape(key_padding_mask, (N, 1, 1, S))
+        scores = ops.where(ops.expand_to(kpm, scores.shape), neg, scores)
+    probs = ops.softmax(scores, -1)
+    out = ops.matmul(probs, v)                         # (N, H, L, hd)
+    out = ops.reshape(ops.transpose(out, (2, 0, 1, 3)), (L, N, E))
+    out = ops.linear(out, out_proj_weight, out_proj_bias)
+    if not need_weights:
+        return out, None
+    w = ops.mean(probs, dim=1) if average_attn_weights else probs
+    return out, w
+
+
 def _t_masked_select(a, mask, *, out=None):
     raise NotImplementedError(
         "masked_select produces a data-dependent shape, which XLA cannot compile; "
@@ -1614,6 +1690,7 @@ for _tf, _fn in {
     F.instance_norm: _t_instance_norm,
     F.pixel_shuffle: (lambda a, r: ops_nn.pixel_shuffle(a, r)),
     F.interpolate: _t_interpolate,
+    F.multi_head_attention_forward: _t_multi_head_attention_forward,
 }.items():
     _torch_to_thunder_function_map[_tf] = _fn
 
