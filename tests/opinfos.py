@@ -656,11 +656,9 @@ register(OpInfo("lerp", ops.lerp,
                 lambda a, b, w: a + w * (b - a),
                 lambda rng: [SampleInput((_t(rng, 4, 4), _t(rng, 4, 4), 0.3))]))
 register(OpInfo("lgamma", ops.lgamma, jax.scipy.special.gammaln,
-                lambda rng: [SampleInput((_t(rng, 4, lo=0.5, hi=4.0),))], atol=1e-4,
-                supports_grad=False))
+                lambda rng: [SampleInput((_t(rng, 4, lo=0.5, hi=4.0),))], atol=1e-4))
 register(OpInfo("erfinv", ops.erfinv, jax.scipy.special.erfinv,
-                lambda rng: [SampleInput((_t(rng, 4, lo=-0.9, hi=0.9),))], atol=1e-4,
-                supports_grad=False))
+                lambda rng: [SampleInput((_t(rng, 4, lo=-0.9, hi=0.9),))], atol=1e-4))
 register(OpInfo("masked_fill", ops.masked_fill,
                 lambda a, m, v: jnp.where(m, v, a),
                 lambda rng: [SampleInput((_t(rng, 4, 4), _t(rng, 4, 4) > 0, 1.5))]))

@@ -17,9 +17,18 @@ import thunder_tpu
 import thunder_tpu.torch as ttorch
 
 
-def assert_close(jax_val, torch_val, rtol=1e-4, atol=1e-5):
+def _np(x):
+    """Numpy view of either a jax array or a (possibly autograd-tracked)
+    torch tensor — module calls return torch tensors via the autograd
+    bridge, function calls return jax arrays."""
+    if isinstance(x, torch.Tensor):
+        return x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def assert_close(got, torch_val, rtol=1e-4, atol=1e-5):
     np.testing.assert_allclose(
-        np.asarray(jax_val), torch_val.detach().cpu().numpy(), rtol=rtol, atol=atol)
+        _np(got), torch_val.detach().cpu().numpy(), rtol=rtol, atol=atol)
 
 
 # ---------------------------------------------------------------------------
@@ -166,21 +175,28 @@ def test_module_embedding_tied_head():
 
 
 def test_module_batchnorm_running_stats_epilogue():
+    import copy
+
     m = nn.BatchNorm1d(6)
     m.train()
+    m_ref = copy.deepcopy(m)
     tm = ttorch.jit(m)
     x = torch.randn(8, 6)
-    out = tm(x)
-    ref = m(x)  # torch mutates running stats in-place
+    out = tm(x)   # bridge path: running stats written back into the live module
+    ref = m_ref(x)
     assert_close(out, ref, rtol=1e-4, atol=1e-5)
-    # buffer write-back (epilogue): running stats updated in the jax state
-    assert_close(tm._buffers["running_mean"], m.running_mean, rtol=1e-4, atol=1e-5)
-    assert_close(tm._buffers["running_var"], m.running_var, rtol=1e-4, atol=1e-5)
+    assert_close(m.running_mean, m_ref.running_mean, rtol=1e-4, atol=1e-5)
+    assert_close(m.running_var, m_ref.running_var, rtol=1e-4, atol=1e-5)
     # second call keeps accumulating
     x2 = torch.randn(8, 6)
     tm(x2)
-    m(x2)
-    assert_close(tm._buffers["running_mean"], m.running_mean, rtol=1e-4, atol=1e-5)
+    m_ref(x2)
+    assert_close(m.running_mean, m_ref.running_mean, rtol=1e-4, atol=1e-5)
+    # the pure-jax path (no_grad) also maintains its own buffer state
+    with torch.no_grad():
+        tm(x2)
+        m_ref(x2)
+    assert_close(tm._buffers["running_mean"], m_ref.running_mean, rtol=1e-4, atol=1e-5)
 
 
 def test_module_train_eval_recompiles():
@@ -193,8 +209,9 @@ def test_module_train_eval_recompiles():
     tm.train()
     thunder_tpu.manual_seed(0)
     out_train = tm(x)  # different compiled entry (dropout active)
-    assert tm._jfn.cache_misses == 2
-    assert not np.allclose(np.asarray(out_train), np.asarray(out_eval))
+    # bridge path: one compiled fwd/bwd pair per training mode
+    assert len(tm._autograd_cache) == 2
+    assert not np.allclose(_np(out_train), _np(out_eval))
 
 
 def test_module_inplace_functionalization():
@@ -352,7 +369,7 @@ def test_torch_multihead_attention_and_transformer_encoder():
     enc = nn.TransformerEncoder(layer, num_layers=2)
     enc.eval()
     got4 = ttorch.jit(enc)(x)
-    np.testing.assert_allclose(np.asarray(got4), enc(x).detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(_np(got4), enc(x).detach().numpy(), atol=1e-5)
 
 
 def test_torch_transformer_encoder_trains():
@@ -377,3 +394,150 @@ def test_torch_transformer_encoder_trains():
     for name, pt in m.named_parameters():
         np.testing.assert_allclose(np.asarray(g[name]), pt.grad.numpy(),
                                    atol=1e-5, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# torch-autograd bridge (VERDICT r1 item 2)
+# ---------------------------------------------------------------------------
+
+def test_unmodified_torch_training_loop_parity():
+    """The reference's defining UX: thunder.jit(model) + loss.backward() +
+    a stock torch optimizer — to parity with eager torch (reference
+    ``thunder/executors/torch_autograd.py:62-109``)."""
+    import copy
+
+    torch.manual_seed(0)
+    m = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+    m_ref = copy.deepcopy(m)
+    tm = thunder_tpu.jit(m)
+    opt = torch.optim.AdamW(m.parameters(), lr=1e-2)
+    opt_ref = torch.optim.AdamW(m_ref.parameters(), lr=1e-2)
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        x = torch.tensor(rng.randn(16, 8).astype(np.float32))
+        y = torch.tensor(rng.randn(16, 4).astype(np.float32))
+        out = tm(x)
+        assert isinstance(out, torch.Tensor) and out.grad_fn is not None
+        loss = F.mse_loss(out, y)
+        opt.zero_grad(); loss.backward(); opt.step()
+        loss_ref = F.mse_loss(m_ref(x), y)
+        opt_ref.zero_grad(); loss_ref.backward(); opt_ref.step()
+        np.testing.assert_allclose(float(loss.detach()), float(loss_ref.detach()),
+                                   rtol=1e-4, atol=1e-6)
+    for p, pr in zip(m.parameters(), m_ref.parameters()):
+        np.testing.assert_allclose(p.detach().numpy(), pr.detach().numpy(),
+                                   rtol=1e-3, atol=1e-5)
+    # fwd/bwd were compiled once and reused across steps
+    assert len(tm._autograd_cache) == 1
+
+
+def test_bridge_grad_accumulation_matches_eager():
+    """Microbatch grad accumulation (multiple backward() calls before step)
+    — real accumulation into Parameter.grad, the no_sync use case."""
+    import copy
+
+    torch.manual_seed(3)
+    m = nn.Linear(6, 3)
+    m_ref = copy.deepcopy(m)
+    tm = thunder_tpu.jit(m)
+    rng = np.random.RandomState(2)
+    with tm.no_sync():
+        for _ in range(3):
+            x = torch.tensor(rng.randn(4, 6).astype(np.float32))
+            y = torch.tensor(rng.randn(4, 3).astype(np.float32))
+            F.mse_loss(tm(x), y).backward()
+            F.mse_loss(m_ref(x), y).backward()
+    for p, pr in zip(m.parameters(), m_ref.parameters()):
+        np.testing.assert_allclose(p.grad.numpy(), pr.grad.numpy(),
+                                   rtol=1e-3, atol=1e-6)
+
+
+def test_bridge_input_grads_and_double_backward_error():
+    """Grads flow to requires-grad inputs; re-backward raises the
+    reference's memory-careful clearing error."""
+    import pytest as _pytest
+
+    torch.manual_seed(1)
+    m = nn.Linear(5, 5).eval()
+    tm = thunder_tpu.jit(m)
+    x = torch.randn(3, 5, requires_grad=True)
+    x_ref = x.detach().clone().requires_grad_(True)
+    out = tm(x)
+    loss = out.pow(2).sum()
+    loss.backward()
+    loss_ref = m(x_ref).pow(2).sum()
+    loss_ref.backward()
+    np.testing.assert_allclose(x.grad.numpy(), x_ref.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+    # re-backward raises (torch's standard freed-graph error, or the bridge's
+    # own memory-careful clearing error if torch's graph was retained)
+    with _pytest.raises(RuntimeError, match="backward through the (same )?graph a? ?second"
+                                            "|backward through the same graph twice"):
+        loss.backward()
+
+
+def test_bridge_trains_transformer_encoder_with_dropout():
+    """Round-1 failure mode, through the full bridge: a torch
+    TransformerEncoderLayer WITH active dropout trains via loss.backward()."""
+    torch.manual_seed(2)
+    m = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32,
+                                   batch_first=True, dropout=0.3)
+    m.train()
+    tm = thunder_tpu.jit(m)
+    opt = torch.optim.SGD(m.parameters(), lr=1e-2)
+    x = torch.randn(4, 6, 16)
+    thunder_tpu.manual_seed(7)
+    losses = []
+    for _ in range(3):
+        loss = tm(x).pow(2).mean()
+        opt.zero_grad(); loss.backward(); opt.step()
+        losses.append(float(loss.detach()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # descending on a fixed batch
+
+
+def test_bridge_then_jax_path_buffer_coherence():
+    """Code-review r2: after bridge use, consecutive no_grad (jax-path)
+    training-mode calls must keep accumulating running stats — the torch
+    module and the jax snapshot stay in lockstep."""
+    import copy
+
+    m = nn.BatchNorm1d(4)
+    m.train()
+    m_ref = copy.deepcopy(m)
+    tm = ttorch.jit(m)
+    xs = [torch.randn(8, 4) for _ in range(3)]
+    tm(xs[0])          # bridge path
+    m_ref(xs[0])
+    with torch.no_grad():
+        tm(xs[1])      # jax path #1
+        tm(xs[2])      # jax path #2 — must see #1's stat update
+        m_ref(xs[1]); m_ref(xs[2])
+    assert_close(m.running_mean, m_ref.running_mean, rtol=1e-4, atol=1e-5)
+    assert_close(m.running_var, m_ref.running_var, rtol=1e-4, atol=1e-5)
+
+
+def test_bridge_duplicate_output_cotangents_accumulate():
+    """Code-review r2: a module returning the same tensor twice must
+    accumulate both cotangents (a+b), not overwrite (b)."""
+    class Dup(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return h, h
+
+    m = Dup()
+    m_ref = type(m)()
+    m_ref.load_state_dict(m.state_dict())
+    tm = thunder_tpu.jit(m)
+    x = torch.randn(3, 4)
+    y1, y2 = tm(x)
+    (2.0 * y1.sum() + 3.0 * y2.sum()).backward()
+    r1, r2 = m_ref(x)
+    (2.0 * r1.sum() + 3.0 * r2.sum()).backward()
+    for p, pr in zip(m.parameters(), m_ref.parameters()):
+        np.testing.assert_allclose(p.grad.numpy(), pr.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)

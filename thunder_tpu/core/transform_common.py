@@ -121,8 +121,13 @@ def visitor_transform(trc: TraceCtx, visit, *, provenance: str | None = None) ->
                 # rebind downstream consumers of the replaced bsym's outputs
                 # to the last emitted op's outputs (positional pairing)
                 if scope:
-                    for old, repl in zip(bsym.flat_proxy_outs(),
-                                         scope[-1].flat_proxy_outs()):
+                    old_outs = bsym.flat_proxy_outs()
+                    repl_outs = scope[-1].flat_proxy_outs()
+                    check(len(old_outs) == len(repl_outs),
+                          lambda: f"visitor REPLACE: replaced op has {len(old_outs)} proxy "
+                                  f"outputs but the last emitted op has {len(repl_outs)}; "
+                                  "emit a final op producing all replacement outputs")
+                    for old, repl in zip(old_outs, repl_outs):
                         if old is not repl:
                             swap[Variable(old)] = repl
             elif vt is VisitType.INSERT_BEFORE:

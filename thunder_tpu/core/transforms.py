@@ -107,7 +107,7 @@ _NONDIFF = {
     PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE, PrimIDs.UNPACK_TRIVIAL,
     PrimIDs.PYTHON_PRINT, PrimIDs.COMMENT, PrimIDs.SINK, PrimIDs.DEVICE_PUT,
     PrimIDs.SHARDING_CONSTRAINT, PrimIDs.SORT,
-    PrimIDs.ZETA, PrimIDs.NEXTAFTER,
+    PrimIDs.NEXTAFTER,
 }
 
 
@@ -175,7 +175,17 @@ def augmented_forward(bsyms: Sequence[BoundSymbol], env: dict) -> list[PullbackR
                 if isinstance(o, Proxy) and Variable(o) not in env:
                     env[Variable(o)] = o  # produced literally by subsymbol replay
         else:
-            if sym_id not in _NONDIFF and any(_is_float_tensor(o) for o in bsym.flat_proxy_outs()) \
+            # pass-through composite (e.g. eval-mode dropout, p=0 dropout):
+            # every output proxy aliases an input proxy and there is no
+            # decomposition to recurse into. Grads flow through the shared
+            # Variable; just bind the mapped values. (ADVICE r1: subsymbol-less
+            # alias bsyms must not raise.)
+            arg_vars = {Variable(a) for a in bsym.flat_proxy_args()}
+            out_proxies = bsym.flat_proxy_outs()
+            if out_proxies and all(Variable(o) in arg_vars for o in out_proxies):
+                _bind_outputs(env, bsym.output, _env_map(env, bsym.output))
+                continue
+            if sym_id not in _NONDIFF and any(_is_float_tensor(o) for o in out_proxies) \
                     and any(_is_float_tensor(a) for a in bsym.flat_proxy_args()):
                 raise NotImplementedError(f"no VJP rule for prim {bsym.sym.name} (id={sym_id})")
             out = bsym.sym(*margs, **mkwargs)
@@ -194,6 +204,11 @@ def backward_pass(records: list[PullbackRecord], grads: dict[Variable, Any]) -> 
             return
         if not p.dtype.is_inexact:
             return
+        # grads carry the primal's dtype (torch convention): implicit type
+        # promotion inside mixed-dtype prims (bf16 × f32) must round-trip,
+        # or every bf16 param would get an f32 grad
+        if isinstance(g, TensorProxy) and g.dtype != p.dtype:
+            g = ops.convert_element_type(g, p.dtype)
         v = Variable(p)
         if v in grads:
             grads[v] = ops.add(grads[v], g)
@@ -385,7 +400,10 @@ def forward_and_backward_from_trace(trc: TraceCtx) -> tuple[TraceCtx, TraceCtx, 
                       for i, o in enumerate(out_flat)]
         grads: dict[Variable, Any] = {}
         for o, ct in zip(out_flat, cotangents):
-            grads[Variable(o)] = ct
+            v = Variable(o)
+            # the same proxy may appear in several output slots (return h, h):
+            # cotangents accumulate, they don't overwrite
+            grads[v] = ops.add(grads[v], ct) if v in grads else ct
         backward_pass(records, grads)
         input_grads = tuple(
             grads.get(Variable(p)) if isinstance(p, TensorProxy) else None for p in trc.args
@@ -486,6 +504,36 @@ _register_unary(PrimIDs.DIGAMMA, prims.digamma,
 _register_unary(PrimIDs.NDTRI, prims.ndtri,
                 lambda g, a, o: _O().mul(g, _O().mul(math.sqrt(2.0 * math.pi),
                                                      _O().exp(_O().mul(0.5, _O().mul(o, o))))))
+
+
+_register_unary(PrimIDs.LGAMMA, prims.lgamma,
+                lambda g, a, o: _O().mul(g, prims.digamma(a)))
+
+
+@register_vjp(PrimIDs.DYNAMIC_SLICE)
+def _dynamic_slice_vjp(a, start_indices, slice_sizes):
+    out = prims.dynamic_slice(a, start_indices, slice_sizes)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        return _pairs((a, prims.dynamic_update_slice(ops.zeros_like(a), g, start_indices)))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.DYNAMIC_UPDATE_SLICE)
+def _dynamic_update_slice_vjp(a, update, start_indices):
+    out = prims.dynamic_update_slice(a, update, start_indices)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        gu = prims.dynamic_slice(g, start_indices, tuple(update.shape))
+        ga = prims.dynamic_update_slice(g, ops.zeros_like(update), start_indices)
+        return _pairs((a, ga), (update, gu))
+
+    return out, pullback
 
 
 @register_vjp(PrimIDs.POLYGAMMA)
@@ -628,6 +676,22 @@ def _atan2_vjp(a, b):
         denom = ops.add(ops.mul(a, a), ops.mul(b, b))
         return _pairs((a, ops.true_divide(ops.mul(g, b), denom)),
                       (b, ops.neg(ops.true_divide(ops.mul(g, a), denom))))
+
+    return out, pullback
+
+
+@register_vjp(PrimIDs.ZETA)
+def _zeta_vjp(a, b):
+    # reference zeta_backward: only d/dy is implemented,
+    # d/dy zeta(x, y) = -x * zeta(x + 1, y); d/dx has no closed form here.
+    out = prims.zeta(a, b)
+
+    def pullback(g):
+        from thunder_tpu import ops
+
+        gb = ops.mul(g, ops.mul(ops.neg(a), prims.zeta(ops.add(a, 1.0), b))) \
+            if isinstance(b, TensorProxy) else None
+        return _pairs((b, gb))
 
     return out, pullback
 

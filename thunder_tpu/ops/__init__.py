@@ -683,7 +683,10 @@ def _getitem_multi_tensor(a, idx, tensor_positions):
     strides = list(reversed(strides))
     for t, s, st in zip(tensors, sizes, strides):
         t = convert_element_type(t, dtypes.int32)
-        t = broadcast_to(remainder(t, s), bshape)
+        # normalize negatives only; out-of-range indices fall through to
+        # XLA's clamp semantics like the single-tensor take path (ADVICE r1:
+        # remainder() silently wrapped OOB indices)
+        t = broadcast_to(where(lt(t, 0), add(t, s), t), bshape)
         term = mul(t, st) if st != 1 else t
         linear = term if linear is None else add(linear, term)
     pre = tuple(int(s) for s in a.shape[:p0])
@@ -1197,9 +1200,15 @@ def rad2deg(a):
 
 
 def sinc(a):
-    x = mul(_float_promote(a), math.pi)
+    # computed in f32 for low-precision inputs: the grad of sin(t)/t chains
+    # through (t·cos t − sin t)/t², which catastrophically cancels near 0 in
+    # bf16 (jax guards its sinc with a Taylor custom-jvp for the same reason)
+    af = _float_promote(a)
+    low_prec = isinstance(af, TensorProxy) and af.dtype in (dtypes.bfloat16, dtypes.float16)
+    x = mul(convert_element_type(af, dtypes.float32) if low_prec else af, math.pi)
     safe = where(eq(x, 0.0), ones_like(x) if isinstance(x, TensorProxy) else 1.0, x)
-    return where(eq(x, 0.0), 1.0, true_divide(sin(safe), safe))
+    out = where(eq(x, 0.0), 1.0, true_divide(sin(safe), safe))
+    return convert_element_type(out, af.dtype) if low_prec else out
 
 
 def logit(a, eps=None):

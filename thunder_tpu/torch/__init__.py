@@ -1279,6 +1279,12 @@ class ThunderModule:
         self._overrides_buffers: dict = {}
         self._training = module.training
         self._grad_sync = True
+        # torch-autograd bridge (reference torch_autograd.py:62-109): on by
+        # default; pass torch_autograd=False to force the pure-jax path
+        self._torch_autograd = jit_kwargs.pop("torch_autograd", True)
+        self._autograd_cache: dict = {}
+        self._torch_dirty = False   # True once the bridge made the torch module live
+        self._torch_fp = None
         self._jfn = _jit(self._functional, **jit_kwargs)
 
     # the traced function: params/buffers are pytree inputs → proxies
@@ -1297,7 +1303,44 @@ class ThunderModule:
             self._torch_module.train(prev)
         return out, mutated
 
+    def _torch_fingerprint(self):
+        """Cheap change detector for the live torch module's state: in-place
+        updates (optimizer steps, buffer writes) bump torch's _version."""
+        return tuple((id(t), t._version)
+                     for _, t in list(self._torch_module.named_parameters())
+                     + list(self._torch_module.named_buffers()))
+
     def __call__(self, *args, **kwargs):
+        from thunder_tpu.core.pytree import tree_flatten as _tf
+
+        flat, _ = _tf((args, kwargs))
+        if self._torch_autograd and torch.is_grad_enabled():
+            torch_in = [l for l in flat if isinstance(l, torch.Tensor)]
+            # non-torch array leaves would be baked into the bridge trace as
+            # constants (wrong under caching) — bridge only on pure-torch input
+            other_arrays = any(
+                not isinstance(l, torch.Tensor) and hasattr(l, "shape")
+                and hasattr(l, "dtype") for l in flat)
+            needs_grad = any(p.requires_grad for p in self._torch_module.parameters()) \
+                or any(t.requires_grad for t in torch_in)
+            if torch_in and needs_grad and not other_arrays and not self._overrides_parameters:
+                from thunder_tpu.torch.autograd_bridge import call_with_torch_autograd
+
+                out = call_with_torch_autograd(self, args, kwargs)
+                self._torch_dirty = True  # torch module is now the live state
+                return out
+        if getattr(self, "_torch_dirty", False):
+            # torch-coupled mode: re-snapshot only when the torch module's
+            # state actually changed (optimizer steps, bridge buffer
+            # write-backs) — and KEEP it coupled by writing jax-path buffer
+            # mutations back into the torch module below
+            fp = self._torch_fingerprint()
+            if fp != getattr(self, "_torch_fp", None):
+                self._params = {k: tensor_to_jax(v)
+                                for k, v in self._torch_module.named_parameters()}
+                self._buffers = {k: tensor_to_jax(v)
+                                 for k, v in self._torch_module.named_buffers()}
+                self._torch_fp = fp
         args, kwargs = _args_to_jax(args, kwargs)
         p = dict(self._params)
         p.update(self._overrides_parameters)
@@ -1307,6 +1350,17 @@ class ThunderModule:
         for k, v in mutated.items():
             target = self._overrides_buffers if k in self._overrides_buffers else self._buffers
             target[k] = v
+        if mutated and getattr(self, "_torch_dirty", False):
+            # keep the torch module authoritative in coupled mode
+            from thunder_tpu.torch.autograd_bridge import jax_to_tensor
+
+            torch_buffers = dict(self._torch_module.named_buffers())
+            with torch.no_grad():
+                for k, v in mutated.items():
+                    t = torch_buffers.get(k)
+                    if t is not None:
+                        t.copy_(jax_to_tensor(v).to(t.dtype).reshape(t.shape))
+            self._torch_fp = self._torch_fingerprint()
         return out
 
     # -- mode / params ------------------------------------------------------
@@ -1334,6 +1388,12 @@ class ThunderModule:
 
     # -- state dict (reference thunder/core/module.py:188-192) --------------
     def state_dict(self) -> dict:
+        if self._torch_dirty:  # bridge training: live torch module leads
+            self._params = {k: tensor_to_jax(v)
+                            for k, v in self._torch_module.named_parameters()}
+            self._buffers = {k: tensor_to_jax(v)
+                             for k, v in self._torch_module.named_buffers()}
+            self._torch_fp = self._torch_fingerprint()
         sd = {}
         for k, v in list(self._params.items()) + list(self._buffers.items()):
             v = self._overrides_parameters.get(k, self._overrides_buffers.get(k, v))
@@ -1345,6 +1405,8 @@ class ThunderModule:
         return sd
 
     def load_state_dict(self, sd: dict, strict: bool = True) -> None:
+        torch_state = dict(self._torch_module.named_parameters())
+        torch_state.update(self._torch_module.named_buffers())
         for k, v in sd.items():
             tgt = self._params if k in self._params else (
                 self._buffers if k in self._buffers else None)
@@ -1352,6 +1414,14 @@ class ThunderModule:
                 check(not strict, lambda: f"unexpected key {k!r} in state_dict")
                 continue
             tgt[k] = tensor_to_jax(v) if isinstance(v, torch.Tensor) else v
+            # keep the live torch module in lockstep (the bridge path reads it)
+            t = torch_state.get(k)
+            if t is not None:
+                from thunder_tpu.torch.autograd_bridge import jax_to_tensor
+
+                with torch.no_grad():
+                    src = v if isinstance(v, torch.Tensor) else jax_to_tensor(tgt[k])
+                    t.copy_(src.to(t.dtype))
         if strict:
             missing = (set(self._params) | set(self._buffers)) - set(sd)
             check(not missing, lambda: f"missing keys in state_dict: {sorted(missing)}")
@@ -1361,11 +1431,16 @@ class ThunderModule:
 
     @_ctxmgr
     def no_sync(self):
-        """Reference API parity (``ThunderModule.no_sync``). In this framework
-        gradient synchronization is compiled *into* the distributed train step
-        (psum inside shard_map), so accumulation without sync is expressed
-        functionally (accumulate microbatch grads, sync once); this context
-        only marks the intent for transforms that inspect it."""
+        """Grad accumulation without synchronization (reference
+        ``ThunderModule.no_sync``, ``thunder/distributed/__init__.py:80-118``).
+
+        With the torch-autograd bridge, every ``loss.backward()`` accumulates
+        into ``Parameter.grad`` (torch semantics) — microbatch accumulation is
+        real, not a marker, and is tested against eager torch. Distributed
+        grad-sync skipping lives in the functional path (grad accumulation
+        over compiled microbatch steps, psum once — ``tests/test_distributed.py``
+        grad-accumulation parity); this context sets ``_grad_sync`` for
+        transforms that inspect it."""
         self._grad_sync = False
         try:
             yield
@@ -1480,8 +1555,7 @@ def _t_multi_head_attention_forward(
           "multi_head_attention: bias_k/bias_v/add_zero_attn unsupported")
     check(static_k is None and static_v is None,
           "multi_head_attention: static_k/static_v unsupported")
-    check(not training or dropout_p == 0.0,
-          "multi_head_attention: attention dropout unsupported (set dropout=0)")
+
     L, N, E = query.shape
     S = key.shape[0]
     H = int(num_heads)
@@ -1531,6 +1605,9 @@ def _t_multi_head_attention_forward(
         kpm = ops.reshape(key_padding_mask, (N, 1, 1, S))
         scores = ops.where(ops.expand_to(kpm, scores.shape), neg, scores)
     probs = ops.softmax(scores, -1)
+    if training and dropout_p and float(dropout_p) > 0.0:
+        # torch applies dropout to the attention probabilities
+        probs = ops_nn.dropout(probs, p=float(dropout_p), training=True)
     out = ops.matmul(probs, v)                         # (N, H, L, hd)
     out = ops.reshape(ops.transpose(out, (2, 0, 1, 3)), (L, N, E))
     out = ops.linear(out, out_proj_weight, out_proj_bias)
