@@ -497,3 +497,104 @@ def test_fsdp_grad_accumulation_matches_combined_batch(eight_devices):
     np.testing.assert_allclose(float(la), float(lf), atol=1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pf)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_out_specs_same_local_shape_param_families(eight_devices):
+    """VERDICT r1 item 4 'done' criterion: two param families whose LOCAL
+    shard shapes coincide but whose shardings differ train correctly — the
+    round-1 local-shape matcher refused this with an ambiguity error; spec
+    propagation derives out_specs from metadata."""
+    from thunder_tpu.distributed.transforms import tensor_parallel
+
+    rng = np.random.RandomState(9)
+    # w_col: (64, 16) column-sharded over tp=8 -> local (8, 16)
+    # w_rep: (8, 16) replicated                -> local (8, 16)  [same!]
+    params = {"w_col": rng.randn(64, 16).astype(np.float32) * 0.1,
+              "w_rep": rng.randn(8, 16).astype(np.float32) * 0.1}
+
+    params["w_row"] = rng.randn(8, 64).astype(np.float32) * 0.1
+
+    def step(p, x):
+        def loss_fn(pp):
+            h = tt.ops.linear(x, pp["w_col"])          # column: (B, 64)
+            y = tt.ops.linear(h, pp["w_row"])          # row:    (B, 8)
+            z = tt.ops.linear(x, pp["w_rep"])          # replicated: (B, 8)
+            return tt.ops.mean(tt.ops.square(tt.ops.add(y, z)))
+        loss, g = tt.value_and_grad(loss_fn)(p)
+        new = {k: tt.ops.sub(p[k], tt.ops.mul(0.05, g[k])) for k in p}
+        return loss, new
+
+    x = rng.randn(4, 16).astype(np.float32)
+
+    ref_loss, ref_new = tt.jit(step)(params, x)
+
+    js = tensor_parallel(step, MeshSpec.make(tp=8), column_patterns=(r"w_col",),
+                         row_patterns=(r"w_row",))
+    loss, new = js(params, x)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss), atol=1e-5)
+    for k in params:
+        assert tuple(new[k].shape) == tuple(params[k].shape), k
+        np.testing.assert_allclose(np.asarray(new[k]), np.asarray(ref_new[k]),
+                                   atol=1e-5, rtol=1e-4, err_msg=k)
+
+
+def test_fsdp_tp_zero3_regathers(eight_devices):
+    """fsdp_tp now supports zero=3: the 2D layout's fsdp gathers are
+    rematerialized in the backward (VERDICT r1 item 4 tail)."""
+    from thunder_tpu.distributed import fsdp_tp
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=8, scale_layers=2)
+    opt = SGD(lr=1e-2)
+    tokens, targets = _data(cfg, 4, 8, seed=8)
+
+    ref_losses, ref_params = _run_steps(tt.jit(_make_step(cfg, opt)), params,
+                                        opt.init(params), tokens, targets)
+
+    js = fsdp_tp(_make_step(llama.tp_config(cfg, 2), opt),
+                 MeshSpec.make(fsdp=4, tp=2),
+                 column_patterns=llama.TP_COLUMN_PATTERNS,
+                 row_patterns=llama.TP_ROW_PATTERNS, zero=3)
+    losses, dparams = _run_steps(js, params, opt.init(params), tokens, targets)
+    np.testing.assert_allclose(ref_losses, losses, atol=1e-5, rtol=1e-5)
+    for r, d in zip(jax.tree_util.tree_flatten(ref_params)[0],
+                    jax.tree_util.tree_flatten(dparams)[0]):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d), atol=1e-5, rtol=1e-4)
+
+    # ZeRO-3 signature: regather ops in the backward window
+    srcs = [t.python() for t in tt.last_traces(js)]
+    assert max(s.count("= regather") for s in srcs) >= 4
+
+
+def test_broadcast_collective_delivers_src_value(eight_devices):
+    """The broadcast prim must deliver the SOURCE rank's value to every rank
+    (round 1's identity impl was only correct for replicated operands)."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from thunder_tpu.distributed.prims import DistPrimIDs
+    from thunder_tpu.executors.eagerjax import _impls
+
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+    bimpl = _impls[DistPrimIDs.BROADCAST]
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+    try:
+        f = jax.jit(sm(lambda x: bimpl(x[0], "r", 3)[None], mesh=mesh,
+                       in_specs=P("r"), out_specs=P("r"), check_vma=False))
+    except TypeError:
+        f = jax.jit(sm(lambda x: bimpl(x[0], "r", 3)[None], mesh=mesh,
+                       in_specs=P("r"), out_specs=P("r"), check_rep=False))
+    out = f(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+    # a different source index
+    try:
+        f5 = jax.jit(sm(lambda x: bimpl(x[0], "r", 5)[None], mesh=mesh,
+                        in_specs=P("r"), out_specs=P("r"), check_vma=False))
+    except TypeError:
+        f5 = jax.jit(sm(lambda x: bimpl(x[0], "r", 5)[None], mesh=mesh,
+                        in_specs=P("r"), out_specs=P("r"), check_rep=False))
+    np.testing.assert_allclose(np.asarray(f5(jnp.arange(8.0))), np.full(8, 5.0))

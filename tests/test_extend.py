@@ -99,5 +99,5 @@ def test_jit_dispatches_torch_modules():
     tm = thunder_tpu.jit(m)
     assert isinstance(tm, ThunderModule)
     x = torch.randn(2, 3)
-    np.testing.assert_allclose(np.asarray(tm(x)), m(x).detach().numpy(),
+    np.testing.assert_allclose(tm(x).detach().numpy(), m(x).detach().numpy(),
                                rtol=1e-5, atol=1e-6)

@@ -92,6 +92,9 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
                     "grads covered via the var and mean OpInfos over the same prims",
     "ops.max_with_indices": "tuple (values, indices) output; values grad covered by amax",
     "ops.min_with_indices": "tuple (values, indices) output; values grad covered by amin",
+    "nn.ring_attention": "registered lazily by the context-parallel transform; its VJP "
+                         "is the ring backward in distributed/ring.py, exercised by "
+                         "tests/test_distributed.py ring-attention parity tests",
 }
 
 # OpInfo name -> composite ids its samples differentiate through (used when

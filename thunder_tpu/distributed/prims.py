@@ -192,9 +192,13 @@ def _reduce_scatter_impl(a, axis, dim, size):
 
 @impl(DistPrimIDs.BROADCAST)
 def _broadcast_impl(a, axis, src_index=0):
-    # select src shard and gather: on TPU a true broadcast is an all-gather of
-    # one participant; for replicated inputs this is the identity.
-    return a
+    # true broadcast: every rank receives src_index's value. Lowered as a
+    # masked psum — zero everywhere except src, then sum across the axis —
+    # which XLA turns into a one-to-all on ICI. (The round-1 identity impl
+    # was only correct for already-replicated operands.)
+    idx = jax.lax.axis_index(axis)
+    contrib = jax.numpy.where(idx == src_index, a, jax.numpy.zeros_like(a))
+    return jax.lax.psum(contrib, axis)
 
 
 @impl(DistPrimIDs.PPERMUTE)

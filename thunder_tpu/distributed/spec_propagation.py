@@ -1,0 +1,755 @@
+"""Trace-level sharding-spec propagation (VERDICT r1 item 4).
+
+Derives ``shard_map`` out_specs by propagating per-dimension mesh-axis
+assignments from the input proxies through every bound symbol of the
+execution trace — replacing round 1's local-shape matcher, which guessed
+output sharding by matching output shapes against input-shard shapes and
+refused on coincidences.
+
+The analog in the reference is distributed *type propagation* over proxies
+(``thunder/core/proxies.py:1138`` DistParallelType + the tensor-parallel
+visitor rewrites, ``thunder/distributed/tensor_parallel/common.py:80``);
+here the propagated state is richer: a PartitionSpec-like per-dim axis
+tuple plus a set of mesh axes over which the value is a *partial sum*
+(pending all_reduce/reduce_scatter) and a *device-varying* flag
+(axis_index-derived values that differ per rank without a dim layout).
+
+The walk tracks LAYOUT, not global-value intent: shard-uniform local ops
+(slice/pad/flip/cat/scan along any dim, sharded ones included) preserve the
+layout claim — every rank applies the same local op to its block, and the
+result's global meaning is the transform author's contract. Loud failures
+are reserved for states the model cannot express or that must not escape:
+a partial sum or device-varying value reaching an output raises with the
+offending proxy named instead of guessing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import FutureTensorProxy, TensorProxy, Variable
+from thunder_tpu.core.pytree import tree_flatten
+
+# ---------------------------------------------------------------------------
+# the propagated state
+# ---------------------------------------------------------------------------
+
+
+class SpecInfo:
+    """Sharding state of one traced value.
+
+    ``dims``: tuple, one entry per tensor dim — None | axis-name |
+    tuple-of-axis-names (major→minor, like PartitionSpec).
+    ``partial``: frozenset of mesh axes over which this value is an
+    unreduced partial sum.
+    ``varying``: frozenset of mesh axes along which the value differs per
+    rank WITHOUT a dimension layout (axis_index-derived masks; a stage
+    param whose sharded size-1 dim was squeezed away).
+    """
+
+    __slots__ = ("dims", "partial", "varying")
+
+    def __init__(self, dims, partial=frozenset(), varying=frozenset()):
+        self.dims = tuple(dims)
+        self.partial = frozenset(partial)
+        self.varying = frozenset(varying) if not isinstance(varying, bool) \
+            else (frozenset(("?",)) if varying else frozenset())
+
+    def sharded_axes(self) -> set:
+        axes = set()
+        for d in self.dims:
+            axes.update(_entry_axes(d))
+        return axes
+
+    def is_replicated(self) -> bool:
+        return not self.sharded_axes() and not self.partial and not self.varying
+
+    def __repr__(self):
+        return f"SpecInfo({self.dims}, partial={set(self.partial)}, varying={self.varying})"
+
+
+def replicated(ndim: int) -> SpecInfo:
+    return SpecInfo((None,) * ndim)
+
+
+def from_partition_spec(pspec, ndim: int) -> SpecInfo:
+    entries = tuple(pspec) if pspec is not None else ()
+    dims = list(entries[:ndim]) + [None] * (ndim - len(entries))
+    return SpecInfo(dims)
+
+
+def canonicalize(spec: SpecInfo, shape) -> SpecInfo:
+    """Axis-major normal form: shift sharded axes LEFT across size-1 local
+    dims. Row-major equivalence makes the views byte-identical — local
+    (1, m) blocks stacked as global (n, m) are the same bytes as (1, n·m) —
+    so without a fixed convention two dataflow branches can carry the same
+    value with the axis attributed to different dims and spuriously conflict
+    at merges/contractions. Left (major) placement is the convention because
+    batch/sequence sharding is outermost in every layout this framework
+    produces."""
+    dims = list(spec.dims)
+    changed = True
+    any_change = False
+    while changed:
+        changed = False
+        for i in range(1, len(dims)):
+            # move only into EMPTY size-1 dims: merging two different axes
+            # into one entry would entangle unrelated distributions (a
+            # tp-sharded size-1 heads dim must not fold into the fsdp batch
+            # dim's entry)
+            if dims[i] is not None and int(shape[i - 1]) == 1 and dims[i - 1] is None:
+                dims[i - 1] = dims[i]
+                dims[i] = None
+                changed = True
+                any_change = True
+    return SpecInfo(dims, spec.partial, spec.varying) if any_change else spec
+
+
+class SpecPropagationError(RuntimeError):
+    def __init__(self, msg, kind: str = "layout"):
+        super().__init__(msg)
+        self.kind = kind  # "layout" (inexpressible/ambiguous) | "unreduced"
+
+
+class Strided:
+    """A dim whose distribution over the named axes is real but not
+    expressible as a PartitionSpec entry (e.g. flattening (B, T) with T
+    sharded: ranks own strided row-blocks). Reductions over it produce the
+    right partial set; outputs carrying it are rejected loudly."""
+
+    __slots__ = ("axes",)
+
+    def __init__(self, axes):
+        self.axes = frozenset(axes)
+
+    def __eq__(self, other):
+        return isinstance(other, Strided) and self.axes == other.axes
+
+    def __hash__(self):
+        return hash(("strided", self.axes))
+
+    def __repr__(self):
+        return f"Strided({set(self.axes)})"
+
+
+def _entry_axes(entry) -> frozenset:
+    if entry is None:
+        return frozenset()
+    if isinstance(entry, Strided):
+        return entry.axes
+    if isinstance(entry, tuple):
+        return frozenset(entry)
+    return frozenset((entry,))
+
+
+def _merge_dim(a, b, opname):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a == b:
+        return a
+    if isinstance(a, Strided) or isinstance(b, Strided):
+        return Strided(_entry_axes(a) | _entry_axes(b))
+    raise SpecPropagationError(
+        f"{opname}: conflicting shardings {a!r} vs {b!r} on the same dim — "
+        "insert a collective (all_gather / sharding_constraint) between layouts")
+
+
+def merge_pointwise(specs: list[SpecInfo], opname: str, shape=None) -> SpecInfo:
+    """Elementwise merge of same-shape operands. Dim-level conflicts fall
+    back to canonical-equivalence: specs that differ only in which side of a
+    size-1 dim carries the axis (byte-identical global views) merge to the
+    first sharded operand's natural attribution."""
+    specs = [s for s in specs if s is not None]
+    check(specs, lambda: f"{opname}: no tensor operands to merge")
+    ndim = max(len(s.dims) for s in specs)
+    partial: set = set()
+    varying: frozenset = frozenset()
+    for s in specs:
+        partial |= s.partial
+        varying |= s.varying
+    def axis_count_ok(dims_):
+        seen: set = set()
+        for d in dims_:
+            for a in (d if isinstance(d, tuple) else (d,) if d is not None else ()):
+                if a in seen:
+                    return False
+                seen.add(a)
+        return True
+
+    dims = [None] * ndim
+    conflicted = False
+    for s in specs:
+        off = ndim - len(s.dims)  # right-align scalars/broadcast operands
+        for i, d in enumerate(s.dims):
+            try:
+                dims[off + i] = _merge_dim(dims[off + i], d, opname)
+            except SpecPropagationError:
+                # same dim, different axes: degrade to Strided (needs
+                # restructuring before it may leave the shard_map)
+                dims[off + i] = Strided(_entry_axes(dims[off + i]) | _entry_axes(d))
+                conflicted = True
+    repeated = not axis_count_ok(dims) and all(axis_count_ok(s.dims) for s in specs)
+    if (conflicted or repeated) and shape is not None:
+        # canonical-equivalence resolution: operands that differ only in
+        # which side of a size-1 dim carries an axis are byte-identical
+        # views — merge to the first sharded operand's natural attribution.
+        # Canonically DIFFERENT operands are a genuine tile state
+        # (ring-attention score blocks): keep the repeated/Strided merge,
+        # which the output boundary rejects if it ever escapes.
+        canons = {canonicalize(SpecInfo(s.dims, frozenset(), frozenset()), shape).dims
+                  for s in specs if len(s.dims) == ndim}
+        if len(canons) == 1:
+            dims = next(s.dims for s in specs if len(s.dims) == ndim and s.sharded_axes())
+    return SpecInfo(dims, partial, varying)
+
+
+# ---------------------------------------------------------------------------
+# pointwise prim set (shape-preserving, dim-oblivious)
+# ---------------------------------------------------------------------------
+
+_POINTWISE = {
+    PrimIDs.ABS, PrimIDs.ACOS, PrimIDs.ACOSH, PrimIDs.ASIN, PrimIDs.ASINH, PrimIDs.ATAN,
+    PrimIDs.ATANH, PrimIDs.BITWISE_NOT, PrimIDs.CEIL, PrimIDs.COS, PrimIDs.COSH,
+    PrimIDs.ERF, PrimIDs.ERFC, PrimIDs.ERFINV, PrimIDs.EXP, PrimIDs.EXP2, PrimIDs.EXPM1,
+    PrimIDs.FLOOR, PrimIDs.ISFINITE, PrimIDs.ISINF, PrimIDs.ISNAN, PrimIDs.LGAMMA,
+    PrimIDs.LOG, PrimIDs.LOG10, PrimIDs.LOG1P, PrimIDs.LOG2, PrimIDs.LOGICAL_NOT,
+    PrimIDs.NEG, PrimIDs.RECIPROCAL, PrimIDs.ROUND, PrimIDs.RSQRT, PrimIDs.SIGN,
+    PrimIDs.SIGNBIT, PrimIDs.SIN, PrimIDs.SINH, PrimIDs.SQRT, PrimIDs.TAN, PrimIDs.TANH,
+    PrimIDs.TRUNC, PrimIDs.DIGAMMA, PrimIDs.NDTRI, PrimIDs.POLYGAMMA,
+    PrimIDs.ADD, PrimIDs.ATAN2, PrimIDs.BITWISE_AND, PrimIDs.BITWISE_OR,
+    PrimIDs.BITWISE_XOR, PrimIDs.COPYSIGN, PrimIDs.DIV, PrimIDs.EQ, PrimIDs.FMOD,
+    PrimIDs.GE, PrimIDs.GT, PrimIDs.LE, PrimIDs.LT, PrimIDs.MAXIMUM, PrimIDs.MINIMUM,
+    PrimIDs.MUL, PrimIDs.NE, PrimIDs.POW, PrimIDs.REMAINDER, PrimIDs.SHIFT_LEFT,
+    PrimIDs.SHIFT_RIGHT, PrimIDs.SUB, PrimIDs.ZETA, PrimIDs.NEXTAFTER, PrimIDs.WHERE,
+    PrimIDs.CONVERT_ELEMENT_TYPE, PrimIDs.DETACH, PrimIDs.DEVICE_PUT,
+    PrimIDs.SHARDING_CONSTRAINT,
+}
+
+# creation prims: replicated outputs (every rank computes the same value;
+# keyed RNG inside shard_map uses the replicated key)
+_CREATION = {PrimIDs.FULL, PrimIDs.IOTA, PrimIDs.UNIFORM, PrimIDs.NORMAL,
+             PrimIDs.RANDOM_BITS, PrimIDs.RNG_KEY, PrimIDs.RNG_SPLIT}
+
+
+# ---------------------------------------------------------------------------
+# the walk
+# ---------------------------------------------------------------------------
+
+
+def _tensor_args_specs(bsym, env):
+    """(proxy, SpecInfo) for each tensor positional arg (flattened)."""
+    out = []
+    for a in bsym.flat_proxy_args():
+        if isinstance(a, (TensorProxy, FutureTensorProxy)):
+            s = env.get(Variable(a))
+            if s is None:
+                s = replicated(len(a.shape))
+            out.append((a, s))
+    return out
+
+
+def _bind_out(env, bsym, spec):
+    for o in bsym.flat_proxy_outs():
+        s = SpecInfo(spec.dims[: len(o.shape)] if len(spec.dims) >= len(o.shape)
+                     else tuple(spec.dims) + (None,) * (len(o.shape) - len(spec.dims)),
+                     spec.partial, spec.varying)
+        env[Variable(o)] = canonicalize(s, o.shape)
+
+
+def _reshape_spec(in_shape, out_shape, spec: SpecInfo, opname: str) -> SpecInfo:
+    """Map sharded dims through a reshape. A sharded input dim survives when
+    it maps to an output dim with the same prefix-product position and it is
+    the MAJOR factor of whatever group it lands in."""
+    sharded = [(i, d) for i, d in enumerate(spec.dims) if d is not None]
+    if not sharded:
+        return SpecInfo((None,) * len(out_shape), spec.partial, spec.varying)
+
+    def prefix_products(shape):
+        out, p = [1], 1
+        for s in shape:
+            p *= int(s)
+            out.append(p)
+        return out
+
+    pin, pout = prefix_products(in_shape), prefix_products(out_shape)
+    dims = [None] * len(out_shape)
+    for i, d in enumerate(spec.dims):
+        if d is None:
+            continue
+        # the input dim spans global positions [pin[i], pin[i+1]): the sharded
+        # axis maps to the FIRST output dim starting at the same position
+        # (axis-major convention: ranks own contiguous row-blocks, so whether
+        # the group splits or merges, outermost placement is byte-consistent)
+        candidates = [j for j in range(len(out_shape)) if pout[j] == pin[i]]
+        if not candidates:
+            # the sharded dim is swallowed mid-group (e.g. (B, T)→(B·T) with T
+            # sharded): a real but PartitionSpec-inexpressible strided layout.
+            # Track it on the containing output dim; reductions over it still
+            # yield the correct partial axes, outputs carrying it error.
+            j = max(k for k in range(len(out_shape)) if pout[k] <= pin[i])
+            dims[j] = Strided(_entry_axes(dims[j]) | _entry_axes(d))
+            continue
+        j = candidates[0]
+        cur = dims[j]
+        if cur is None:
+            dims[j] = d
+        elif isinstance(cur, Strided) or isinstance(d, Strided):
+            dims[j] = Strided(_entry_axes(cur) | _entry_axes(d))
+        else:
+            # two sharded input dims merge into one output dim: ordered
+            # tuple, earlier (major) input dim first — a legal PartitionSpec
+            cur_t = cur if isinstance(cur, tuple) else (cur,)
+            d_t = d if isinstance(d, tuple) else (d,)
+            dims[j] = cur_t + d_t
+    return SpecInfo(dims, spec.partial, spec.varying)
+
+
+def propagate_specs(trc, input_specs: dict, *, axis_sizes: dict | None = None) -> dict:
+    """Walk ``trc`` and return {Variable: SpecInfo} for every traced value.
+
+    ``input_specs`` maps Variable(input proxy) → SpecInfo (or PartitionSpec).
+    """
+    from thunder_tpu.distributed.prims import DistPrimIDs
+
+    env: dict = {}
+    for p in trc.args:
+        v = Variable(p)
+        s = input_specs.get(v)
+        if s is None:
+            s = replicated(len(p.shape))
+        elif not isinstance(s, SpecInfo):
+            s = from_partition_spec(s, len(p.shape))
+        env[v] = canonicalize(s, p.shape)
+
+    cur = {"bsym": None}
+    fuzzy: set = set()   # axes whose exact tracking was lost (degrades,
+                         # device-varying states): boundary partials on these
+                         # are rescuable; partials on exactly-tracked axes
+                         # stay hard errors
+
+    def walk(bsyms):
+        for bsym in bsyms:
+            cur["bsym"] = bsym
+            sid = bsym.sym.id
+            name = bsym.sym.name
+            outs = [o for o in bsym.flat_proxy_outs()
+                    if isinstance(o, (TensorProxy, FutureTensorProxy))]
+            if not outs or sid in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
+                continue
+            if all(Variable(o) in env for o in outs):
+                continue  # already computed (e.g. fusion wrapper after subsymbols)
+            tas = _tensor_args_specs(bsym, env)
+
+            if sid in _POINTWISE:
+                specs = []
+                for a, s in tas:
+                    if tuple(a.shape) == tuple(outs[0].shape):
+                        specs.append(s)
+                    elif s.is_replicated():
+                        continue  # scalar/broadcastable replicated operand
+                    else:
+                        raise SpecPropagationError(
+                            f"{name}: sharded operand shape {tuple(a.shape)} != output "
+                            f"{tuple(outs[0].shape)} (implicit broadcast of a sharded "
+                            "value)")
+                spec = merge_pointwise(specs, name, shape=tuple(outs[0].shape)) \
+                    if specs else replicated(len(outs[0].shape))
+                _bind_out(env, bsym, spec)
+                continue
+            if sid in _CREATION:
+                for o in outs:
+                    env[Variable(o)] = replicated(len(o.shape))
+                continue
+            if sid is PrimIDs.BROADCAST_IN_DIM:
+                a, s = tas[0]
+                bdims = bsym.args[2] if len(bsym.args) > 2 else bsym.kwargs.get("broadcast_dimensions")
+                dims = [None] * len(outs[0].shape)
+                for i, j in enumerate(bdims):
+                    dims[j] = s.dims[i]
+                _bind_out(env, bsym, SpecInfo(dims, s.partial, s.varying))
+                continue
+            if sid is PrimIDs.RESHAPE:
+                a, s = tas[0]
+                spec = _reshape_spec(a.shape, outs[0].shape, s, name)
+                if spec.varying and spec.varying != {"?"}:
+                    # unsqueeze-style reshape: a created size-1 dim can carry
+                    # the varying axes again (inverse of the sharded-squeeze)
+                    n_in_ones = sum(1 for x in a.shape if int(x) == 1)
+                    created = [i for i, x in enumerate(outs[0].shape) if int(x) == 1]
+                    if len(created) > n_in_ones and created:
+                        dims = list(spec.dims)
+                        j = created[0]
+                        axes = tuple(sorted(x for x in spec.varying if x != "?"))
+                        if dims[j] is None and axes:
+                            dims[j] = axes[0] if len(axes) == 1 else axes
+                            spec = SpecInfo(dims, spec.partial,
+                                            frozenset(x for x in spec.varying if x == "?"))
+                _bind_out(env, bsym, spec)
+                continue
+            if sid is PrimIDs.TRANSPOSE:
+                a, s = tas[0]
+                perm = bsym.args[1] if len(bsym.args) > 1 else bsym.kwargs.get("permutation")
+                _bind_out(env, bsym, SpecInfo(tuple(s.dims[p] for p in perm), s.partial, s.varying))
+                continue
+            if sid is PrimIDs.SQUEEZE:
+                a, s = tas[0]
+                dims_arg = bsym.args[1] if len(bsym.args) > 1 else bsym.kwargs.get("dims")
+                drop = set(int(d) % len(a.shape) for d in (dims_arg if isinstance(dims_arg, (tuple, list)) else [dims_arg]))
+                varying = set(s.varying)
+                for d in drop:
+                    if s.dims[d] is not None:
+                        # squeezing a sharded size-1 LOCAL dim: each rank now
+                        # holds its own slice with no dim to carry the axis —
+                        # the value is per-rank varying over those axes
+                        # (pipeline stage selection); reattachable on unsqueeze
+                        if int(a.shape[d]) == 1:
+                            varying |= _entry_axes(s.dims[d])
+                            fuzzy.update(_entry_axes(s.dims[d]))
+                        else:
+                            raise SpecPropagationError(f"{name}: squeezing sharded dim {d}")
+                _bind_out(env, bsym, SpecInfo(
+                    tuple(x for i, x in enumerate(s.dims) if i not in drop), s.partial, varying))
+                continue
+            if sid in (PrimIDs.SLICE, PrimIDs.PAD, PrimIDs.FLIP, PrimIDs.DYNAMIC_SLICE,
+                       PrimIDs.DYNAMIC_UPDATE_SLICE):
+                # shard-uniform ops: every rank applies the same local
+                # slice/pad/flip to its shard, so the LAYOUT is preserved
+                # (the transform author owns the value semantics)
+                a, s = tas[0]
+                if sid is PrimIDs.DYNAMIC_UPDATE_SLICE:
+                    others = [s2 for _, s2 in tas[1:]]
+                    extra_p = set().union(*(o.partial for o in others)) if others else set()
+                    extra_v = frozenset().union(*(o.varying for o in others)) if others else frozenset()
+                    _bind_out(env, bsym, SpecInfo(s.dims, s.partial | extra_p,
+                                                  s.varying | extra_v))
+                    continue
+                _bind_out(env, bsym, SpecInfo(s.dims[: len(outs[0].shape)], s.partial, s.varying))
+                continue
+            if sid is PrimIDs.CAT:
+                # shard-uniform: each rank concatenates its local pieces;
+                # layout merges like a pointwise op
+                merged = merge_pointwise([s for _, s in tas], name,
+                                         shape=tuple(outs[0].shape))
+                _bind_out(env, bsym, merged)
+                continue
+            if sid in (PrimIDs.SUM, PrimIDs.PROD, PrimIDs.AMAX, PrimIDs.AMIN,
+                       PrimIDs.ARGMAX, PrimIDs.ARGMIN):
+                a, s = tas[0]
+                red = bsym.args[1] if len(bsym.args) > 1 else bsym.kwargs.get("dims")
+                if red is None:
+                    red = tuple(range(len(a.shape)))
+                red = tuple(int(d) % len(a.shape) for d in (red if isinstance(red, (tuple, list)) else [red]))
+                partial = set(s.partial)
+                for d in red:
+                    entry = s.dims[d]
+                    if entry is not None:
+                        if sid in (PrimIDs.ARGMAX, PrimIDs.ARGMIN):
+                            raise SpecPropagationError(f"{name}: arg-reduction over sharded dim {d}")
+                        partial.update(_entry_axes(entry))
+                kept = [x for i, x in enumerate(s.dims) if i not in red]
+                # keepdim reductions keep rank
+                if len(outs[0].shape) == len(a.shape):
+                    kept = [None if i in red else x for i, x in enumerate(s.dims)]
+                _bind_out(env, bsym, SpecInfo(kept, partial, s.varying))
+                continue
+            if sid in (PrimIDs.CUMSUM, PrimIDs.CUMPROD, PrimIDs.SORT, PrimIDs.ARGSORT,
+                       PrimIDs.TOPK):
+                # shard-uniform along-dim ops: layout preserved
+                a, s = tas[0]
+                _bind_out(env, bsym, s)
+                continue
+            if sid is PrimIDs.DOT_GENERAL:
+                (qa, sa), (qb, sb) = tas[0], tas[1]
+                cd = bsym.kwargs.get("contract_dims") or bsym.args[2]
+                bd = bsym.kwargs.get("batch_dims") or (bsym.args[3] if len(bsym.args) > 3 else ((), ()))
+                (ca, cb), (ba, bb) = cd, bd
+
+                def dot_rule(sa_, sb_):
+                    partial = set(sa_.partial) | set(sb_.partial)
+                    for da, db in zip(ca, cb):
+                        ea, eb = sa_.dims[da], sb_.dims[db]
+                        if ea != eb:
+                            raise SpecPropagationError(
+                                f"{name}: contracting dims with mismatched sharding {ea!r} vs {eb!r}")
+                        if ea is not None:
+                            partial.update(_entry_axes(ea))
+                    dims = []
+                    for da, db in zip(ba, bb):
+                        dims.append(_merge_dim(sa_.dims[da], sb_.dims[db], name))
+                    dims += [sa_.dims[i] for i in range(len(qa.shape)) if i not in ca and i not in ba]
+                    dims += [sb_.dims[i] for i in range(len(qb.shape)) if i not in cb and i not in bb]
+                    return SpecInfo(dims, partial, sa_.varying | sb_.varying)
+
+                try:
+                    spec = dot_rule(sa, sb)
+                except SpecPropagationError:
+                    try:
+                        # retry with canonical views (size-1-dim attribution noise)
+                        spec = dot_rule(canonicalize(sa, qa.shape), canonicalize(sb, qb.shape))
+                    except SpecPropagationError:
+                        # tile-structured internals (ring attention: the same
+                        # axis legitimately lives on both score dims, or a
+                        # Strided flatten feeds a contraction). Degrade to
+                        # VARYING over the involved axes — "differs per rank
+                        # in ways this model cannot attribute": collectives
+                        # clear it; at the output boundary it is rescuable by
+                        # key-path correspondence, unlike a genuine partial
+                        # sum (which stays a hard error).
+                        axes = sa.sharded_axes() | sb.sharded_axes()
+                        fuzzy.update(axes)
+                        spec = SpecInfo((None,) * len(outs[0].shape),
+                                        sa.partial | sb.partial,
+                                        sa.varying | sb.varying | axes)
+                _bind_out(env, bsym, spec)
+                continue
+            if sid in (PrimIDs.TAKE, PrimIDs.TAKE_ALONG_AXIS):
+                (qa, sa), (qi, si) = tas[0], tas[1]
+                d = bsym.args[2] if len(bsym.args) > 2 else bsym.kwargs.get("dim", 0)
+                d = int(d) % len(qa.shape)
+                if sa.dims[d] is not None:
+                    # gathering along a sharded dim: each rank gathers from
+                    # its own shard — per-rank values, no layout claim
+                    _bind_out(env, bsym, SpecInfo(
+                        (None,) * len(outs[0].shape), sa.partial | si.partial,
+                        sa.varying | si.varying | _entry_axes(sa.dims[d])))
+                    continue
+                if sid is PrimIDs.TAKE:
+                    dims = list(sa.dims[:d]) + list(si.dims) + list(sa.dims[d + 1:])
+                else:
+                    dims = [_merge_dim(a_, b_, name) if i != d else si.dims[i]
+                            for i, (a_, b_) in enumerate(zip(sa.dims, si.dims))]
+                _bind_out(env, bsym, SpecInfo(dims, sa.partial | si.partial,
+                                              sa.varying | si.varying))
+                continue
+            if sid in (PrimIDs.SCATTER_ADD, PrimIDs.INDEX_ADD):
+                # additive scatter of rank-local contributions into a
+                # replicated destination = a PARTIAL SUM over the axes the
+                # indices/values are sharded on (embedding backward: each
+                # rank scatters its local tokens' grads, then reduce)
+                (qd, sd) = tas[0]
+                if sd.sharded_axes() or sd.varying:
+                    raise SpecPropagationError(f"{name}: sharded scatter destination")
+                partial = set(sd.partial)
+                varying: frozenset = frozenset()
+                for a, s in tas[1:]:
+                    partial |= s.partial | s.sharded_axes()
+                    varying |= s.varying
+                _bind_out(env, bsym, SpecInfo(sd.dims, partial, varying))
+                continue
+            if sid in (PrimIDs.SCATTER, PrimIDs.INDEX_PUT):
+                # overwrite semantics: rank-local writes are not a partial
+                # sum; require replicated operands
+                for a, s in tas:
+                    if not s.is_replicated():
+                        raise SpecPropagationError(f"{name}: sharded operand in overwrite scatter")
+                _bind_out(env, bsym, replicated(len(outs[0].shape)))
+                continue
+            # -- distributed prims --------------------------------------------
+            if isinstance(sid, DistPrimIDs):
+                spec = _dist_rule(sid, bsym, tas, name, fuzzy)
+                _bind_out(env, bsym, spec)
+                continue
+            if sid is PrimIDs.CONVOLUTION:
+                # batch dim may be sharded; feature/spatial must be replicated
+                (qa, sa) = tas[0]
+                for i, d in enumerate(sa.dims[1:], start=1):
+                    if d is not None:
+                        raise SpecPropagationError(f"{name}: sharded non-batch conv dim {i}")
+                for a, s in tas[1:]:
+                    if not s.is_replicated():
+                        raise SpecPropagationError(f"{name}: sharded conv weight")
+                _bind_out(env, bsym, SpecInfo((sa.dims[0],) + (None,) * (len(outs[0].shape) - 1),
+                                              sa.partial, sa.varying))
+                continue
+            if sid is PrimIDs.EINSUM:
+                for a, s in tas:
+                    if not s.is_replicated():
+                        raise SpecPropagationError(f"{name}: einsum over sharded operands "
+                                                   "(lower to dot_general for propagation)")
+                _bind_out(env, bsym, replicated(len(outs[0].shape)))
+                continue
+            # unknown op: recurse into its decomposition if present
+            if bsym.subsymbols:
+                walk(bsym.subsymbols)
+                missing = [o for o in outs if Variable(o) not in env]
+                for o in missing:
+                    env[Variable(o)] = replicated(len(o.shape))
+                continue
+            # last resort: replicated inputs → replicated output
+            if all(s.is_replicated() for _, s in tas):
+                for o in outs:
+                    env[Variable(o)] = replicated(len(o.shape))
+                continue
+            raise SpecPropagationError(
+                f"no sharding-propagation rule for op {name!r} (id={sid}) with sharded "
+                "operands — add a rule in spec_propagation.py")
+
+    try:
+        walk(trc.bound_symbols)
+    except SpecPropagationError as e:
+        b = cur["bsym"]
+        if b is not None and "| in op:" not in str(e):
+            args_desc = ", ".join(
+                f"{a.name}{tuple(a.shape)}={env.get(Variable(a))}"
+                for a in b.flat_proxy_args()
+                if isinstance(a, (TensorProxy, FutureTensorProxy)))
+            raise SpecPropagationError(f"{e} | in op: {b.sym.name}({args_desc})") from None
+        raise
+    env["__fuzzy_axes__"] = fuzzy
+    return env
+
+
+def _dist_rule(sid, bsym, tas, name, fuzzy):
+    from thunder_tpu.distributed.prims import DistPrimIDs
+    from thunder_tpu.core.proxies import DistParallelType
+
+    (qa, sa) = tas[0] if tas else (None, None)
+    if sid is DistPrimIDs.WAIT:
+        return sa
+    if sid is DistPrimIDs.ALL_GATHER:
+        axis = bsym.args[1]
+        # gathered: every rank of the axis now holds the full value
+        return SpecInfo(_drop_axis_all(sa.dims, axis), sa.partial, sa.varying - {axis, "?"})
+    if sid is DistPrimIDs.ALL_REDUCE:
+        axis = bsym.args[1]
+        # psum output is identical on every rank of the axis: clears
+        # partiality, device-variation, AND any dim-layout claim on the axis
+        return SpecInfo(_drop_axis_all(sa.dims, axis), sa.partial - {axis},
+                        sa.varying - {axis, "?"})
+    if sid is DistPrimIDs.REDUCE_SCATTER:
+        axis, dim = bsym.args[1], int(bsym.args[2])
+        dims = list(_drop_axis_all(sa.dims, axis))
+        dims[dim] = _add_axis(dims[dim], axis, name)
+        return SpecInfo(dims, sa.partial - {axis}, sa.varying - {axis, "?"})
+    if sid is DistPrimIDs.BROADCAST:
+        axis = bsym.args[1]
+        return SpecInfo(_drop_axis_all(sa.dims, axis), sa.partial,
+                        sa.varying - {axis, "?"})
+    if sid in (DistPrimIDs.PPERMUTE, DistPrimIDs.ALL_TO_ALL):
+        if sid is DistPrimIDs.ALL_TO_ALL:
+            axis = bsym.args[1]
+            split_dim, concat_dim = int(bsym.args[2]), int(bsym.args[3])
+            dims = list(sa.dims)
+            dims[split_dim] = _add_axis(dims[split_dim], axis, name)
+            dims[concat_dim] = _drop_axis(dims[concat_dim], axis)
+            return SpecInfo(dims, sa.partial, sa.varying)
+        return sa
+    if sid in (DistPrimIDs.SYNCHRONIZE, DistPrimIDs.REGATHER):
+        axis, ptype = bsym.args[1], bsym.args[2]
+        if ptype is DistParallelType.FULLY_SHARDED:
+            # dim-0 all_gather: the full param is now on every rank
+            return SpecInfo(_drop_axis_all(sa.dims, axis), sa.partial,
+                            sa.varying - {axis, "?"})
+        return sa  # replicated-family synchronize: identity layout
+    if sid is DistPrimIDs.SYNCHRONIZE_TP_OUTPUT:
+        axis = bsym.args[1]
+        return SpecInfo(sa.dims, sa.partial - {axis}, sa.varying)
+    if sid is DistPrimIDs.SYNCHRONIZE_TP_INPUT:
+        return sa
+    if sid is DistPrimIDs.AXIS_INDEX:
+        fuzzy.add(bsym.args[0])
+        return SpecInfo((), frozenset(), frozenset((bsym.args[0],)))
+    raise SpecPropagationError(f"unhandled distributed prim {name}")
+
+
+def _drop_axis(entry, axis):
+    if entry is None:
+        return None
+    if isinstance(entry, Strided):
+        rest = entry.axes - {axis}
+        return Strided(rest) if rest else None
+    if entry == axis:
+        return None
+    if isinstance(entry, tuple):
+        rest = tuple(a for a in entry if a != axis)
+        return rest[0] if len(rest) == 1 else (rest or None)
+    return entry
+
+
+def _drop_axis_all(dims, axis):
+    """After a reducing/gathering collective over ``axis`` the value is
+    identical on every rank of that axis — no dim may keep claiming it."""
+    return tuple(_drop_axis(d, axis) for d in dims)
+
+
+def _add_axis(entry, axis, name):
+    if entry is None:
+        return axis
+    if entry == axis or (isinstance(entry, tuple) and axis in entry):
+        raise SpecPropagationError(f"{name}: dim already sharded over {axis!r}")
+    if isinstance(entry, tuple):
+        return entry + (axis,)
+    return (entry, axis)
+
+
+def out_partition_specs(trc, input_specs: dict, fallback=None):
+    """PartitionSpec pytree for ``trc.output`` via propagation. Raises
+    SpecPropagationError when an output is a partial sum or device-varying
+    (an unreduced value must not silently leave the shard_map) — unless
+    ``fallback(leaf)`` returns a PartitionSpec for it (used for pytree
+    key-path correspondence: an updated param inherits its input's spec when
+    tile-structured internals defeat exact per-dim tracking)."""
+    from jax.sharding import PartitionSpec
+
+    env = propagate_specs(trc, input_specs)
+    from thunder_tpu.core.pytree import tree_map
+
+    def to_pspec(leaf):
+        if fallback is not None and isinstance(leaf, TensorProxy):
+            try:
+                return _leaf_pspec(leaf)
+            except SpecPropagationError as e:
+                # rescue only LAYOUT failures (strided/varying/tile states the
+                # per-dim model cannot express). An unreduced partial sum is a
+                # genuine missing-collective bug — key-path correspondence
+                # would silently stitch divergent per-rank values; refuse.
+                if e.kind == "unreduced":
+                    raise
+                fb = fallback(leaf)
+                if fb is not None:
+                    return fb
+                raise
+        return _leaf_pspec(leaf)
+
+    def _leaf_pspec(leaf):
+        if isinstance(leaf, TensorProxy):
+            s = env.get(Variable(leaf))
+            if s is None:
+                return PartitionSpec()
+            if s.partial:
+                fuzzy = env.get("__fuzzy_axes__", set())
+                kind = "layout" if set(s.partial) <= set(fuzzy) else "unreduced"
+                raise SpecPropagationError(
+                    f"output {leaf.name} is an unreduced partial sum over axes "
+                    f"{set(s.partial)}; all_reduce/reduce_scatter it before returning"
+                    + (" (axes were fuzzily tracked; key-path rescue applies)"
+                       if kind == "layout" else ""),
+                    kind=kind)
+            if any(isinstance(d, Strided) for d in s.dims):
+                raise SpecPropagationError(
+                    f"output {leaf.name} has a strided (PartitionSpec-inexpressible) "
+                    f"layout {s.dims}; reshape/gather it into a per-dim layout first")
+            seen_axes: set = set()
+            for d in s.dims:
+                for ax in _entry_axes(d):
+                    if ax in seen_axes:
+                        raise SpecPropagationError(
+                            f"output {leaf.name} carries mesh axis {ax!r} on two dims "
+                            f"({s.dims}) — a tile layout PartitionSpec cannot express")
+                    seen_axes.add(ax)
+            if s.varying:
+                raise SpecPropagationError(
+                    f"output {leaf.name} is device-varying over {set(s.varying)} with no "
+                    "declared layout; reduce, broadcast, or unsqueeze it before returning")
+            # trim trailing Nones (PartitionSpec convention)
+            dims = list(s.dims)
+            while dims and dims[-1] is None:
+                dims.pop()
+            return PartitionSpec(*dims)
+        return PartitionSpec()
+
+    return tree_map(to_pspec, trc.output)
