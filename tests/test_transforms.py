@@ -204,3 +204,105 @@ def test_visitor_transform_and_bsym_dag():
                              if x.bsym.sym.name == "sin"), 0)
     top2 = toposort_bsym_dag(roots, "top_down", selector=sel)
     assert top2[0].sym.name == "sin"
+
+
+# ---------------------------------------------------------------------------
+# trace-level vmap (VERDICT r1 item 8)
+# ---------------------------------------------------------------------------
+
+def test_vmap_emits_trace_ir_and_composes_with_grad():
+    """Done criteria: tt.grad(tt.vmap(f)) matches jax on a composite; the
+    batched output is plain trace IR (no opaque region)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+
+    def f(x, w):
+        return ops.sum(ops.tanh(ops.matmul(x, w)), 1)
+
+    xs = rng.randn(6, 4, 5).astype(np.float32)
+    w = rng.randn(5, 3).astype(np.float32)
+
+    jf = tt.jit(lambda xs, w: tt.vmap(f, in_axes=(0, None))(xs, w))
+    got = jf(xs, w)
+    want = jax.vmap(lambda x, w_: jnp.tanh(x @ w_).sum(1), in_axes=(0, None))(xs, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    src = tt.last_traces(jf)[0].python()
+    assert "vmap" not in src and "dot_general" in src
+
+    def g(xs, w):
+        return ops.sum(tt.vmap(f, in_axes=(0, None))(xs, w))
+
+    gw = tt.jit(tt.grad(g, argnums=1))(xs, w)
+    ref = jax.grad(lambda w_: jax.vmap(lambda x: jnp.tanh(x @ w_).sum(1))(xs).sum())(
+        jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(ref), atol=1e-4)
+
+
+def test_vmapped_sdpa_still_claimed_by_pallas(monkeypatch):
+    """The composite batching rule folds the vmap batch into SDPA's leading
+    dims, so the Pallas executor still claims it."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.RandomState(1)
+
+    def att(q, kv):
+        return ops.scaled_dot_product_attention(q, kv, kv, is_causal=True)
+
+    q = rng.randn(3, 2, 16, 8).astype(np.float32)
+    kv = rng.randn(3, 2, 16, 8).astype(np.float32)
+    ja = tt.jit(lambda q, kv: tt.vmap(att)(q, kv), executors=["pallas", "xla"])
+    out = ja(q, kv)
+
+    names = set()
+
+    def walk(bs):
+        for b in bs:
+            names.add(b.sym.codegen_name())
+            walk(b.subsymbols)
+
+    walk(tt.last_execution_trace(ja).bound_symbols)
+    assert any("pallas" in n for n in names), sorted(names)
+    ref = jax.vmap(lambda q_, kv_: jax.nn.softmax(
+        (q_ @ jnp.swapaxes(kv_, -1, -2)) / np.sqrt(8)
+        + jnp.where(jnp.tril(jnp.ones((16, 16), bool)), 0, -jnp.inf), axis=-1) @ kv_)(q, kv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_vmap_fallback_for_unruled_ops():
+    """Ops without a batching rule fall back to the opaque jax.vmap lowering
+    per call — partial rule coverage never breaks correctness."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    xs = rng.randn(5, 7).astype(np.float32)
+
+    def h(x):
+        vals, idx = ops.sort(x, 0)
+        return vals
+
+    got = tt.jit(lambda xs: tt.vmap(h)(xs))(xs)
+    want = jax.vmap(lambda x: jnp.sort(x, 0))(xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_vmap_shape_ops_and_reductions():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    xs = rng.randn(4, 3, 6).astype(np.float32)
+
+    def f(x):
+        y = ops.reshape(ops.transpose(x, (1, 0)), (18,))
+        y = ops.cat([y, y], 0)
+        return ops.amax(ops.reshape(y, (6, 6)), (1,))
+
+    got = tt.jit(lambda xs: tt.vmap(f)(xs))(xs)
+    want = jax.vmap(lambda x: jnp.concatenate([x.T.reshape(18), x.T.reshape(18)])
+                    .reshape(6, 6).max(1))(xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)

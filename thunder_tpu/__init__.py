@@ -183,7 +183,9 @@ class ThunderTPUFunction:
         # symbolic values: non-bool numbers become runtime inputs guarded by
         # type only (reference SYMBOLIC_VALUES, thunder/core/options.py:95) —
         # tensor SHAPES stay static: XLA compiles static programs, so shape
-        # polymorphism on TPU is handled by data-pipeline bucketing instead
+        # polymorphism on TPU is handled by data-pipeline bucketing
+        # (thunder_tpu.data.LengthBucketer: pad to a small fixed ladder of
+        # lengths, bounding compilations to the bucket count)
         if (self.cache_option == "symbolic values" and isinstance(leaf, Number)
                 and not isinstance(leaf, bool)):
             return ("N", type(leaf).__name__)
@@ -473,10 +475,34 @@ def jvp(fn: Callable) -> Callable:
     return jvp_fn
 
 
+def _vmap_impl(fn: Callable, in_axes=0) -> Callable:
+    """Trace-level vmap (per-prim batching rules, composable with grad and
+    executor claiming — reference ``thunder/core/transforms.py:1902``), with
+    automatic fallback to the opaque jax.vmap lowering for ops without rules."""
+
+    def wrapper(*args):
+        from thunder_tpu.core.batching import NoBatchRule, inline_vmap
+        from thunder_tpu.core.trace import get_tracectx
+
+        trc = get_tracectx()
+        mark = len(trc.bound_symbols) if trc is not None else 0
+        try:
+            return inline_vmap(fn, in_axes)(*args)
+        except NoBatchRule:
+            if trc is not None:  # roll back partially-emitted batched ops
+                del trc.bound_symbols[mark:]
+            return vmap_call(fn, in_axes=in_axes)(*args)
+
+    return wrapper
+
+
 def vmap(fn: Callable, in_axes=0) -> Callable:
-    """Batching transform (reference ``transforms.py:1902``); lowers to an
-    opaque jax.vmap region — opaque to trace-level autograd."""
-    return vmap_call(fn, in_axes=in_axes)
+    """Batching transform (reference ``transforms.py:1902``): trace-level
+    per-prim batching rules — the output is ordinary trace IR, so it composes
+    with ``tt.grad`` and executor claiming (a vmapped SDPA is still claimed
+    by Pallas). Ops without a rule fall back per-call to the opaque jax.vmap
+    lowering."""
+    return _vmap_impl(fn, in_axes=in_axes)
 
 
 # ---------------------------------------------------------------------------

@@ -114,3 +114,77 @@ class TokenDataset:
 def write_token_file(path: str, tokens: np.ndarray, dtype_bytes: int = 2) -> None:
     dt = np.uint16 if dtype_bytes == 2 else np.uint32
     np.asarray(tokens, dtype=dt).tofile(path)
+
+
+# ---------------------------------------------------------------------------
+# sequence-length bucketing (VERDICT r1 item 10)
+# ---------------------------------------------------------------------------
+
+class LengthBucketer:
+    """Pads variable-length sequences to a SMALL, FIXED set of compiled
+    lengths so XLA compiles at most ``len(buckets)`` programs instead of one
+    per distinct length.
+
+    This is the documented mitigation for the static-shape stance
+    (``thunder_tpu.jit`` compiles static XLA programs; the reference instead
+    carries NumberProxy CONSTRAINT machinery for symbolic shapes,
+    ``thunder/core/proxies.py:624-1136`` — on TPU, bucketing is the idiomatic
+    answer: a handful of padded shapes amortize compilation, and the MXU
+    prefers the aligned lengths anyway).
+
+    >>> b = LengthBucketer([128, 512, 2048])
+    >>> b.bucket_for(300)
+    512
+    >>> padded, mask = b.pad_batch(list_of_token_arrays, pad_id=0)
+    """
+
+    def __init__(self, buckets):
+        bs = sorted(int(b) for b in buckets)
+        if not bs:
+            raise ValueError("need at least one bucket length")
+        self.buckets = bs
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"sequence length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}; add a bucket or truncate upstream")
+
+    def pad_batch(self, seqs, pad_id: int = 0):
+        """Pad a list of 1-D int arrays to the batch's common bucket.
+
+        Returns ``(tokens, mask)``: tokens ``(B, L)`` with ``pad_id`` fill,
+        mask ``(B, L)`` True on real tokens. The bucket is chosen by the
+        LONGEST sequence so one batch compiles one program.
+        """
+        seqs = [np.asarray(s) for s in seqs]
+        L = self.bucket_for(max(int(s.shape[0]) for s in seqs))
+        B = len(seqs)
+        tokens = np.full((B, L), pad_id, dtype=seqs[0].dtype)
+        mask = np.zeros((B, L), dtype=bool)
+        for i, s in enumerate(seqs):
+            n = int(s.shape[0])
+            tokens[i, :n] = s
+            mask[i, :n] = True
+        return tokens, mask
+
+    def stream(self, batches, pad_id: int = 0):
+        """Yield padded ``(tokens, mask)`` for an iterable of
+        list-of-sequences batches; every yield's length is one of
+        ``self.buckets`` (≤ ``len(buckets)`` distinct compiled shapes)."""
+        for batch in batches:
+            yield self.pad_batch(batch, pad_id=pad_id)
+
+
+def default_buckets(max_len: int, *, factor: int = 2, align: int = 128):
+    """Power-of-``factor`` ladder of lane-aligned bucket lengths up to
+    ``max_len`` (128-aligned: the TPU lane width)."""
+    out = []
+    b = align
+    while b < max_len:
+        out.append(b)
+        b *= factor
+    out.append(((max_len + align - 1) // align) * align)
+    return out

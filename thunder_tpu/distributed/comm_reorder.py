@@ -1,0 +1,128 @@
+"""Comm-scheduling escape hatch: hoist collective issue points, sink waits.
+
+The default stance is to let XLA's async-collective scheduler overlap
+communication with compute (SURVEY §5 "Distributed communication backend").
+When XLA's latency hiding underdelivers on a real pod, this trace pass is
+the manual control the reference reaches for with
+``sort_communication_ops`` / ``sort_waits``
+(``thunder/distributed/utils.py:60,119,196``): a greedy topological
+reschedule in which
+
+- collective-ISSUE ops (``all_gather``/``all_reduce``/``reduce_scatter``/
+  ``synchronize``/…, the ops producing FutureTensorProxy) are emitted as
+  EARLY as their dependencies allow, and
+- ``wait`` ops are emitted as LATE as possible — only when no other op is
+  ready — so independent compute slides between a collective's issue and
+  its wait.
+
+Scheduling is deterministic (stable priority + original index as the
+tiebreak), so every rank of an SPMD program reorders identically and the
+collective issue ORDER is preserved rank-to-rank (no cross-rank deadlock).
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.trace import TraceCtx, from_trace
+from thunder_tpu.core.transform_common import Transform
+from thunder_tpu.core.utils import consumed_vars, produced_vars
+
+
+def _is_issue(bsym) -> bool:
+    from thunder_tpu.core.proxies import FutureTensorProxy
+    from thunder_tpu.core.pytree import tree_flatten
+
+    outs, _ = tree_flatten(bsym.output)
+    return any(isinstance(o, FutureTensorProxy) for o in outs)
+
+
+def _is_wait(bsym) -> bool:
+    from thunder_tpu.distributed.prims import DistPrimIDs
+
+    return bsym.sym.id is DistPrimIDs.WAIT
+
+
+def sort_waits(trc: TraceCtx) -> TraceCtx:
+    """Reorder ``trc`` so collective issues run ASAP and waits run ALAP.
+
+    Comments/dels are pinned to their predecessor op; the return stays last.
+    """
+    bsyms = list(trc.bound_symbols)
+
+    # pin non-semantic markers (comments, dels, prints) to their predecessor
+    groups: list[list] = []
+    for b in bsyms:
+        if b.sym.id in (PrimIDs.COMMENT, PrimIDs.PYTHON_DEL, PrimIDs.PYTHON_PRINT) and groups:
+            groups[-1].append(b)
+        else:
+            groups.append([b])
+
+    n = len(groups)
+    produced_by: dict = {}
+    for gi, grp in enumerate(groups):
+        for b in grp:
+            for v in produced_vars(b):
+                produced_by[v] = gi
+
+    deps: list[set] = [set() for _ in range(n)]
+    for gi, grp in enumerate(groups):
+        for b in grp:
+            for v in consumed_vars(b):
+                src = produced_by.get(v)
+                if src is not None and src != gi:
+                    deps[gi].add(src)
+
+    ret_idx = next((gi for gi, grp in enumerate(groups)
+                    if grp[0].sym.id is PrimIDs.PYTHON_RETURN), None)
+
+    indegree = [len(d) for d in deps]
+    dependents: list[list] = [[] for _ in range(n)]
+    for gi, d in enumerate(deps):
+        for src in d:
+            dependents[src].append(gi)
+
+    import heapq
+
+    def priority(gi: int) -> tuple:
+        head = groups[gi][0]
+        if _is_issue(head):
+            rank = 0          # hoist collective issues
+        elif _is_wait(head):
+            rank = 2          # sink waits
+        else:
+            rank = 1
+        return (rank, gi)     # original index keeps determinism + stability
+
+    ready = [priority(gi) for gi in range(n) if indegree[gi] == 0 and gi != ret_idx]
+    heapq.heapify(ready)
+    order: list[int] = []
+    while ready:
+        _, gi = heapq.heappop(ready)
+        order.append(gi)
+        for dep in dependents[gi]:
+            indegree[dep] -= 1
+            if indegree[dep] == 0 and dep != ret_idx:
+                heapq.heappush(ready, priority(dep))
+
+    if ret_idx is not None:
+        order.append(ret_idx)
+    if len(order) != n:  # cycle (malformed trace): bail out unchanged
+        return trc
+
+    new = from_trace(trc)
+    for gi in order:
+        new.bound_symbols.extend(groups[gi])
+    new.set_provenance("Comm reorder (hoist collective issues, sink waits)")
+    return new
+
+
+class CommReorderTransform(Transform):
+    """Applies :func:`sort_waits` to the computation trace BEFORE executor
+    dispatch/fusion, so the reordered issue/wait positions shape the order of
+    collective calls in the generated program (inside fusion regions too).
+    Pass via ``transforms=[CommReorderTransform()]`` or ``comm_reorder=True``
+    on the distributed wrappers."""
+
+    def transform_traces_pre_prologue(self, prologue_trc, computation_trc,
+                                      epilogue_trc, **kw):
+        return prologue_trc, sort_waits(computation_trc), epilogue_trc

@@ -25,12 +25,9 @@ offending proxy named instead of guessing.
 
 from __future__ import annotations
 
-from typing import Any
-
 from thunder_tpu.core.baseutils import check
 from thunder_tpu.core.prims import PrimIDs
 from thunder_tpu.core.proxies import FutureTensorProxy, TensorProxy, Variable
-from thunder_tpu.core.pytree import tree_flatten
 
 # ---------------------------------------------------------------------------
 # the propagated state
@@ -211,23 +208,18 @@ def merge_pointwise(specs: list[SpecInfo], opname: str, shape=None) -> SpecInfo:
 # pointwise prim set (shape-preserving, dim-oblivious)
 # ---------------------------------------------------------------------------
 
-_POINTWISE = {
-    PrimIDs.ABS, PrimIDs.ACOS, PrimIDs.ACOSH, PrimIDs.ASIN, PrimIDs.ASINH, PrimIDs.ATAN,
-    PrimIDs.ATANH, PrimIDs.BITWISE_NOT, PrimIDs.CEIL, PrimIDs.COS, PrimIDs.COSH,
-    PrimIDs.ERF, PrimIDs.ERFC, PrimIDs.ERFINV, PrimIDs.EXP, PrimIDs.EXP2, PrimIDs.EXPM1,
-    PrimIDs.FLOOR, PrimIDs.ISFINITE, PrimIDs.ISINF, PrimIDs.ISNAN, PrimIDs.LGAMMA,
-    PrimIDs.LOG, PrimIDs.LOG10, PrimIDs.LOG1P, PrimIDs.LOG2, PrimIDs.LOGICAL_NOT,
-    PrimIDs.NEG, PrimIDs.RECIPROCAL, PrimIDs.ROUND, PrimIDs.RSQRT, PrimIDs.SIGN,
-    PrimIDs.SIGNBIT, PrimIDs.SIN, PrimIDs.SINH, PrimIDs.SQRT, PrimIDs.TAN, PrimIDs.TANH,
-    PrimIDs.TRUNC, PrimIDs.DIGAMMA, PrimIDs.NDTRI, PrimIDs.POLYGAMMA,
-    PrimIDs.ADD, PrimIDs.ATAN2, PrimIDs.BITWISE_AND, PrimIDs.BITWISE_OR,
-    PrimIDs.BITWISE_XOR, PrimIDs.COPYSIGN, PrimIDs.DIV, PrimIDs.EQ, PrimIDs.FMOD,
-    PrimIDs.GE, PrimIDs.GT, PrimIDs.LE, PrimIDs.LT, PrimIDs.MAXIMUM, PrimIDs.MINIMUM,
-    PrimIDs.MUL, PrimIDs.NE, PrimIDs.POW, PrimIDs.REMAINDER, PrimIDs.SHIFT_LEFT,
-    PrimIDs.SHIFT_RIGHT, PrimIDs.SUB, PrimIDs.ZETA, PrimIDs.NEXTAFTER, PrimIDs.WHERE,
-    PrimIDs.CONVERT_ELEMENT_TYPE, PrimIDs.DETACH, PrimIDs.DEVICE_PUT,
-    PrimIDs.SHARDING_CONSTRAINT,
-}
+def _pointwise_ids():
+    from thunder_tpu.core.prims import OpTags, all_prims
+
+    ids = {pid for pid, sym in all_prims().items()
+           if OpTags.ELEMENTWISE_OP in sym.tags}
+    # shape/dtype-preserving pass-throughs the tag doesn't cover
+    ids |= {PrimIDs.CONVERT_ELEMENT_TYPE, PrimIDs.DETACH, PrimIDs.DEVICE_PUT,
+            PrimIDs.SHARDING_CONSTRAINT}
+    return ids
+
+
+_POINTWISE = _pointwise_ids()
 
 # creation prims: replicated outputs (every rank computes the same value;
 # keyed RNG inside shard_map uses the replicated key)
@@ -308,7 +300,7 @@ def _reshape_spec(in_shape, out_shape, spec: SpecInfo, opname: str) -> SpecInfo:
     return SpecInfo(dims, spec.partial, spec.varying)
 
 
-def propagate_specs(trc, input_specs: dict, *, axis_sizes: dict | None = None) -> dict:
+def propagate_specs(trc, input_specs: dict) -> dict:
     """Walk ``trc`` and return {Variable: SpecInfo} for every traced value.
 
     ``input_specs`` maps Variable(input proxy) → SpecInfo (or PartitionSpec).

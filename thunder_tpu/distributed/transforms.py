@@ -119,6 +119,13 @@ class DistributedFunction(ThunderTPUFunction):
               "(leaf plans and shard specs are built per concrete call)")
         if mode in ("fsdp", "hsdp", "fsdp_tp") and zero == 3:
             jit_kwargs["transforms"] = tuple(jit_kwargs.get("transforms", ())) + (_Zero3Transform(),)
+        if jit_kwargs.pop("comm_reorder", False):
+            # manual comm scheduling (the sort_waits escape hatch) when XLA's
+            # async-collective overlap underdelivers
+            from thunder_tpu.distributed.comm_reorder import CommReorderTransform
+
+            jit_kwargs["transforms"] = tuple(jit_kwargs.get("transforms", ())) \
+                + (CommReorderTransform(),)
         super().__init__(wrapped, **jit_kwargs)
         self._orig_fn = fn
 

@@ -137,7 +137,10 @@ def examine_torch(fn, *args, **kwargs) -> dict:
                 # would raise on
                 meth = getattr(func, "__name__", "")
                 is_method = (name or "").startswith("torch.Tensor.")
-                if not (is_method and meth in _TENSOR_METHODS):
+                from thunder_tpu.torch import TorchProxy
+
+                proxy_dunder = meth.startswith("__") and hasattr(TorchProxy, meth)
+                if not (is_method and (meth in _TENSOR_METHODS or proxy_dunder)):
                     unsupported[name] += 1
             return func(*f_args, **(f_kwargs or {}))
 

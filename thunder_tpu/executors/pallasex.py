@@ -58,12 +58,6 @@ def _pick_block(n: int, budget_elems: int) -> int:
     return max(fitting) if fitting else n
 
 
-def _causal_nk(qi, bq, bk, nk_all):
-    """Number of kv blocks a causal q block must process: blocks up to and
-    including the one containing the q block's last row (the diagonal)."""
-    return jnp.minimum(((qi + 1) * bq + bk - 1) // bk, nk_all)
-
-
 def _causal_mask(s, row0, col0):
     """Mask score tile ``s`` to row >= col given the tile's global offsets."""
     row = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
