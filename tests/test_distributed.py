@@ -685,3 +685,36 @@ def test_comm_reorder_option_end_to_end(eight_devices):
         return gaps
 
     assert sum(wait_gaps(n1)) > sum(wait_gaps(n2))  # waits sank
+
+
+def test_sort_waits_never_moves_del_before_use(eight_devices):
+    """Code-review r2: a pinned `del x` group must not overtake another
+    consumer of x that waits on a sunk collective."""
+    from thunder_tpu.distributed import sort_waits
+    from thunder_tpu.distributed import prims as dp
+    from thunder_tpu.core.trace import TraceCtx, tracectx
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.core import dtypes, prims as cp
+    from thunder_tpu.core.prims import PrimIDs
+    from thunder_tpu.executors.passes import del_last_used
+    from thunder_tpu import ops
+
+    trc = TraceCtx("computation")
+    with tracectx(trc):
+        a = TensorProxy("a", shape=(4, 4), dtype=dtypes.float32)
+        red = dp.wait(dp.all_reduce(a, "dp", "sum"))
+        c = ops.mul(a, red)       # consumer of a gated by the wait
+        d = ops.add(a, 1.0)       # independent compute (del a pins here)
+        out = ops.add(c, d)
+        cp.python_return(out)
+    trc.args = [a]
+    trc.output = out
+
+    new = sort_waits(del_last_used(trc))
+    deleted: set = set()
+    for b in new.bound_symbols:
+        names = [x.name for x in b.flat_proxy_args() if hasattr(x, "name")]
+        if b.sym.id is PrimIDs.PYTHON_DEL:
+            deleted.update(names)
+        else:
+            assert not (set(names) & deleted), f"use after del: {names} in {b.sym.name}"

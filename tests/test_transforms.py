@@ -306,3 +306,27 @@ def test_vmap_shape_ops_and_reductions():
     want = jax.vmap(lambda x: jnp.concatenate([x.T.reshape(18), x.T.reshape(18)])
                     .reshape(6, 6).max(1))(xs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_vmap_pytree_args_and_argmax():
+    """Code-review r2: pytree args bind every tensor leaf; vmapped argmax
+    works per-dim and falls back cleanly for the full-reduce form."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    xs = rng.randn(6, 4).astype(np.float32)
+    w = rng.randn(4, 3).astype(np.float32)
+    got = tt.jit(lambda xs, p: tt.vmap(
+        lambda x, pp: ops.sum(ops.matmul(ops.reshape(x, (1, 4)), pp["w"])),
+        in_axes=(0, None))(xs, p))(xs, {"w": w})
+    ref = jax.vmap(lambda x: (x.reshape(1, 4) @ jnp.asarray(w)).sum())(xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+    xs3 = rng.randn(3, 4, 5).astype(np.float32)
+    got = tt.jit(lambda xs: tt.vmap(lambda x: ops.argmax(x, 1))(xs))(xs3)
+    ref = jax.vmap(lambda x: jnp.argmax(x, 1))(xs3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+    got = tt.jit(lambda xs: tt.vmap(lambda x: ops.argmax(x))(xs))(xs3)
+    ref = jax.vmap(lambda x: jnp.argmax(x))(xs3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))

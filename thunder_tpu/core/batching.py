@@ -81,16 +81,9 @@ def _elementwise_rule(bsym, vals, bdims, B):
     return out, 0
 
 
-def _pointwise_ids():
-    from thunder_tpu.core.prims import OpTags, all_prims
+from thunder_tpu.core.prims import elementwise_prim_ids
 
-    ids = {pid for pid, sym in all_prims().items()
-           if OpTags.ELEMENTWISE_OP in sym.tags}
-    ids.add(PrimIDs.WHERE)
-    return ids
-
-
-_POINTWISE = _pointwise_ids()
+_POINTWISE = elementwise_prim_ids()
 
 
 # ---------------------------------------------------------------------------
@@ -185,9 +178,26 @@ def _reduction_rule(prim):
 
 
 for _pid, _prim in ((PrimIDs.SUM, prims.sum), (PrimIDs.PROD, prims.prod),
-                    (PrimIDs.AMAX, prims.amax), (PrimIDs.AMIN, prims.amin),
-                    (PrimIDs.ARGMAX, prims.argmax), (PrimIDs.ARGMIN, prims.argmin)):
+                    (PrimIDs.AMAX, prims.amax), (PrimIDs.AMIN, prims.amin)):
     register_batching_rule(_pid)(_reduction_rule(_prim))
+
+
+def _arg_reduction_rule(prim):
+    def rule(bsym, vals, bdims, B):
+        a = vals[0]
+        nd = a.ndim - 1  # unbatched rank
+        d = bsym.args[1] if len(bsym.args) > 1 else bsym.kwargs.get("dim")
+        if d is None:
+            # full-reduce argmax returns a flattened index; shifting dims
+            # cannot express that — let the opaque fallback handle it
+            raise NoBatchRule("vmapped full-reduce argmax/argmin")
+        return prim(a, int(d) % nd + 1), 0
+
+    return rule
+
+
+register_batching_rule(PrimIDs.ARGMAX)(_arg_reduction_rule(prims.argmax))
+register_batching_rule(PrimIDs.ARGMIN)(_arg_reduction_rule(prims.argmin))
 
 
 def _along_dim_rule(prim):
@@ -356,29 +366,43 @@ def inline_vmap(fn: Callable, in_axes=0):
         check(get_tracectx() is not None, "inline_vmap must run under tracing")
         axes = in_axes if isinstance(in_axes, (tuple, list)) else (in_axes,) * len(args)
         check(len(axes) == len(args), "in_axes length must match args")
+        # flatten per-arg: an in_axes entry applies to EVERY tensor leaf of
+        # that (possibly pytree) argument, matching jax.vmap semantics
         B = None
-        unbatched = []
+        unbatched_args = []
+        leaf_plan = []  # (outer leaf, axis or None) per tensor leaf, flatten order
         for a, ax in zip(args, axes):
-            if isinstance(a, TensorProxy) and ax is not None:
-                ax = int(ax) % a.ndim
-                B = int(a.shape[ax]) if B is None else B
-                check(int(a.shape[ax]) == B, "inconsistent batch sizes across in_axes")
-                shape = tuple(s for i, s in enumerate(a.shape) if i != ax)
-                unbatched.append(TensorProxy(shape=shape, dtype=a.dtype, device=a.device))
-            else:
-                unbatched.append(a)
+            flat, treedef = tree_flatten(a)
+            new_flat = []
+            for leaf in flat:
+                if isinstance(leaf, TensorProxy):
+                    if ax is not None:
+                        lax_ = int(ax) % leaf.ndim
+                        B = int(leaf.shape[lax_]) if B is None else B
+                        check(int(leaf.shape[lax_]) == B,
+                              "inconsistent batch sizes across in_axes")
+                        shape = tuple(s for i, s in enumerate(leaf.shape) if i != lax_)
+                        new_flat.append(TensorProxy(shape=shape, dtype=leaf.dtype,
+                                                    device=leaf.device))
+                        leaf_plan.append((leaf, lax_))
+                    else:
+                        new_flat.append(leaf)
+                        leaf_plan.append((leaf, None))
+                else:
+                    new_flat.append(leaf)
+            unbatched_args.append(tree_unflatten(treedef, new_flat))
         check(B is not None, "vmap needs at least one batched tensor argument")
-        inner, inner_inputs, _ = _trace_subfn(lambda *xs: fn(*xs), tuple(unbatched), {})
+        inner, inner_inputs, _ = _trace_subfn(lambda *xs: fn(*xs), tuple(unbatched_args), {})
+        check(len(inner_inputs) == len(leaf_plan),
+              lambda: f"vmap: {len(leaf_plan)} tensor leaves but the inner trace has "
+                      f"{len(inner_inputs)} inputs")
 
         env: dict = {}
-        it = iter(inner_inputs)
-        for a, ax in zip(args, axes):
-            if isinstance(a, TensorProxy):
-                p = next(it)
-                if ax is not None:
-                    env[Variable(p)] = (_move_bdim_front(a, int(ax) % a.ndim), 0)
-                else:
-                    env[Variable(p)] = (a, None)
+        for p, (leaf, lax_) in zip(inner_inputs, leaf_plan):
+            if lax_ is not None:
+                env[Variable(p)] = (_move_bdim_front(leaf, lax_), 0)
+            else:
+                env[Variable(p)] = (leaf, None)
 
         replay_batched(inner.bound_symbols, env, B)
 

@@ -109,6 +109,13 @@ def all_prims() -> dict[Any, Symbol]:
     return dict(_prims_by_id)
 
 
+def elementwise_prim_ids() -> set:
+    """PrimIDs tagged ELEMENTWISE_OP — the shape-preserving pointwise set
+    shared by sharding propagation and vmap batching."""
+    return {pid for pid, sym in _prims_by_id.items()
+            if OpTags.ELEMENTWISE_OP in sym.tags}
+
+
 def make_prim(prim_id, name: str, meta, *, tags: Sequence[OpTags] = (), python_impl=None) -> Symbol:
     sym = Symbol(name, meta, id=prim_id, is_prim=True, tags=frozenset(tags), python_impl=python_impl)
     _prims_by_id[prim_id] = sym

@@ -65,12 +65,26 @@ def sort_waits(trc: TraceCtx) -> TraceCtx:
                 produced_by[v] = gi
 
     deps: list[set] = [set() for _ in range(n)]
+    consumers: dict = {}   # var -> groups with a NON-del use
     for gi, grp in enumerate(groups):
         for b in grp:
+            is_del = b.sym.id is PrimIDs.PYTHON_DEL
             for v in consumed_vars(b):
                 src = produced_by.get(v)
                 if src is not None and src != gi:
                     deps[gi].add(src)
+                if not is_del:
+                    consumers.setdefault(v, set()).add(gi)
+    # a group carrying `del x` must run after EVERY group that uses x —
+    # producer→consumer edges alone would let independent compute (and its
+    # pinned del) overtake a consumer waiting on a sunk collective
+    for gi, grp in enumerate(groups):
+        for b in grp:
+            if b.sym.id is PrimIDs.PYTHON_DEL:
+                for v in consumed_vars(b):
+                    for cg in consumers.get(v, ()):
+                        if cg != gi:
+                            deps[gi].add(cg)
 
     ret_idx = next((gi for gi, grp in enumerate(groups)
                     if grp[0].sym.id is PrimIDs.PYTHON_RETURN), None)

@@ -209,14 +209,12 @@ def merge_pointwise(specs: list[SpecInfo], opname: str, shape=None) -> SpecInfo:
 # ---------------------------------------------------------------------------
 
 def _pointwise_ids():
-    from thunder_tpu.core.prims import OpTags, all_prims
+    from thunder_tpu.core.prims import elementwise_prim_ids
 
-    ids = {pid for pid, sym in all_prims().items()
-           if OpTags.ELEMENTWISE_OP in sym.tags}
-    # shape/dtype-preserving pass-throughs the tag doesn't cover
-    ids |= {PrimIDs.CONVERT_ELEMENT_TYPE, PrimIDs.DETACH, PrimIDs.DEVICE_PUT,
-            PrimIDs.SHARDING_CONSTRAINT}
-    return ids
+    # plus shape/dtype-preserving pass-throughs the tag doesn't cover
+    return elementwise_prim_ids() | {
+        PrimIDs.CONVERT_ELEMENT_TYPE, PrimIDs.DETACH, PrimIDs.DEVICE_PUT,
+        PrimIDs.SHARDING_CONSTRAINT}
 
 
 _POINTWISE = _pointwise_ids()

@@ -430,8 +430,9 @@ def pallas_rms_norm(a, weight=None, eps=1e-5, dim=-1):
     D = a.shape[-1]
     N = a.size // D
     x2 = a.reshape(N, D)
-    # in+out f32 tiles are double-buffered: keep bn*D*4 under ~1.5MB
-    bn = _pick_block(N, max(8, min(256, (3 * 1024 * 1024) // (D * 8))))
+    # bn=128 measured fastest on v5e at D=4096 (8.55ms vs 14.0 at bn=64,
+    # 12.6 at bn=256 for (16384,4096) bf16): budget targets a ~2MB f32 tile
+    bn = _pick_block(N, max(8, min(256, (2 * 1024 * 1024) // (D * 4))))
     kernel = functools.partial(_rms_kernel, eps=eps, cast=a.dtype)
     if weight is None:
         def kernel_nw(x_ref, o_ref):

@@ -57,6 +57,14 @@ CONFIGS = {
     "llama3-8b": LlamaConfig(name="llama3-8b", vocab_size=128256, dim=4096, n_layers=32,
                              n_heads=32, n_kv_heads=8, intermediate_size=14336,
                              max_seq_len=8192, rope_theta=500000.0, dtype=dtypes.bfloat16),
+    # bench variant: same per-layer arithmetic (GQA 32/8 heads, MLP 14336);
+    # vocab capped at 32k and seq at 2048 so a scaled-layer slice + full
+    # AdamW state fits one 16GB chip (the 128k-vocab embed+head alone is
+    # 1.05B params — the GQA attention/MLP geometry is what this measures)
+    "llama3-8b-bench": LlamaConfig(name="llama3-8b-bench", vocab_size=32000, dim=4096,
+                                   n_layers=32, n_heads=32, n_kv_heads=8,
+                                   intermediate_size=14336, max_seq_len=2048,
+                                   rope_theta=500000.0, dtype=dtypes.bfloat16),
 }
 
 
