@@ -75,3 +75,140 @@ def size(a) -> int:
     for d in a.shape:
         n *= int(d)
     return n
+
+
+# -- numpy-specific semantics (beyond name aliasing) -------------------------
+
+def dot(a, b):
+    """numpy.dot polymorphism: scalar multiply, 1-D·1-D inner product,
+    2-D matmul, N-D: sum-product over a's last axis and b's second-to-last."""
+    if getattr(a, "ndim", 0) == 0 or getattr(b, "ndim", 0) == 0:
+        return _ops.mul(a, b)
+    if a.ndim == 1 and b.ndim == 1:
+        return _ops.sum(_ops.mul(a, b))
+    if b.ndim == 1:
+        return _ops.matmul(a, b)
+    if a.ndim == 1:
+        return _ops.matmul(a, b)
+    if a.ndim == 2 and b.ndim == 2:
+        return _ops.matmul(a, b)
+    # N-D: contract a[-1] with b[-2] (numpy semantics, NOT broadcasting matmul)
+    from thunder_tpu.core import prims as _prims
+
+    return _prims.dot_general(a, b, contract_dims=((a.ndim - 1,), (b.ndim - 2,)),
+                              batch_dims=((), ()))
+
+
+outer = _ops.outer
+inner = _ops.inner
+
+
+def var(a, axis=None, ddof=0, keepdims=False):
+    """numpy default ddof=0 (population variance) — torch defaults to 1."""
+    return _ops.var(a, axis, correction=ddof, keepdim=keepdims)
+
+
+def std(a, axis=None, ddof=0, keepdims=False):
+    return _ops.sqrt(var(a, axis, ddof=ddof, keepdims=keepdims))
+
+
+def clip(a, a_min=None, a_max=None):
+    return _ops.clamp(a, min=a_min, max=a_max)
+
+
+def expand_dims(a, axis):
+    return _ops.unsqueeze(a, axis)
+
+
+def squeeze(a, axis=None):
+    if axis is None:
+        dims = tuple(i for i, s in enumerate(a.shape) if int(s) == 1)
+        return _ops.squeeze(a, dims) if dims else a
+    axes = (axis,) if not isinstance(axis, (tuple, list)) else tuple(axis)
+    for ax in axes:
+        if int(a.shape[int(ax) % a.ndim]) != 1:
+            # numpy raises here; torch silently no-ops — this is the numpy dialect
+            raise ValueError(
+                "cannot select an axis to squeeze out which has size not equal to one")
+    return _ops.squeeze(a, axis)
+
+
+def moveaxis(a, source, destination):
+    src = [int(source)] if not isinstance(source, (tuple, list)) else [int(s) for s in source]
+    dst = [int(destination)] if not isinstance(destination, (tuple, list)) \
+        else [int(d) for d in destination]
+    src = [s % a.ndim for s in src]
+    dst = [d % a.ndim for d in dst]
+    perm = [i for i in range(a.ndim) if i not in src]
+    for d, s in sorted(zip(dst, src)):
+        perm.insert(d, s)
+    return _ops.transpose(a, tuple(perm))
+
+
+def swapaxes(a, axis1, axis2):
+    perm = list(range(a.ndim))
+    perm[axis1 % a.ndim], perm[axis2 % a.ndim] = perm[axis2 % a.ndim], perm[axis1 % a.ndim]
+    return _ops.transpose(a, tuple(perm))
+
+
+def cumsum(a, axis=None):
+    if axis is None:  # numpy flattens first
+        return _ops.cumsum(_ops.reshape(a, (-1,)), 0)
+    return _ops.cumsum(a, axis)
+
+
+def sort(a, axis=-1):
+    return _ops.sort(a, axis)[0]
+
+
+def argsort(a, axis=-1):
+    return _ops.argsort(a, axis)
+
+
+def flip(a, axis=None):
+    if axis is None:
+        axis = tuple(range(a.ndim))
+    return _ops.flip(a, axis)
+
+
+def maximum(a, b):
+    return _ops.maximum(a, b)
+
+
+def minimum(a, b):
+    return _ops.minimum(a, b)
+
+
+power = _ops.pow
+floor_divide = _ops.floor_divide
+mod = _ops.remainder
+sign = _ops.sign
+tile = _ops.tile
+
+
+def split(a, indices_or_sections, axis=0):
+    """numpy.split: int -> equal sections (must divide); list -> cut points."""
+    axis = axis % a.ndim
+    n = int(a.shape[axis])
+    if isinstance(indices_or_sections, int):
+        k = indices_or_sections
+        if n % k != 0:
+            raise ValueError("array split does not result in an equal division")
+        cuts = [i * (n // k) for i in range(1, k)]
+    else:
+        cuts = [int(c) for c in indices_or_sections]
+    pieces = []
+    start = 0
+    for c in cuts + [n]:
+        idx = [slice(None)] * a.ndim
+        idx[axis] = slice(start, c)
+        pieces.append(_ops.getitem(a, tuple(idx)))
+        start = c
+    return pieces
+
+
+__all__ += [
+    "dot", "outer", "inner", "var", "std", "clip", "expand_dims", "squeeze",
+    "moveaxis", "swapaxes", "cumsum", "sort", "argsort", "flip", "maximum",
+    "minimum", "power", "floor_divide", "mod", "sign", "tile", "split",
+]

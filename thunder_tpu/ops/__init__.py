@@ -1432,8 +1432,14 @@ def tile(a, dims):
         out = unsqueeze(out, 0)
     offset = max(-lead, 0)
     for i, r in enumerate(dims):
-        if r != 1:
-            out = cat([out] * int(r), dim=i + offset)
+        r = int(r)
+        if r == 0:
+            # numpy/torch: zero reps yield an empty extent along that axis
+            d = i + offset
+            idx = tuple(slice(0, 0) if j == d else slice(None) for j in range(out.ndim))
+            out = getitem(out, idx)
+        elif r != 1:
+            out = cat([out] * r, dim=i + offset)
     return out
 
 
