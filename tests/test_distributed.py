@@ -718,3 +718,75 @@ def test_sort_waits_never_moves_del_before_use(eight_devices):
             deleted.update(names)
         else:
             assert not (set(names) & deleted), f"use after del: {names} in {b.sym.name}"
+
+
+def test_ddp_float_image_batch_is_sharded(eight_devices):
+    """VERDICT r1 weak #4: a FLOAT batch (images) under ddp must shard the
+    batch dim — the round-1 integer-dtype heuristic silently replicated it
+    (losing data parallelism); state leaves still replicate with params."""
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(3 * 8 * 8, 10).astype(np.float32) * 0.1,
+              "b": np.zeros(10, np.float32)}
+    images = rng.randn(16, 3 * 8 * 8).astype(np.float32)   # FLOAT batch
+    labels = rng.randint(0, 10, size=(16,)).astype(np.int32)
+
+    def step(p, s, x, y):
+        def loss_fn(pp):
+            logits = tt.ops.add(tt.ops.matmul(x, pp["w"]), pp["b"])
+            return tt.ops.cross_entropy(tt.ops.convert_element_type(
+                logits, tt.core.dtypes.float32), y)
+        loss, g = tt.value_and_grad(loss_fn)(p)
+        new = {k: tt.ops.sub(p[k], tt.ops.mul(0.1, g[k]))
+               for k in p}
+        news = {k: tt.ops.add(s[k], tt.ops.mul(0.0, g[k])) for k in p}  # mirrors params
+        return loss, new, news
+
+    state = {k: np.zeros_like(v) for k, v in params.items()}
+    ref_loss, ref_new, _ = tt.jit(step)(params, state, images, labels)
+
+    js = ddp(step, MeshSpec.make(dp=N))
+    loss, new, _ = js(params, state, images, labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss), atol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new[k]), np.asarray(ref_new[k]),
+                                   atol=1e-5, rtol=1e-4)
+
+    # the float image batch was actually SHARDED (not replicated): its leaf
+    # plan carries the dp axis
+    img_plan = [pl for pl, (path, leaf) in zip(
+        js._plan,
+        __import__("jax").tree_util.tree_flatten_with_path(
+            ((params, state, images, labels), {}))[0])
+        if hasattr(leaf, "shape") and tuple(leaf.shape) == (16, 3 * 8 * 8)]
+    assert img_plan and img_plan[0].kind == "data_shard", img_plan
+    # state leaves replicated with their params
+    st_plans = [pl.kind for pl, (path, leaf) in zip(
+        js._plan,
+        __import__("jax").tree_util.tree_flatten_with_path(
+            ((params, state, images, labels), {}))[0])
+        if "w" == getattr(path[-1], "key", None) or "b" == getattr(path[-1], "key", None)]
+    assert all(k in ("ddp_param", "replicate") for k in st_plans), st_plans
+
+
+def test_ddp_bare_array_state_replicates(eight_devices):
+    """Code-review r2: bare-array params (no key structure) fall back to the
+    integer-dtype heuristic — a bare float momentum array must NOT be
+    sharded as batch data."""
+    rng = np.random.RandomState(4)
+    w = rng.randn(16, 10).astype(np.float32) * 0.1
+    mom = np.zeros((16, 10), np.float32)
+    x = rng.randint(0, 16, size=(16,)).astype(np.int32)   # int batch
+
+    def step(w, mom, x):
+        def loss_fn(ww):
+            picked = tt.ops.take(ww, x, 0)
+            return tt.ops.mean(tt.ops.square(picked))
+        loss, g = tt.value_and_grad(loss_fn)(w)
+        mom2 = tt.ops.add(tt.ops.mul(0.9, mom), g)
+        return loss, tt.ops.sub(w, tt.ops.mul(0.1, mom2)), mom2
+
+    ref = tt.jit(step)(w, mom, x)
+    js = ddp(step, MeshSpec.make(dp=N))
+    got = js(w, mom, x)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(g), atol=1e-5, rtol=1e-4)

@@ -38,8 +38,21 @@ def _lift_constant_arrays(trc, args, kwargs):
         cache = getattr(trc, "_const_cache", None)
         if cache is None:
             cache = trc._const_cache = {}
-        if id(x) in cache:
-            return cache[id(x)]
+        orig_id = id(x)
+        if orig_id in cache:
+            return cache[orig_id]
+        import sys
+
+        if type(x).__name__ == "TorchProxy":  # missed unwrap in a nested structure
+            return x._p
+        _torch = sys.modules.get("torch")
+        if _torch is not None and isinstance(x, _torch.Tensor):
+            # torch-dialect closures capture real torch tensors (HF mask
+            # helpers); lift them like any other concrete array — cache under
+            # the ORIGINAL tensor's id so shared tensors dedup to one const
+            from thunder_tpu.torch import tensor_to_jax
+
+            x = tensor_to_jax(x.detach())
         from thunder_tpu.core import dtypes as _dt
         from thunder_tpu.core.devices import default_device
 
@@ -52,7 +65,7 @@ def _lift_constant_arrays(trc, args, kwargs):
         csym = Symbol(f"const_tensor{idx}", None, id=f"const_tensor:{idx}:{id(x)}",
                       is_prim=True, python_impl=lambda _v=x: _v)
         trc.add_bound_symbol(csym.bind(output=out))
-        cache[id(x)] = out
+        cache[orig_id] = out
         return out
 
     from thunder_tpu.core.pytree import tree_map

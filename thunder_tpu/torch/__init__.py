@@ -168,13 +168,82 @@ def _dispatch(func, args, kwargs):
 class _TraceMode(TorchFunctionMode):
     """Active while tracing a torch program: routes every torch API call that
     involves a TorchProxy — and all factory functions — into the thunder map;
-    everything else (real-tensor compute building constants) passes through."""
+    everything else (real-tensor compute building constants) passes through.
+
+    Also swaps ``torch.vmap``/``torch.func.vmap`` for a trace-level vmap while
+    active: functorch cannot batch over TorchProxy, but the framework's own
+    per-prim batching rules can (transformers' masking_utils builds its masks
+    with nested torch.vmap over index predicates)."""
 
     def __torch_function__(self, func, types, args=(), kwargs=None):
         kwargs = kwargs or {}
         if _has_wrapper(args, kwargs) or func in _FACTORY_FUNCTIONS:
             return _dispatch(func, args, kwargs)
         return func(*args, **kwargs)
+
+    def __enter__(self):
+        # NOTE: these are process-global patches (module attributes have no
+        # thread scope) — tracing from one thread while another runs real
+        # torch will leak trace semantics to it; tracing is assumed
+        # single-threaded, like torch.jit.trace itself. Patch ordering is
+        # exception-safe: state is saved before any mutation.
+        self._orig_vmap = torch.vmap
+        self._orig_is_tracing = torch.jit.is_tracing
+        torch.vmap = _traced_vmap
+        try:
+            torch.func.vmap = _traced_vmap
+        except Exception:
+            pass
+        # report as tracing: libraries (transformers mask utils) guard their
+        # data-dependent fast paths with torch.jit.is_tracing() — under duck
+        # tracing those branches must take the trace-safe route exactly as
+        # they would under torch.jit.trace
+        torch.jit.is_tracing = lambda: True
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        torch.vmap = self._orig_vmap
+        try:
+            torch.func.vmap = self._orig_vmap
+        except Exception:
+            pass
+        torch.jit.is_tracing = self._orig_is_tracing
+        return super().__exit__(*exc)
+
+
+_ORIG_TORCH_VMAP = torch.vmap
+
+
+def _traced_vmap(fn, in_dims=0, out_dims=0, randomness="error", **vmap_kw):
+    """torch.vmap stand-in during tracing: proxies go through the framework's
+    trace-level batching rules; real tensors go through real functorch."""
+
+    def wrapped(*args, **kwargs):
+        if not _has_wrapper(args, kwargs):
+            return _ORIG_TORCH_VMAP(fn, in_dims, out_dims, randomness,
+                                    **vmap_kw)(*args, **kwargs)
+        from thunder_tpu import _vmap_impl
+
+        def inner(*xs):
+            # kwargs map with in_dims=None (real torch.func.vmap semantics)
+            return _unwrap(fn(*_wrap(xs), **_wrap(kwargs)))
+
+        out = _vmap_impl(inner, in_axes=in_dims)(*_unwrap(args))
+
+        def move(o):
+            if out_dims in (0, None) or getattr(o, "ndim", 0) <= 1:
+                return o
+            d = int(out_dims) % o.ndim
+            perm = tuple(i for i in range(1, o.ndim))
+            perm = perm[:d] + (0,) + perm[d:]
+            return ops.transpose(o, perm)
+
+        from thunder_tpu.core.pytree import tree_map as _tm
+
+        out = _tm(move, out)
+        return _wrap(out)
+
+    return wrapped
 
 
 # ---------------------------------------------------------------------------
@@ -384,8 +453,25 @@ def _normalize_shape(shape) -> tuple:
 def _unwrap_out_tree(out):
     from thunder_tpu.core.pytree import tree_map
 
-    return tree_map(lambda x: x._p if isinstance(x, TorchProxy) else x, out,
-                    is_leaf=lambda x: isinstance(x, (TorchProxy, Proxy)))
+    out = tree_map(lambda x: x._p if isinstance(x, TorchProxy) else x, out,
+                   is_leaf=lambda x: isinstance(x, (TorchProxy, Proxy)))
+    # containers pytree doesn't traverse (HF ModelOutput subclasses are
+    # registered pytrees for torch but not for optree): convert to plain
+    # dicts so downstream trace machinery sees every proxy leaf
+    if type(out).__module__.startswith("transformers"):
+        try:
+            out = {k: _unwrap_out_tree(v) for k, v in out.items()}
+        except (AttributeError, TypeError):
+            # non-mapping containers (DynamicCache): unwrap attribute-wise
+            try:
+                out = {k: _unwrap_out_tree(v) for k, v in vars(out).items()
+                       if not k.startswith("_")}
+            except TypeError:
+                pass
+    elif isinstance(out, (tuple, list)) and any(
+            type(x).__module__.startswith("transformers") for x in out):
+        out = type(out)(_unwrap_out_tree(x) for x in out)
+    return out
 
 
 def _t_add(a, b, *, alpha=1, out=None):
@@ -805,7 +891,28 @@ def _t_addmm(input, m1, m2, *, beta=1, alpha=1):
 
 
 def _t_cat(tensors, dim=0, *, out=None):
-    return ops.cat(list(tensors), dim=dim)
+    # torch legacy special case: zero-element 1-D tensors are ignored by cat
+    # regardless of the other operands' rank (HF DynamicCache seeds its
+    # K/V with torch.tensor([]) and cats 4-D states onto it)
+    ts = [t for t in tensors
+          if not (getattr(t, "ndim", None) == 1 and int(t.shape[0]) == 0
+                  and any(getattr(o, "ndim", 1) != 1 for o in tensors))]
+    if len(ts) == 1:
+        return ts[0]
+    return ops.cat(ts, dim=dim)
+
+
+def _t_diff(a, n=1, dim=-1, prepend=None, append=None):
+    parts = [t for t in (prepend, a, append) if t is not None]
+    x = parts[0] if len(parts) == 1 else ops.cat(parts, dim=dim)
+    for _ in range(int(n)):
+        d = dim % x.ndim
+        hi = [slice(None)] * x.ndim
+        lo = [slice(None)] * x.ndim
+        hi[d] = slice(1, None)
+        lo[d] = slice(None, -1)
+        x = ops.sub(ops.getitem(x, tuple(hi)), ops.getitem(x, tuple(lo)))
+    return x
 
 
 def _t_stack(tensors, dim=0, *, out=None):
@@ -940,9 +1047,17 @@ def _make_simple(op):
 
 # -- registrations ----------------------------------------------------------
 
+# Only RANDOM factories trace unconditionally (they must consume the traced
+# RNG key). Deterministic factories over static shapes (arange/zeros/ones/…)
+# run as REAL torch at trace time: their values are trace constants, which
+# keeps index arithmetic and library mask-construction code (transformers
+# masking_utils: nested torch.vmap over index predicates, packed-sequence
+# detection) on concrete values — data-independent control flow stays
+# Python-decidable, and the results enter the trace via constant lifting.
+# A deterministic factory whose ARGS carry proxies still traces (the
+# _has_wrapper branch in _TraceMode).
 _FACTORY_FUNCTIONS = {
-    torch.arange, torch.zeros, torch.ones, torch.full, torch.empty, torch.tensor,
-    torch.rand, torch.randn, torch.eye, torch.linspace,
+    torch.rand, torch.randn,
 }
 
 for _tf, _fn in {
@@ -1023,6 +1138,7 @@ for _tf, _fn in {
     torch.movedim: _t_movedim, torch.moveaxis: _t_movedim,
     torch.swapaxes: _t_transpose, torch.swapdims: _t_transpose,
     torch.cat: _t_cat, torch.concat: _t_cat, torch.stack: _t_stack,
+    torch.diff: _t_diff,
     torch.split: _t_split, torch.chunk: _t_chunk, torch.unbind: _t_unbind,
     torch.narrow: _t_narrow, torch.select: _t_select,
     torch.tril: _t_tril, torch.triu: _t_triu,
