@@ -38,7 +38,7 @@ def test_hf_gpt2_forward_parity():
     out = tm(ids, use_cache=False)
     logits = _logits(out)
     arr = logits.detach().numpy() if isinstance(logits, torch.Tensor) else np.asarray(logits)
-    np.testing.assert_allclose(arr, ref.numpy(), atol=2e-3)
+    np.testing.assert_allclose(arr, ref.numpy(), atol=1e-4)
 
 
 def test_hf_gpt2_trains_with_unmodified_hf_loop():
@@ -104,7 +104,7 @@ def test_hf_bert_classifier_parity():
     out = tt.jit(m)(ids, attention_mask=attn)
     logits = _logits(out)
     arr = logits.detach().numpy() if isinstance(logits, torch.Tensor) else np.asarray(logits)
-    np.testing.assert_allclose(arr, ref.numpy(), atol=2e-3)
+    np.testing.assert_allclose(arr, ref.numpy(), atol=1e-4)
 
 
 def test_hf_llama_gqa_parity():
@@ -121,5 +121,25 @@ def test_hf_llama_gqa_parity():
     out = tt.jit(m)(ids, use_cache=False)
     logits = _logits(out)
     arr = logits.detach().numpy() if isinstance(logits, torch.Tensor) else np.asarray(logits)
-    # RoPE + GQA + 2 attention layers accumulate ~1% softmax-path noise
-    np.testing.assert_allclose(arr, ref.numpy(), atol=6e-3)
+    np.testing.assert_allclose(arr, ref.numpy(), atol=1e-4)
+
+
+def test_hf_t5_encoder_decoder_parity():
+    """Encoder-decoder family: T5 (relative position buckets, T5LayerNorm,
+    cross attention) traces to exact parity (conftest pins full matmul
+    precision; looser tolerances in ad-hoc runs come from XLA-CPU's oneDNN
+    bf16 fastmath, not the framework)."""
+    from transformers import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config(d_model=64, d_ff=128, num_layers=2, num_heads=4, vocab_size=256,
+                   d_kv=16, dropout_rate=0.0)
+    torch.manual_seed(0)
+    m = T5ForConditionalGeneration(cfg).eval()
+    ids = torch.randint(0, 256, (2, 10))
+    dec = torch.randint(0, 256, (2, 6))
+    with torch.no_grad():
+        ref = m(input_ids=ids, decoder_input_ids=dec, use_cache=False).logits
+    out = tt.jit(m)(input_ids=ids, decoder_input_ids=dec, use_cache=False)
+    logits = _logits(out)
+    arr = logits.detach().numpy() if isinstance(logits, torch.Tensor) else np.asarray(logits)
+    np.testing.assert_allclose(arr, ref.numpy(), atol=1e-4)

@@ -319,6 +319,12 @@ class TorchProxy:
     def element_size(self) -> int:
         return self._p.dtype.bytes
 
+    def is_floating_point(self) -> bool:
+        return self._p.dtype.is_float
+
+    def is_complex(self) -> bool:
+        return self._p.dtype.is_complex
+
     def __len__(self) -> int:
         check(self._p.ndim > 0, "len() of a 0-d tensor")
         return int(self._p.shape[0])
