@@ -330,3 +330,47 @@ def test_vmap_pytree_args_and_argmax():
     got = tt.jit(lambda xs: tt.vmap(lambda x: ops.argmax(x))(xs))(xs3)
     ref = jax.vmap(lambda x: jnp.argmax(x))(xs3)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+
+
+def test_jvp_dynamic_slice_family():
+    """Forward-mode rules for the dynamic-slice prims (added alongside their
+    round-2 VJPs)."""
+    import jax
+    import jax.numpy as jnp
+    from thunder_tpu.core import prims
+
+    rng = np.random.RandomState(0)
+    a = rng.rand(4, 6).astype(np.float32)
+    u = rng.rand(2, 3).astype(np.float32)
+    ta, tu = np.ones_like(a), np.ones_like(u)
+
+    def f(a):
+        return ops.sum(ops.square(prims.dynamic_slice(a, (1, 2), (2, 3))))
+
+    _, tang = tt.jit(tt.jvp(f))((a,), (ta,))
+    ref = jax.jvp(lambda a: (jax.lax.dynamic_slice(a, (1, 2), (2, 3)) ** 2).sum(),
+                  (jnp.asarray(a),), (jnp.asarray(ta),))
+    np.testing.assert_allclose(np.asarray(tang), np.asarray(ref[1]), rtol=1e-5)
+
+    def g(a, u):
+        return ops.sum(ops.square(prims.dynamic_update_slice(a, u, (1, 2))))
+
+    _, tang = tt.jit(tt.jvp(g))((a, u), (ta, tu))
+    ref = jax.jvp(lambda a, u: (jax.lax.dynamic_update_slice(a, u, (1, 2)) ** 2).sum(),
+                  (jnp.asarray(a), jnp.asarray(u)), (jnp.asarray(ta), jnp.asarray(tu)))
+    np.testing.assert_allclose(np.asarray(tang), np.asarray(ref[1]), rtol=1e-5)
+
+
+def test_jvp_detach_stops_tangents():
+    """Code-review r2: detach is stop_gradient in forward mode too —
+    jvp(x * detach(x)) must give x*t, not 2*x*t."""
+    import jax
+    import jax.numpy as jnp
+    from thunder_tpu.core import prims
+
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    t = np.ones_like(x)
+    _, tang = tt.jit(tt.jvp(lambda x: ops.sum(ops.mul(x, prims.detach(x)))))((x,), (t,))
+    ref = jax.jvp(lambda x: (x * jax.lax.stop_gradient(x)).sum(),
+                  (jnp.asarray(x),), (jnp.asarray(t),))
+    np.testing.assert_allclose(np.asarray(tang), np.asarray(ref[1]), rtol=1e-6)

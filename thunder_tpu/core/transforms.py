@@ -1024,6 +1024,7 @@ _SINGLE_LINEAR_PRIMS = {
     PrimIDs.NEG, PrimIDs.BROADCAST_IN_DIM, PrimIDs.RESHAPE, PrimIDs.SQUEEZE,
     PrimIDs.TRANSPOSE, PrimIDs.SLICE, PrimIDs.FLIP, PrimIDs.SUM, PrimIDs.CUMSUM,
     PrimIDs.TAKE, PrimIDs.TAKE_ALONG_AXIS, PrimIDs.CONVERT_ELEMENT_TYPE,
+    PrimIDs.DYNAMIC_SLICE,
 }
 
 # bilinear prims: tangent = op(t_a, b) + op(a, t_b)
@@ -1130,6 +1131,14 @@ def jvp_call(fn, primals: tuple, tangents: tuple):
                         continue
                     term = op_with(i, t)
                     t_out = term if t_out is None else ops.add(t_out, term)
+            elif sym_id is PrimIDs.DETACH:
+                t_out = None  # stop_gradient kills tangents in forward mode too
+            elif sym_id is PrimIDs.DYNAMIC_UPDATE_SLICE:
+                # jointly linear in (operand, update); start indices constant
+                a_, u_ = margs[0], margs[1]
+                ta = arg_tans[0] if arg_tans[0] is not None else ops.zeros_like(a_)
+                tu = arg_tans[1] if arg_tans[1] is not None else ops.zeros_like(u_)
+                t_out = prims.dynamic_update_slice(ta, tu, margs[2])
             elif sym_id is PrimIDs.CUMPROD:
                 t_out = prims.cumprod_tangent(flat_margs[0], arg_tans[0], margs[1])
             elif sym_id in (PrimIDs.SCATTER, PrimIDs.SCATTER_ADD, PrimIDs.INDEX_ADD):
