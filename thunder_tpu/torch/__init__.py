@@ -1355,10 +1355,26 @@ def functional_call(module: torch.nn.Module, params_and_buffers: dict,
     """Traceable functional invocation of a torch module (analog of
     ``torch.func.functional_call``): usable inside ``thunder_tpu.jit`` /
     ``grad`` with params as explicit (differentiable) inputs. Returns
-    ``(output, mutated_buffers)``."""
+    ``(output, mutated_buffers)``.
+
+    Tied weights: ``named_parameters()`` dedups shared tensors (GPT-2's
+    ``lm_head.weight`` IS ``transformer.wte.weight``), so a params dict built
+    from it lacks the duplicate names. Every duplicate site is routed to its
+    canonical entry, keeping the tie — and the gradient flow through both
+    uses — intact (same handling as ``ThunderModule._tied``)."""
     buffer_names = {k for k, _ in module.named_buffers()}
     params = {k: v for k, v in params_and_buffers.items() if k not in buffer_names}
     buffers = {k: v for k, v in params_and_buffers.items() if k in buffer_names}
+    by_id: dict[int, str] = {}
+    for k, v in list(module.named_parameters(remove_duplicate=False)) \
+            + list(module.named_buffers(remove_duplicate=False)):
+        canon = by_id.get(id(v))
+        if canon is None:
+            by_id[id(v)] = k
+            continue
+        tgt = buffers if canon in buffers else params
+        if k not in params and k not in buffers and canon in tgt:
+            tgt[k] = tgt[canon]
     prev_training = module.training
     if training is not None:
         module.train(training)
