@@ -541,3 +541,84 @@ def test_bridge_duplicate_output_cotangents_accumulate():
     for p, pr in zip(m.parameters(), m_ref.parameters()):
         np.testing.assert_allclose(p.grad.numpy(), pr.grad.numpy(),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_setitem_functionalization():
+    """``x[idx] = val`` traces functionally (no COPY_), covering the
+    shift-right pattern HF decoder preprocessing uses."""
+
+    def shift_right(x):
+        shifted = torch.zeros_like(x)
+        shifted[..., 1:] = x[..., :-1].clone()
+        shifted[..., 0] = 7
+        return shifted
+
+    x = torch.randint(0, 100, (2, 6))
+    got = ttorch.jit(shift_right)(x)
+    assert np.array_equal(_np(got), shift_right(x).numpy())
+
+    def sl(x):
+        y = x.clone()
+        y[1, 2:4] = -1.0
+        y[0] = y[0] * 2
+        return y
+
+    xf = torch.randn(3, 5)
+    np.testing.assert_allclose(_np(ttorch.jit(sl)(xf)), sl(xf).numpy(), atol=1e-6)
+
+    # tensor-index assignment routes through index_put
+    def ti(x, i):
+        y = x.clone()
+        y[i] = 0.0
+        return y
+
+    i = torch.tensor([0, 2])
+    np.testing.assert_allclose(_np(ttorch.jit(ti)(xf, i)), ti(xf, i).numpy(), atol=1e-6)
+
+    # grads flow through the write (the overwritten region gets zero grad)
+    import thunder_tpu as tt
+    from thunder_tpu import ops as tops
+
+    def loss(x):
+        y = x.clone()
+        y[..., 0] = 0.0
+        return (y * y).sum()
+
+    xg = torch.randn(3, 4, requires_grad=True)
+    out = loss(xg)
+    out.backward()
+    g = tt.jit(tt.grad(lambda a: tops.sum(tops.square(
+        tops.setitem(a, (Ellipsis, 0), 0.0)))))(xg.detach().numpy())
+    np.testing.assert_allclose(np.asarray(g), xg.grad.numpy(), atol=1e-6)
+
+
+def test_setitem_edge_semantics():
+    """Code-review r2: chained subscript writes raise (silent no-op before),
+    OOB indices raise IndexError (torch contract), scalar-tensor values
+    broadcast, boolean masks are rejected with guidance."""
+    import pytest as _pytest
+    import thunder_tpu as tt
+    from thunder_tpu import ops as tops
+
+    def chained(y):
+        z = y.clone()
+        z[0][1] = 5.0
+        return z
+
+    with _pytest.raises(NotImplementedError, match="chained subscript"):
+        ttorch.jit(chained)(torch.randn(3, 4))
+
+    with _pytest.raises(IndexError, match="out of range"):
+        thunder_tpu.jit(lambda a: tops.setitem(a, 5, 0.0))(np.zeros((3, 4), np.float32))
+
+    def f(x):
+        y = x.clone()
+        y[:, 0] = x.sum()
+        return y
+
+    xf = torch.randn(3, 4)
+    np.testing.assert_allclose(_np(ttorch.jit(f)(xf)), f(xf).numpy(), atol=1e-5)
+
+    with _pytest.raises(NotImplementedError, match="boolean-mask"):
+        thunder_tpu.jit(lambda a, m: tops.setitem(a, m, 0.0))(
+            np.zeros((4,), np.float32), np.array([True, False, True, False]))

@@ -139,8 +139,11 @@ def examine_torch(fn, *args, **kwargs) -> dict:
                 is_method = (name or "").startswith("torch.Tensor.")
                 from thunder_tpu.torch import TorchProxy
 
-                proxy_dunder = meth.startswith("__") and hasattr(TorchProxy, meth)
-                if not (is_method and (meth in _TENSOR_METHODS or proxy_dunder)):
+                # methods implemented directly on the proxy class (dim, size,
+                # __getitem__, is_floating_point, ...) are supported even
+                # though they bypass the method table
+                proxy_attr = bool(meth) and hasattr(TorchProxy, meth)
+                if not (is_method and (meth in _TENSOR_METHODS or proxy_attr)):
                     unsupported[name] += 1
             return func(*f_args, **(f_kwargs or {}))
 

@@ -639,6 +639,73 @@ def index_add(a, dim, index, src, *, alpha=1):
     return prims.index_add(a, index, src, d)
 
 
+def setitem(a, idx, val):
+    """Functional ``a[idx] = val`` for BASIC indexing (ints, step-1 slices,
+    Ellipsis, full slices): returns the updated tensor. The torch dialect's
+    ``TorchProxy.__setitem__`` rebinds through this (functionalization —
+    no COPY_ ever traced, reference ``functionalize_inplace_ops``).
+    Integer-tensor indices route to ``index_put``."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    idx = tuple(_lift_arrays(i) if _is_arraylike_idx(i) else i for i in idx)
+    if any(isinstance(i, TensorProxy) for i in idx):
+        check(all(isinstance(i, TensorProxy) for i in idx),
+              "setitem: mixing tensor and slice indices is not supported; "
+              "index with tensors only or slices only", NotImplementedError)
+        check(all(i.dtype is not dtypes.bool8 for i in idx),
+              "setitem: boolean-mask assignment is not supported (the index_put "
+              "VJP would misread the mask as integer indices); use ops.where",
+              NotImplementedError)
+        return index_put(a, idx, val, accumulate=False)
+    # expand Ellipsis
+    n_spec = len([i for i in idx if i is not Ellipsis])
+    idx = tuple(
+        j for i in idx
+        for j in ((slice(None),) * (a.ndim - n_spec) if i is Ellipsis else (i,)))
+    idx = idx + (slice(None),) * (a.ndim - len(idx))
+    check(len(idx) == a.ndim, lambda: f"setitem: too many indices for rank {a.ndim}")
+
+    starts, sizes, keep_dim = [], [], []
+    for d, i in enumerate(idx):
+        n = int(a.shape[d])
+        if isinstance(i, int):
+            check(n > 0 and -n <= i < n,
+                  lambda: f"setitem: index {i} out of range for dim {d} (size {n})",
+                  IndexError)
+            ii = i % n
+            starts.append(ii)
+            sizes.append(1)
+            keep_dim.append(False)
+        elif isinstance(i, slice):
+            s0, e0, st = i.indices(n)
+            check(st == 1, "setitem: only step-1 slices supported", NotImplementedError)
+            starts.append(s0)
+            sizes.append(max(e0 - s0, 0))
+            keep_dim.append(True)
+        else:
+            check(False, lambda: f"setitem: unsupported index {i!r}", NotImplementedError)
+
+    region_shape = tuple(sizes)
+    if isinstance(val, TensorProxy):
+        # align val to the region: insert the dims ints dropped
+        v = val
+        for d, kd in enumerate(keep_dim):
+            if not kd and v.ndim < len(region_shape):
+                v = unsqueeze(v, min(d, v.ndim))
+        if v.ndim < len(region_shape):  # sub-rank values right-align
+            v = reshape(v, (1,) * (len(region_shape) - v.ndim) + tuple(v.shape))
+        v = broadcast_to(v, region_shape) if tuple(v.shape) != region_shape else v
+    else:
+        v = full(region_shape, val, dtype=a.dtype)
+    v = convert_element_type(v, a.dtype)
+    return prims.dynamic_update_slice(a, v, tuple(starts))
+
+
+def _is_arraylike_idx(i):
+    return (not isinstance(i, (int, slice, type(Ellipsis), type(None)))
+            and hasattr(i, "shape") and hasattr(i, "dtype"))
+
+
 def index_put(a, indices, values, accumulate=False):
     return prims.index_put(a, tuple(indices), values, bool(accumulate))
 

@@ -255,7 +255,7 @@ class TorchProxy:
     TensorProxy; all torch functions/methods/operators on it record trace
     operations. In-place methods rebind ``_p`` (functionalization)."""
 
-    __slots__ = ("_p", "_orig_p")
+    __slots__ = ("_p", "_orig_p", "_subscript_view")
 
     def __init__(self, p: TensorProxy):
         object.__setattr__(self, "_p", p)
@@ -422,7 +422,20 @@ class TorchProxy:
         return id(self)
 
     def __getitem__(self, idx):
-        return _wrap(ops.getitem(self._p, _unwrap(idx)))
+        out = _wrap(ops.getitem(self._p, _unwrap(idx)))
+        if isinstance(out, TorchProxy):
+            # mark subscript results: writing through them (y[i][j] = v)
+            # cannot reach the base tensor under functionalization
+            object.__setattr__(out, "_subscript_view", True)
+        return out
+
+    def __setitem__(self, idx, val):
+        check(not getattr(self, "_subscript_view", False),
+              "chained subscript assignment (y[i][j] = v) cannot write through "
+              "to the base tensor under functional tracing; index in one step "
+              "(y[i, j] = v)", NotImplementedError)
+        # functionalized in-place write: rebind the underlying proxy
+        object.__setattr__(self, "_p", ops.setitem(self._p, _unwrap(idx), _unwrap(val)))
 
     # -- methods (delegate to the method table) ----------------------------
     def __getattr__(self, name: str):
