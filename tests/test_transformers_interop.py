@@ -205,3 +205,23 @@ def test_hf_gpt2_trains_under_fsdp(eight_devices):
         l, p, s = js(p, s, ids, tgt)
         losses.append(float(np.asarray(l)))
     np.testing.assert_allclose(ref_losses, losses, atol=1e-5, rtol=1e-5)
+
+
+def test_hf_vit_parity():
+    """Vision family: ViT (conv patch embedding + CLS token + encoder)
+    traces to exact parity."""
+    from transformers import ViTConfig, ViTForImageClassification
+
+    cfg = ViTConfig(hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=128, image_size=32, patch_size=8,
+                    num_channels=3, num_labels=5, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    m = ViTForImageClassification(cfg).eval()
+    x = torch.randn(2, 3, 32, 32)
+    with torch.no_grad():
+        ref = m(x).logits
+    out = tt.jit(m)(x)
+    logits = _logits(out)
+    arr = logits.detach().numpy() if isinstance(logits, torch.Tensor) else np.asarray(logits)
+    np.testing.assert_allclose(arr, ref.numpy(), atol=1e-4)
