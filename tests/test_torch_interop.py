@@ -622,3 +622,56 @@ def test_setitem_edge_semantics():
     with _pytest.raises(NotImplementedError, match="boolean-mask"):
         thunder_tpu.jit(lambda a, m: tops.setitem(a, m, 0.0))(
             np.zeros((4,), np.float32), np.array([True, False, True, False]))
+
+
+def test_function_bridge_loss_backward():
+    """thunder.jit(fn) (a FUNCTION, not a module) is differentiable through
+    torch autograd too — reference parity for the function-training UX."""
+
+    def fn(x, w):
+        return torch.tanh(x @ w).pow(2).sum()
+
+    torch.manual_seed(0)
+    x = torch.randn(4, 5)
+    w = torch.randn(5, 3, requires_grad=True)
+    w_ref = w.detach().clone().requires_grad_(True)
+
+    jf = ttorch.jit(fn)
+    loss = jf(x, w)
+    assert isinstance(loss, torch.Tensor) and loss.grad_fn is not None
+    loss.backward()
+    fn(x, w_ref).backward()
+    np.testing.assert_allclose(w.grad.numpy(), w_ref.grad.numpy(), atol=1e-4, rtol=1e-4)
+
+    # compiled once, reused across calls
+    w.grad = None
+    jf(x, w).backward()
+    assert len(jf._autograd_cache) == 1
+    np.testing.assert_allclose(w.grad.numpy(), w_ref.grad.numpy(), atol=1e-4, rtol=1e-4)
+
+    # no-grad calls keep the jax fast path (back-compat)
+    with torch.no_grad():
+        out = jf(x, w.detach())
+    assert not isinstance(out, torch.Tensor)
+
+
+def test_function_bridge_opt_out_and_weighted_mse():
+    """torch_autograd=False keeps the pure-jax path for functions too; the
+    weighted F.mse_loss matches eager torch (sum(w*d^2)/sum(w) for mean)."""
+
+    def fn(x, w):
+        return torch.tanh(x @ w).sum()
+
+    x = torch.randn(3, 4)
+    w = torch.randn(4, 2, requires_grad=True)
+    jf = ttorch.jit(fn, torch_autograd=False)
+    out = jf(x, w)
+    assert not isinstance(out, torch.Tensor)  # jax output, no bridge
+
+    a, b, wt = torch.randn(4, 3), torch.randn(4, 3), torch.rand(4, 3)
+    try:
+        ref = F.mse_loss(a, b, weight=wt)
+    except TypeError:
+        pytest.skip("this torch has no weighted mse_loss")
+    got = ttorch.jit(lambda a, b, wt: F.mse_loss(a, b, weight=wt))(a, b, wt)
+    np.testing.assert_allclose(_np(got), float(ref), atol=1e-5)

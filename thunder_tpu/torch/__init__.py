@@ -779,7 +779,14 @@ def _t_nll_loss(logp, target, weight=None, size_average=None, ignore_index=-100,
     return ops.true_divide(total, denom)
 
 
-def _t_mse_loss(input, target, size_average=None, reduce=None, reduction="mean"):
+def _t_mse_loss(input, target, size_average=None, reduce=None, reduction="mean",
+                weight=None):
+    if weight is not None:
+        d = ops.sub(input, target)
+        sq = ops.mul(ops.mul(d, d), weight)
+        if reduction == "mean":
+            return ops.true_divide(ops.sum(sq), ops.sum(ops.mul(ops.ones_like(d), weight)))
+        return ops.sum(sq) if reduction == "sum" else sq
     return ops_nn.mse_loss(input, target, reduction=reduction)
 
 
@@ -1629,16 +1636,39 @@ def jit(module_or_fn, **jit_kwargs):
         return _unwrap_out_tree(out)
 
     traced.__name__ = getattr(fn, "__name__", "fn")
-    return _ConvertingWrapper(_jit(traced, **jit_kwargs))
+    use_bridge = jit_kwargs.pop("torch_autograd", True)
+    return _ConvertingWrapper(_jit(traced, **jit_kwargs),
+                              torch_fn=fn if use_bridge else None)
 
 
 class _ConvertingWrapper:
-    """Converts torch-tensor args to jax before invoking the compiled fn."""
+    """Converts torch-tensor args to jax before invoking the compiled fn.
+    When grad mode is on and a torch-tensor input requires grad, the call
+    routes through the autograd bridge instead: outputs are autograd-tracked
+    torch tensors and ``loss.backward()`` runs the compiled backward (the
+    reference's ``thunder.jit(fn)`` function-training UX)."""
 
-    def __init__(self, jfn):
+    def __init__(self, jfn, torch_fn=None):
         self._jfn = jfn
+        self._torch_fn = torch_fn
+        self._autograd_cache: dict = {}
 
     def __call__(self, *args, **kwargs):
+        if self._torch_fn is not None and torch.is_grad_enabled():
+            from thunder_tpu.core.pytree import tree_flatten as _tf
+
+            flat, _ = _tf((args, kwargs))
+            needs = any(isinstance(l, torch.Tensor) and l.requires_grad for l in flat)
+            others = any(not isinstance(l, torch.Tensor) and hasattr(l, "shape")
+                         and hasattr(l, "dtype") for l in flat)
+            if needs and not others:
+                from thunder_tpu.torch.autograd_bridge import (
+                    call_function_with_torch_autograd,
+                )
+
+                return call_function_with_torch_autograd(
+                    self._torch_fn, args, kwargs, self._autograd_cache,
+                    self._jfn.executors)
         args, kwargs = _args_to_jax(args, kwargs)
         return self._jfn(*args, **kwargs)
 

@@ -68,6 +68,51 @@ def _apply_execution_pipeline(trc: TraceCtx, executors):
     return del_last_used(trc)
 
 
+def _finalize_step(step: CompiledAutogradStep, trc: TraceCtx, full_out, executors,
+                   provenance: str):
+    """Shared tail of both bridge compilers: rng bookkeeping, output slot
+    maps, fwd/bwd split, execution pipeline, jax.jit."""
+    import jax
+
+    step.uses_rng = getattr(trc, "rng_input_proxy", None) is not None
+    if step.uses_rng:
+        trc.args.append(trc.rng_input_proxy)
+    step.n_trace_args = len(trc.args)
+    trc.output = full_out
+    trc.set_provenance(provenance)
+    step.computation_trace = trc
+
+    out_flat, out_treedef = tree_flatten(full_out)
+    step.out_treedef = out_treedef
+    step.out_tensor_slots = [
+        i for i, o in enumerate(out_flat) if isinstance(o, TensorProxy)]
+    step.out_float_slots = [
+        i for i, o in enumerate(out_flat)
+        if isinstance(o, TensorProxy) and o.dtype.is_inexact]
+
+    fwd, bwd, _saved = forward_and_backward_from_trace(trc)
+    fwd = _apply_execution_pipeline(fwd, executors)
+    bwd = _apply_execution_pipeline(bwd, executors)
+    step.fwd_trace, step.bwd_trace = fwd, bwd
+    step.fwd_fn = jax.jit(fwd.python_callable())
+    step.bwd_fn = jax.jit(bwd.python_callable())
+    return step
+
+
+def _args_cache_key(flat, treedef, extra=()):
+    """Signature key over flattened inputs: tensor leaves by (shape, dtype),
+    primitives by value; non-primitive leaves cannot reach the bridge (the
+    callers gate on pure-torch inputs)."""
+    parts = list(extra)
+    for leaf in flat:
+        if isinstance(leaf, torch.Tensor):
+            parts.append(("T", tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            parts.append(("L", leaf if isinstance(leaf, (int, float, str, bool,
+                                                         type(None))) else str(leaf)))
+    return (treedef, tuple(parts))
+
+
 def compile_autograd_step(tm, args: tuple, kwargs: dict) -> CompiledAutogradStep:
     """Trace ``tm``'s torch module functionally, split fwd/bwd, compile both.
 
@@ -134,30 +179,8 @@ def compile_autograd_step(tm, args: tuple, kwargs: dict) -> CompiledAutogradStep
         prims.python_return(full_out)
 
     trc.args = list(proxies)
-    step.uses_rng = getattr(trc, "rng_input_proxy", None) is not None
-    if step.uses_rng:
-        trc.args.append(trc.rng_input_proxy)
-    step.n_trace_args = len(trc.args)
-    trc.output = full_out
-    trc.set_provenance("Tracing (torch-autograd bridge)")
-    step.computation_trace = trc
-
-    # output bookkeeping BEFORE the split (proxy identities)
-    out_flat, out_treedef = tree_flatten(full_out)
-    step.out_treedef = out_treedef
-    step.out_tensor_slots = [
-        i for i, o in enumerate(out_flat) if isinstance(o, TensorProxy)]
-    step.out_float_slots = [
-        i for i, o in enumerate(out_flat)
-        if isinstance(o, TensorProxy) and o.dtype.is_inexact]
-
-    fwd, bwd, _saved = forward_and_backward_from_trace(trc)
-    fwd = _apply_execution_pipeline(fwd, tm._jfn.executors)
-    bwd = _apply_execution_pipeline(bwd, tm._jfn.executors)
-    step.fwd_trace, step.bwd_trace = fwd, bwd
-    step.fwd_fn = jax.jit(fwd.python_callable())
-    step.bwd_fn = jax.jit(bwd.python_callable())
-    return step
+    return _finalize_step(step, trc, full_out, tm._jfn.executors,
+                          "Tracing (torch-autograd bridge)")
 
 
 class ThunderFunction(torch.autograd.Function):
@@ -242,16 +265,10 @@ def call_with_torch_autograd(tm, args: tuple, kwargs: dict):
     from thunder_tpu.torch import tensor_to_jax
 
     flat, treedef = tree_flatten((args, kwargs))
-    key_parts = [tm._training]
-    for leaf in flat:
-        if isinstance(leaf, torch.Tensor):
-            key_parts.append(("T", tuple(leaf.shape), str(leaf.dtype)))
-        else:
-            key_parts.append(("L", leaf if isinstance(leaf, (int, float, str, bool, type(None))) else str(leaf)))
     module = tm._torch_module
-    for _, t in list(module.named_parameters()) + list(module.named_buffers()):
-        key_parts.append((tuple(t.shape), str(t.dtype)))
-    key = (treedef, tuple(key_parts))
+    state_sig = tuple((tuple(t.shape), str(t.dtype)) for _, t in
+                      list(module.named_parameters()) + list(module.named_buffers()))
+    key = _args_cache_key(flat, treedef, extra=(tm._training, state_sig))
     step = tm._autograd_cache.get(key)
     if step is None:
         step = compile_autograd_step(tm, args, kwargs)
@@ -278,4 +295,71 @@ def call_with_torch_autograd(tm, args: tuple, kwargs: dict):
                 if tgt is not None:
                     src = val if isinstance(val, torch.Tensor) else jax_to_tensor(val)
                     tgt.copy_(src.to(tgt.dtype).reshape(tgt.shape))
+    return user_out
+
+
+# ---------------------------------------------------------------------------
+# function-level bridge: loss.backward() through jitted torch FUNCTIONS
+# (the reference's thunder.jit(fn) trains too, not only modules)
+# ---------------------------------------------------------------------------
+
+def compile_function_autograd_step(fn, args: tuple, kwargs: dict,
+                                   executors) -> CompiledAutogradStep:
+    """Trace a torch-calling function, split fwd/bwd, compile both. Trace-arg
+    order: tensor leaves of (args, kwargs) in flatten order (+ RNG key)."""
+    import jax
+
+    from thunder_tpu.torch import _TraceMode, _unwrap_out_tree, _wrap, to_thunder_dtype
+
+    step = CompiledAutogradStep()
+    step.n_params = 0
+    step.n_buffers = 0
+    step.mutated_names = []
+    step.n_mutated = 0
+
+    flat, treedef = tree_flatten((args, kwargs))
+    step.args_treedef = treedef
+    step.n_flat_args = len(flat)
+    step.tensor_arg_positions = [
+        i for i, leaf in enumerate(flat) if isinstance(leaf, torch.Tensor)]
+
+    trc = TraceCtx("computation")
+    proxies: list[TensorProxy] = []
+    with tracectx(trc):
+        pflat = list(flat)
+        for i in step.tensor_arg_positions:
+            t = flat[i]
+            p = TensorProxy(shape=tuple(t.shape), dtype=to_thunder_dtype(t.dtype))
+            pflat[i] = p
+            proxies.append(p)
+        pargs, pkwargs = tree_unflatten(treedef, pflat)
+        with _TraceMode():
+            out = _wrap(fn(*_wrap(pargs), **_wrap(pkwargs)))
+        out = _unwrap_out_tree(out)
+        full_out = (out, ())
+        prims.python_return(full_out)
+
+    trc.args = list(proxies)
+    return _finalize_step(step, trc, full_out, executors,
+                          "Tracing (torch-autograd bridge, function)")
+
+
+def call_function_with_torch_autograd(fn, args: tuple, kwargs: dict,
+                                      cache: dict, executors):
+    """Bridge body for jitted torch functions: outputs are autograd-tracked
+    torch tensors; backward runs the compiled bwd trace."""
+    flat, treedef = tree_flatten((args, kwargs))
+    key = _args_cache_key(flat, treedef)
+    step = cache.get(key)
+    if step is None:
+        step = compile_function_autograd_step(fn, args, kwargs, executors)
+        cache[key] = step
+
+    tensor_args = [flat[i] for i in step.tensor_arg_positions]
+    holder: dict = {}
+    outs = ThunderFunction.apply(step, holder, (), *tensor_args)
+    out_flat = list(holder.pop("out_flat"))
+    for slot, t in zip(step.out_tensor_slots, outs):
+        out_flat[slot] = t
+    user_out, _ = tree_unflatten(step.out_treedef, out_flat)
     return user_out
