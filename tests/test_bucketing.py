@@ -1,0 +1,192 @@
+"""Shape-polymorphic caching via sequence bucketing.
+
+The reference handles ragged shapes with SYMBOLIC_VALUES constraint machinery
+(``thunder/core/proxies.py:624-1136``, ``thunder/core/options.py:95``); on TPU
+the idiomatic answer is a fixed ladder of compiled lengths: ``jit(fn,
+seq_buckets=...)`` pads tensor args to the ladder and passes the true length
+as a 0-d ``seq_len`` tensor so masking stays exact. Compilations are bounded
+by the ladder size regardless of how many distinct lengths arrive.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+
+def _masked_mse(tokens, targets, seq_len=None):
+    x = ops.convert_element_type(tokens, dtypes.float32)
+    t = ops.convert_element_type(targets, dtypes.float32)
+    sq = ops.mul(ops.sub(x, t), ops.sub(x, t))
+    pos = ops.arange(tokens.shape[1])
+    mk = ops.convert_element_type(ops.lt(pos, seq_len), dtypes.float32)
+    sq = ops.mul(sq, ops.unsqueeze(mk, 0))
+    denom = ops.mul(ops.sum(mk, None), float(tokens.shape[0]))
+    return ops.div(ops.sum(sq, None), denom)
+
+
+class TestJitSeqBuckets:
+    def test_twenty_lengths_bounded_compiles_exact_loss(self):
+        jfn = tt.jit(_masked_mse, seq_buckets=(128, 256, 512))
+        rng = np.random.RandomState(0)
+        lengths = rng.randint(1, 513, size=20)
+        for L in lengths:
+            a = rng.randn(2, L).astype(np.float32)
+            b = rng.randn(2, L).astype(np.float32)
+            got = float(jfn(a, b))
+            want = float(np.mean((a - b) ** 2))
+            assert got == pytest.approx(want, rel=1e-5)
+        assert tt.cache_misses(jfn) <= 3
+        assert tt.cache_hits(jfn) == 20 - tt.cache_misses(jfn)
+
+    def test_seq_len_not_injected_when_fn_lacks_it(self):
+        def plain_sum(a):
+            return ops.sum(a, None)
+
+        jfn = tt.jit(plain_sum, seq_buckets=(8, 16))
+        out = float(jfn(np.ones((2, 5), np.float32)))
+        assert out == pytest.approx(10.0)  # zero padding is sum-neutral
+        assert tt.cache_misses(jfn) == 1
+
+    def test_seq_argnums_selects_padded_args(self):
+        # train-step shape: fn(params, tokens) — params must NOT be padded
+        def fn(w, tokens, seq_len=None):
+            x = ops.convert_element_type(tokens, dtypes.float32)
+            pos = ops.arange(tokens.shape[1])
+            mk = ops.convert_element_type(ops.lt(pos, seq_len), dtypes.float32)
+            return ops.mul(ops.sum(ops.mul(x, mk), None), ops.sum(w, None))
+
+        w = np.ones((3,), np.float32)  # would fail the length check if padded
+        jfn = tt.jit(fn, seq_buckets=(8, 32), seq_argnums=(1,))
+        for L in (3, 5, 8, 20, 31):
+            toks = np.ones((4, L), np.float32)
+            assert float(jfn(w, toks)) == pytest.approx(4 * L * 3)
+        assert tt.cache_misses(jfn) == 2
+
+    def test_inconsistent_lengths_loud_error(self):
+        def fn(a, b):
+            return ops.add(a, b)
+
+        jfn = tt.jit(fn, seq_buckets=(8,))
+        with pytest.raises(RuntimeError, match="disagree on the sequence"):
+            jfn(np.ones((2, 3), np.float32), np.ones((2, 4), np.float32))
+
+    def test_over_ladder_raises(self):
+        jfn = tt.jit(lambda a: ops.sum(a, None), seq_buckets=(8,))
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            jfn(np.ones((2, 9), np.float32))
+
+
+class TestModuleSeqBuckets:
+    def test_torch_module_bucketing(self):
+        torch = pytest.importorskip("torch")
+        import thunder_tpu.torch as ttorch
+
+        class MaskedMean(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.w = torch.nn.Parameter(torch.ones(()))
+
+            def forward(self, x, mask):
+                m = mask.to(x.dtype)
+                return (x * m * self.w).sum() / m.sum()
+
+        jm = ttorch.jit(MaskedMean(), seq_buckets=(8, 16))
+        rng = np.random.RandomState(1)
+        with torch.no_grad():
+            for L in (3, 5, 8, 11, 16, 7, 13):
+                x = torch.tensor(rng.randn(2, L).astype(np.float32))
+                mask = torch.ones(2, L)
+                got = float(jm(x, mask))
+                assert got == pytest.approx(float(x.mean()), rel=1e-5)
+        assert tt.cache_misses(jm._jfn) <= 2
+
+    def test_module_kwargs_mask_padded_too(self):
+        # HF-idiomatic keyword mask: module(x, mask=mask) must pad BOTH
+        torch = pytest.importorskip("torch")
+        import thunder_tpu.torch as ttorch
+
+        class MaskedMean(torch.nn.Module):
+            def forward(self, x, mask=None):
+                m = mask.to(x.dtype)
+                return (x * m).sum() / m.sum()
+
+        jm = ttorch.jit(MaskedMean(), seq_buckets=(8, 16))
+        with torch.no_grad():
+            for L in (3, 11, 6):
+                x = torch.ones(2, L)
+                assert float(jm(x, mask=torch.ones(2, L))) == pytest.approx(1.0)
+
+    def test_module_bridge_training_is_bucketed(self):
+        # grad-enabled path routes through the torch-autograd bridge; padding
+        # must happen there too so training over ragged lengths stays bounded
+        torch = pytest.importorskip("torch")
+        import thunder_tpu.torch as ttorch
+
+        class MaskedScore(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.w = torch.nn.Parameter(torch.ones(()))
+
+            def forward(self, x, mask):
+                m = mask.to(x.dtype)
+                return ((x * self.w) * m).sum() / m.sum()
+
+        jm = ttorch.jit(MaskedScore(), seq_buckets=(8, 16))
+        for L in (3, 5, 11, 7, 13):
+            x = torch.ones(2, L)
+            loss = jm(x, torch.ones(2, L))
+            loss.backward()
+            # d/dw of mean(x*w) with x=1 is 1
+            assert float(jm._torch_module.w.grad) == pytest.approx(1.0)
+            jm._torch_module.w.grad = None
+        # bridge compiles are keyed per padded shape: 2 buckets → ≤2 entries
+        assert len(jm._autograd_cache) <= 2
+
+    def test_torch_function_path_seq_len(self):
+        torch = pytest.importorskip("torch")
+        import thunder_tpu.torch as ttorch
+
+        def masked_mean(x, seq_len=None):
+            mask = (torch.arange(x.shape[1]) < seq_len).to(x.dtype)
+            return (x * mask).sum() / mask.sum()
+
+        jfn = ttorch.jit(masked_mean, seq_buckets=(8, 16))
+        with torch.no_grad():
+            for L in (3, 5, 8, 11, 16):
+                x = torch.full((2, L), 3.0)
+                assert float(jfn(x)) == pytest.approx(6.0)
+        assert tt.cache_misses(jfn._jfn) <= 2
+
+    def test_torch_function_path_no_seq_len_no_injection(self):
+        torch = pytest.importorskip("torch")
+        import thunder_tpu.torch as ttorch
+
+        def plain_sum(x):
+            return x.sum()
+
+        jfn = ttorch.jit(plain_sum, seq_buckets=(8,))
+        with torch.no_grad():
+            assert float(jfn(torch.ones(2, 5))) == pytest.approx(10.0)
+
+
+class TestGeneratePrefillBuckets:
+    def test_bucketed_prefill_parity_and_bounded_compiles(self):
+        from thunder_tpu.models import llama
+
+        cfg = llama.LlamaConfig(name="bkt-test", vocab_size=97, dim=32, n_layers=2,
+                                n_heads=4, n_kv_heads=2, intermediate_size=64,
+                                max_seq_len=256)
+        params = llama.init_params(cfg)
+        llama._step_fns.clear()
+        for L in (9, 23, 40, 17, 31, 44, 12, 60):
+            pr = (np.arange(1, L + 1) % 97)[None, :]
+            ref = np.asarray(llama.generate(params, cfg, pr, 4, max_len=128))
+            got = np.asarray(llama.generate(params, cfg, pr, 4, max_len=128,
+                                            prefill_buckets=(16, 64)))
+            assert (ref == got).all(), L
+        _, pfn = llama._get_step_fns(cfg, None)
+        assert tt.cache_misses(pfn) <= 2  # 8 distinct lengths, 2 buckets
+        llama._step_fns.clear()

@@ -159,7 +159,9 @@ class ThunderTPUFunction:
     def __init__(self, fn: Callable, *, executors=None, cache: str = "constant values",
                  transforms: Sequence[Transform] = (), enable_cse: bool = True,
                  insert_dels: bool = True, sharp_edges: str = "allow",
-                 fn_name: str | None = None, **compile_options):
+                 fn_name: str | None = None, seq_buckets: Sequence[int] | None = None,
+                 seq_argnums: Sequence[int] | None = None, seq_dim: int = -1,
+                 **compile_options):
         from thunder_tpu.executors import resolve_executors
 
         check(cache in _CACHE_OPTIONS, lambda: f"unknown cache option {cache!r}")
@@ -178,6 +180,26 @@ class ThunderTPUFunction:
         self.compile_options = dict(compile_options)
         self._compile_ctx = None  # last CompileContext (option usage report)
         self.__name__ = f"thunder_tpu.jit({self.fn_name})"
+        # shape-polymorphic caching via bucketing (reference SYMBOLIC_VALUES
+        # over shapes, thunder/core/proxies.py:624-1136 + options.py:95 —
+        # on TPU the idiomatic answer is a fixed ladder of compiled lengths)
+        self.seq_buckets = None
+        self.seq_argnums = tuple(seq_argnums) if seq_argnums is not None else None
+        self.seq_dim = seq_dim
+        self._accepts_seq_len = False
+        if seq_buckets is not None:
+            from thunder_tpu.data import LengthBucketer
+
+            self.seq_buckets = LengthBucketer(seq_buckets)
+            import inspect
+
+            # explicit `seq_len` parameter only — a VAR_KEYWORD catch-all
+            # would misfire on forwarding wrappers (e.g. the torch-dialect
+            # traced(*args, **kwargs) shim) and crash fns that don't take it
+            try:
+                self._accepts_seq_len = "seq_len" in inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                self._accepts_seq_len = False
 
     def _leaf_cache_key(self, leaf):
         # symbolic values: non-bool numbers become runtime inputs guarded by
@@ -191,8 +213,57 @@ class ThunderTPUFunction:
             return ("N", type(leaf).__name__)
         return _leaf_key(leaf)
 
+    # -- bucketing ----------------------------------------------------------
+    def _pad_to_bucket(self, args, kwargs):
+        """Pad designated tensor leaves along ``seq_dim`` to the bucket ladder
+        so distinct sequence lengths hit at most ``len(buckets)`` compiled
+        programs. The TRUE length is passed to ``fn`` as a 0-d int32 array
+        kwarg ``seq_len`` (when the signature accepts it) — a runtime tensor
+        input, so masking sees the real length while the compiled shape stays
+        the bucket's. Outputs keep the PADDED length: callers index them with
+        the true length (or a mask), not ``[:, -1]``."""
+        import jax.numpy as jnp
+        import jax.tree_util as _jtu
+
+        flat_paths, treedef = _jtu.tree_flatten_with_path((args, kwargs))
+        flat = [leaf for _, leaf in flat_paths]
+        designated = []
+        for i, (path, leaf) in enumerate(flat_paths):
+            if not _is_arraylike(leaf) or not getattr(leaf, "ndim", 0):
+                continue
+            if self.seq_argnums is not None:
+                # path[0] selects args(0)/kwargs(1); path[1] the positional idx
+                if len(path) < 2 or getattr(path[0], "idx", None) != 0:
+                    continue
+                if getattr(path[1], "idx", None) not in self.seq_argnums:
+                    continue
+            designated.append(i)
+        check(designated, lambda: "seq_buckets is set but no tensor args were found")
+        lengths = {int(flat[i].shape[self.seq_dim]) for i in designated}
+        check(len(lengths) == 1, lambda: (
+            f"seq_buckets: designated tensor args disagree on the sequence "
+            f"dimension size ({sorted(lengths)}); pass seq_argnums to select "
+            f"which positional args carry the sequence axis"))
+        L = lengths.pop()
+        Lb = self.seq_buckets.bucket_for(L)
+        if Lb != L:
+            new_flat = list(flat)
+            for i in designated:
+                leaf = flat[i]
+                d = self.seq_dim % leaf.ndim
+                widths = [(0, 0)] * leaf.ndim
+                widths[d] = (0, Lb - L)
+                new_flat[i] = jnp.pad(jnp.asarray(leaf), widths)
+            args, kwargs = tree_unflatten(treedef, new_flat)
+        if self._accepts_seq_len and "seq_len" not in kwargs:
+            kwargs = dict(kwargs)
+            kwargs["seq_len"] = _np.asarray(L, _np.int32)
+        return args, kwargs
+
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
+        if self.seq_buckets is not None:
+            args, kwargs = self._pad_to_bucket(args, kwargs)
         flat, treedef = tree_flatten((args, kwargs))
         key = (treedef, tuple(self._leaf_cache_key(l) for l in flat)) \
             if self.cache_option != "no caching" else None
@@ -415,8 +486,20 @@ class ThunderTPUFunction:
 def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant values",
         transforms: Sequence[Transform] = (), enable_cse: bool = True,
         insert_dels: bool = True, sharp_edges: str = "allow",
+        seq_buckets: Sequence[int] | None = None,
+        seq_argnums: Sequence[int] | None = None, seq_dim: int = -1,
         **compile_options) -> ThunderTPUFunction:
     """Compile ``fn``: trace → transform → dispatch to executors.
+
+    ``seq_buckets=(256, 512, ...)`` enables shape-polymorphic caching by
+    bucketing: on each call, tensor args (all of them, or those selected by
+    ``seq_argnums``) are zero-padded along ``seq_dim`` to the next ladder
+    length, bounding compilations to the ladder size; the true length is
+    passed as a 0-d ``seq_len`` tensor when ``fn`` accepts it, so masking
+    stays exact (the TPU answer to the reference's symbolic-shape caching,
+    ``thunder/core/proxies.py:624-1136``, ``thunder/core/options.py:95``).
+    Outputs keep the PADDED length — index them with the true length or a
+    mask (``logits[:, -1]`` would read a pad position).
 
     Free-form ``**compile_options`` are queried lazily by passes/executors via
     ``thunder_tpu.core.compile_data.get_compile_option``; see
@@ -424,11 +507,12 @@ def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant va
 
     Reference: ``thunder.jit`` (``thunder/__init__.py:262``).
     """
+    shape_opts = dict(seq_buckets=seq_buckets, seq_argnums=seq_argnums, seq_dim=seq_dim)
     if fn is None:
         def deco(f):
             return jit(f, executors=executors, cache=cache, transforms=transforms,
                        enable_cse=enable_cse, insert_dels=insert_dels,
-                       sharp_edges=sharp_edges, **compile_options)
+                       sharp_edges=sharp_edges, **shape_opts, **compile_options)
 
         return deco
     import sys
@@ -439,10 +523,10 @@ def jit(fn: Callable | None = None, *, executors=None, cache: str = "constant va
 
         return torch_jit(fn, executors=executors, cache=cache, transforms=transforms,
                          enable_cse=enable_cse, insert_dels=insert_dels,
-                         sharp_edges=sharp_edges, **compile_options)
+                         sharp_edges=sharp_edges, **shape_opts, **compile_options)
     return ThunderTPUFunction(fn, executors=executors, cache=cache, transforms=transforms,
                               enable_cse=enable_cse, insert_dels=insert_dels,
-                              sharp_edges=sharp_edges, **compile_options)
+                              sharp_edges=sharp_edges, **shape_opts, **compile_options)
 
 
 # ---------------------------------------------------------------------------

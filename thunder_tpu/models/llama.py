@@ -15,7 +15,7 @@ import math
 from dataclasses import dataclass, field
 
 from thunder_tpu import ops
-from thunder_tpu.core import dtypes
+from thunder_tpu.core import dtypes, prims
 
 
 @dataclass(frozen=True)
@@ -326,8 +326,6 @@ def forward_step(params, tokens, cache, pos, cfg: LlamaConfig):
     [pos, pos+T) (prefill T>1 or decode T=1); ``pos`` is a traced scalar so
     one compiled program serves every decode step. Returns
     (logits (B, T, vocab), updated cache)."""
-    from thunder_tpu.core import prims
-
     B, T = tokens.shape
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.kv_heads
@@ -369,13 +367,55 @@ def forward_step(params, tokens, cache, pos, cfg: LlamaConfig):
     return ops.linear(h, params["lm_head"]), new_cache
 
 
+# shared decode/prefill step cache: tt.jit functions cache per input shape
+# internally, so one entry per (config, n_layers) bounds compilations across
+# generate() calls — a bucketed prefill (prefill_buckets) then compiles at
+# most len(buckets) prefill programs total
+_step_fns: dict = {}
+
+
+def _get_step_fns(cfg: LlamaConfig, n_layers):
+    import thunder_tpu as tt
+
+    key = (repr(cfg), n_layers)
+    if key in _step_fns:
+        return _step_fns[key]
+
+    def _step(p, t, c, pos):
+        logits, nc = forward_step(p, t, c, pos, cfg)
+        T = t.shape[1]
+        return ops.squeeze(ops.narrow(logits, 1, T - 1, 1), 1), nc
+
+    def _prefill(p, t, c, pos, true_len):
+        # padded prefill: extract logits at the LAST REAL position
+        # (true_len - 1), a traced 0-d index — the compiled program is
+        # shared by every prompt length in the bucket
+        logits, nc = forward_step(p, t, c, pos, cfg)
+        B, _, V = logits.shape
+        zero = ops.full((), 0, dtype=dtypes.int32)
+        last = prims.dynamic_slice(
+            logits, (zero, ops.sub(true_len, 1), zero), (B, 1, V))
+        return ops.squeeze(last, 1), nc
+
+    fns = (tt.jit(_step, donate_argnums=(2,)), tt.jit(_prefill, donate_argnums=(2,)))
+    _step_fns[key] = fns
+    return fns
+
+
 def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
              temperature: float = 0.0, key=None, max_len: int | None = None,
-             n_layers: int | None = None):
+             n_layers: int | None = None, prefill_buckets=None):
     """Autoregressive decoding with a KV cache: prefill once, then one
     compiled decode step reused for every position (``pos`` is a traced
     array — no per-step recompilation). Greedy when ``temperature == 0``,
-    else softmax sampling via Gumbel trick with the keyed functional RNG."""
+    else softmax sampling via Gumbel trick with the keyed functional RNG.
+
+    ``prefill_buckets=(128, 512, ...)``: pad the prompt to a bucket ladder so
+    ragged prompt lengths compile at most ``len(buckets)`` prefill programs
+    (step functions are shared across ``generate`` calls per config). The
+    pad positions write garbage K/V beyond ``Tp`` — harmless: the causal
+    mask hides cols > row, and decode overwrites each position before it is
+    first attended."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -386,7 +426,27 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
         return jnp.zeros((len(prompt), 0), jnp.int32)
     prompt = jnp.asarray(prompt)
     B, Tp = prompt.shape
-    max_len = max_len or (Tp + max_new_tokens)
+    prompt_in, Tpad = prompt, Tp
+    if prefill_buckets is not None:
+        from thunder_tpu.data import LengthBucketer
+
+        bk = LengthBucketer(prefill_buckets)
+        Tpad = bk.bucket_for(Tp)
+        if Tpad != Tp:
+            prompt_in = jnp.pad(prompt, ((0, 0), (0, Tpad - Tp)))
+        if max_len is None:
+            # bucket the KV-cache length too: the decode step's compiled
+            # shape is (B, 1) tokens × (B, H, max_len, hd) cache, so an
+            # un-bucketed max_len would recompile decode per prompt length
+            align = bk.buckets[0]
+            max_len = min(cfg.max_seq_len,
+                          max(Tpad, -(-(Tp + max_new_tokens) // align) * align))
+    max_len = max_len or max(Tp + max_new_tokens, Tpad)
+    if Tpad > max_len:
+        raise ValueError(
+            f"prefill bucket {Tpad} (for prompt length {Tp}) exceeds the KV "
+            f"cache length (max_len={max_len}); use a tighter bucket ladder "
+            f"or a larger max_len")
     if Tp + max_new_tokens > max_len or max_len > cfg.max_seq_len:
         raise ValueError(
             f"prompt ({Tp}) + max_new_tokens ({max_new_tokens}) exceeds the "
@@ -397,12 +457,7 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
     # otherwise run lm_head over the whole prompt and ship (B, Tp, vocab)
     # to the host); the cache is donated so XLA updates it in place instead
     # of copying ~all of it every token
-    def _step(p, t, c, pos):
-        logits, nc = forward_step(p, t, c, pos, cfg)
-        T = t.shape[1]
-        return ops.squeeze(ops.narrow(logits, 1, T - 1, 1), 1), nc
-
-    step_fn = tt.jit(_step, donate_argnums=(2,))
+    step_fn, prefill_fn = _get_step_fns(cfg, n_layers)
 
     def pick(logits_last, key):
         if temperature == 0.0:
@@ -410,7 +465,10 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
         g = -jnp.log(-jnp.log(jax.random.uniform(key, logits_last.shape) + 1e-10) + 1e-10)
         return jnp.argmax(logits_last / temperature + g, -1).astype(jnp.int32)
 
-    last, cache = step_fn(params, prompt, cache, jnp.int32(0))
+    if prefill_buckets is not None:
+        last, cache = prefill_fn(params, prompt_in, cache, jnp.int32(0), jnp.int32(Tp))
+    else:
+        last, cache = step_fn(params, prompt, cache, jnp.int32(0))
     if key is None:
         key = jax.random.PRNGKey(0)
     key, sub = jax.random.split(key)

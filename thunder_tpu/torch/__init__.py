@@ -1443,6 +1443,23 @@ class ThunderModule:
         self._autograd_cache: dict = {}
         self._torch_dirty = False   # True once the bridge made the torch module live
         self._torch_fp = None
+        # seq_buckets on a module: pad the USER args/kwargs before dispatch
+        # (never the parameters) — an HF-style attention_mask padded with
+        # zeros gives exact masking for free. Padding happens in __call__
+        # (on torch tensors, so the autograd-bridge path is bucketed too);
+        # the inner jit's own bucketing then sees already-bucket-sized
+        # shapes. Outputs keep the padded length — index with the true
+        # length or a mask, not [:, -1].
+        self._seq_buckets = None
+        self._seq_dim = jit_kwargs.get("seq_dim", -1)
+        if jit_kwargs.get("seq_buckets") is not None:
+            from thunder_tpu.data import LengthBucketer
+
+            self._seq_buckets = LengthBucketer(jit_kwargs["seq_buckets"])
+            if jit_kwargs.get("seq_argnums") is None:
+                # positions 3/4 of _functional(params, buffers, training,
+                # args, kwargs): the user's args and kwargs pytrees
+                jit_kwargs["seq_argnums"] = (3, 4)
         self._jfn = _jit(self._functional, **jit_kwargs)
 
     # the traced function: params/buffers are pytree inputs → proxies
@@ -1471,6 +1488,9 @@ class ThunderModule:
     def __call__(self, *args, **kwargs):
         from thunder_tpu.core.pytree import tree_flatten as _tf
 
+        if self._seq_buckets is not None:
+            args, kwargs = _pad_call_to_bucket(self._seq_buckets, self._seq_dim,
+                                               args, kwargs)
         flat, _ = _tf((args, kwargs))
         if self._torch_autograd and torch.is_grad_enabled():
             torch_in = [l for l in flat if isinstance(l, torch.Tensor)]
@@ -1606,6 +1626,64 @@ class ThunderModule:
             self._grad_sync = True
 
 
+def _pad_call_to_bucket(bucketer, seq_dim, args, kwargs, *, argnums=None,
+                        inject_seq_len=False):
+    """Pad tensor leaves (torch or jax/numpy) of a call along ``seq_dim`` to
+    the bucket ladder — applied BEFORE dispatch so both the pure-jax path and
+    the torch-autograd bridge see bucket-sized shapes (bounded compiles under
+    training too). Outputs keep the padded length; mask-aware models
+    (attention_mask padded with zeros) stay exact, and callers must index
+    results with the true length rather than ``[:, -1]``."""
+    import jax.tree_util as _jtu
+
+    flat_paths, treedef = _jtu.tree_flatten_with_path((args, kwargs))
+    designated = []
+    for i, (path, leaf) in enumerate(flat_paths):
+        is_tensor = isinstance(leaf, torch.Tensor) or (
+            hasattr(leaf, "shape") and hasattr(leaf, "dtype"))
+        if not is_tensor or not getattr(leaf, "ndim", 0):
+            continue
+        if argnums is not None:
+            if len(path) < 2 or getattr(path[0], "idx", None) != 0:
+                continue
+            if getattr(path[1], "idx", None) not in argnums:
+                continue
+        designated.append(i)
+    if not designated:
+        return args, kwargs
+    leaves = [leaf for _, leaf in flat_paths]
+    lengths = {int(leaves[i].shape[seq_dim]) for i in designated}
+    if len(lengths) != 1:
+        raise RuntimeError(
+            f"seq_buckets: tensor args disagree on the sequence dimension "
+            f"size ({sorted(lengths)}); pass seq_argnums to select which "
+            f"args carry the sequence axis")
+    L = lengths.pop()
+    Lb = bucketer.bucket_for(L)
+    if Lb != L:
+        for i in designated:
+            leaf = leaves[i]
+            d = seq_dim % leaf.ndim
+            if isinstance(leaf, torch.Tensor):
+                # F.pad's spec is (last_lo, last_hi, prev_lo, prev_hi, ...)
+                spec = [0, 0] * leaf.ndim
+                spec[(leaf.ndim - 1 - d) * 2 + 1] = Lb - L
+                leaves[i] = torch.nn.functional.pad(leaf, spec)
+            else:
+                import jax.numpy as jnp
+
+                widths = [(0, 0)] * leaf.ndim
+                widths[d] = (0, Lb - L)
+                leaves[i] = jnp.pad(jnp.asarray(leaf), widths)
+        args, kwargs = _jtu.tree_unflatten(treedef, leaves)
+    if inject_seq_len and "seq_len" not in kwargs:
+        kwargs = dict(kwargs)
+        # a torch scalar (not numpy): the autograd bridge treats non-torch
+        # array leaves as constants-to-bake and refuses to engage on them
+        kwargs["seq_len"] = torch.tensor(int(L), dtype=torch.int32)
+    return args, kwargs
+
+
 def _args_to_jax(args, kwargs):
     def conv(x):
         if isinstance(x, torch.Tensor):
@@ -1637,8 +1715,17 @@ def jit(module_or_fn, **jit_kwargs):
 
     traced.__name__ = getattr(fn, "__name__", "fn")
     use_bridge = jit_kwargs.pop("torch_autograd", True)
-    return _ConvertingWrapper(_jit(traced, **jit_kwargs),
-                              torch_fn=fn if use_bridge else None)
+    jfn = _jit(traced, **jit_kwargs)
+    if jit_kwargs.get("seq_buckets") is not None:
+        # the traced(*args, **kwargs) shim hides the USER fn's signature from
+        # the core seq_len heuristic — decide injection from the user's fn
+        import inspect
+
+        try:
+            jfn._accepts_seq_len = "seq_len" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            pass
+    return _ConvertingWrapper(jfn, torch_fn=fn if use_bridge else None)
 
 
 class _ConvertingWrapper:
@@ -1654,6 +1741,13 @@ class _ConvertingWrapper:
         self._autograd_cache: dict = {}
 
     def __call__(self, *args, **kwargs):
+        if getattr(self._jfn, "seq_buckets", None) is not None:
+            # pad on torch tensors so the autograd-bridge path (which never
+            # reaches the inner jit's bucketing) is bucketed too
+            args, kwargs = _pad_call_to_bucket(
+                self._jfn.seq_buckets, self._jfn.seq_dim, args, kwargs,
+                argnums=self._jfn.seq_argnums,
+                inject_seq_len=self._jfn._accepts_seq_len)
         if self._torch_fn is not None and torch.is_grad_enabled():
             from thunder_tpu.core.pytree import tree_flatten as _tf
 
