@@ -310,7 +310,8 @@ def checkpoint(fn):
     region's inputs. Works in both autograd modes (inline whole-step and
     torch-style fwd/bwd split)."""
     from thunder_tpu.core.transforms import (
-        _env_map, _trace_subfn, augmented_forward, backward_pass, register_vjp,
+        _env_map, _trace_subfn, augmented_forward, backward_pass,
+        promote_free_vars, register_vjp,
     )
 
     def wrapped(*args):
@@ -321,12 +322,8 @@ def checkpoint(fn):
         inner, inner_inputs, _ = _trace_subfn(fn, args, {})
         # closure-captured outer proxies (e.g. precomputed rope tables) become
         # explicit region inputs, so dataflow (DCE, saved-set analysis) sees them
-        from thunder_tpu.core.utils import free_vars
-
-        input_set = {Variable(p) for p in inner_inputs}
-        frees = [v.proxy for v in free_vars(inner.bound_symbols) if v not in input_set]
-        inner_inputs = list(inner_inputs) + frees
-        inner.args = inner_inputs
+        frees = promote_free_vars(inner, inner_inputs)
+        inner_inputs = inner.args
         sid = f"checkpoint_{_ckpt_counter}"
         _ckpt_counter += 1
 

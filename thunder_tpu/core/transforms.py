@@ -324,6 +324,19 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
     return inner, [p for p in proxies if isinstance(p, Proxy)], out
 
 
+def promote_free_vars(inner: TraceCtx, inner_inputs) -> list:
+    """Promote closure-captured outer proxies of a sub-trace to explicit
+    inputs (appended to ``inner.args``), so dataflow analyses (DCE,
+    saved-set, replay) see them. Returns the promoted proxies in order —
+    callers pass them as extra symbol args."""
+    from thunder_tpu.core.utils import free_vars
+
+    input_set = {Variable(p) for p in inner_inputs}
+    frees = [v.proxy for v in free_vars(inner.bound_symbols) if v not in input_set]
+    inner.args = list(inner_inputs) + frees
+    return frees
+
+
 def inline_value_and_grad(fn, argnums=0, has_aux: bool = False):
     """Differentiate ``fn`` inline in the current trace (or under jit).
 

@@ -165,6 +165,279 @@ def _dispatch(func, args, kwargs):
     return _wrap(mapped(*_unwrap(args), **_unwrap(kwargs)))
 
 
+# ---------------------------------------------------------------------------
+# custom torch.autograd.Function + torch.utils.checkpoint lookasides
+# (reference: thunder/core/jit_ext.py:919-930 autograd_function_apply lookaside)
+# ---------------------------------------------------------------------------
+
+class _TraceFunctionCtx:
+    """Stand-in for ``FunctionCtx`` while tracing a user
+    ``torch.autograd.Function``: records ``save_for_backward`` saves (as
+    proxies) and arbitrary attributes; the same object is handed to the
+    user's ``backward`` with the saves swapped for their replayed values."""
+
+    def __init__(self, needs_input_grad=()):
+        object.__setattr__(self, "_tensor_attrs", {})
+        self._to_save = ()
+        self._materialize_grads = True
+        self.needs_input_grad = tuple(needs_input_grad)
+
+    def __setattr__(self, name, value):
+        # tensors stashed as plain ctx attributes (ctx.x = x) must be
+        # replayed in the backward like save_for_backward saves — otherwise
+        # the backward would consume stale proxies from the detached
+        # forward-tracing context
+        object.__setattr__(self, name, value)
+        if not name.startswith("_") and name != "needs_input_grad":
+            if isinstance(value, TorchProxy):
+                self._tensor_attrs[name] = value
+            else:
+                self._tensor_attrs.pop(name, None)
+
+    def save_for_backward(self, *tensors):
+        self._to_save = tensors
+
+    def save_for_forward(self, *tensors):  # forward-mode saves: unused here
+        pass
+
+    @property
+    def saved_tensors(self):
+        return tuple(self._to_save)
+
+    def mark_non_differentiable(self, *tensors):
+        pass
+
+    def mark_dirty(self, *tensors):
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+def _to_torch_value(v):
+    """Present a traced-region input to USER torch code: proxies get their
+    TorchProxy wrapper; jax/numpy constants (e.g. a causal mask baked in the
+    outer trace) become real torch tensors — torch APIs reject foreign array
+    types before ``__torch_function__`` dispatch can run."""
+    if isinstance(v, TensorProxy):
+        return TorchProxy(v)
+    if isinstance(v, (tuple, list)):
+        return type(v)(_to_torch_value(i) for i in v)
+    if isinstance(v, dict):
+        return {k: _to_torch_value(x) for k, x in v.items()}
+    if v is None or isinstance(v, (torch.Tensor, Number, str, bool, Proxy)):
+        return v
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        from thunder_tpu.torch.autograd_bridge import jax_to_tensor
+
+        return jax_to_tensor(v)
+    return v
+
+
+_autograd_fn_counter = 0
+
+
+def _trace_autograd_function(cls, args, kwargs):
+    """Trace ``MyFn.apply(*args)``: the user's ``forward`` becomes a
+    composite symbol (subsymbols = its traced ops, so executors claim into
+    it), and the user's ``backward`` is registered as that symbol's VJP rule
+    — grads follow the user's derivative, not autodiff of the forward."""
+    global _autograd_fn_counter
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.core.symbol import Symbol
+    from thunder_tpu.core.trace import get_tracectx
+    from thunder_tpu.core.transforms import (
+        _trace_subfn, eval_trace, promote_free_vars, register_vjp,
+    )
+
+    check(get_tracectx() is not None,
+          "autograd.Function tracing requires an active trace")
+    core_args = _unwrap(args)
+    core_kwargs = _unwrap(kwargs or {})
+    needs = tuple(isinstance(a, TensorProxy) and a.dtype.is_inexact
+                  for a in core_args)
+
+    # new-style Functions define forward WITHOUT ctx + a setup_context hook
+    base_setup = getattr(torch.autograd.Function, "setup_context", None)
+    new_style = (base_setup is not None
+                 and getattr(cls, "setup_context", None) is not base_setup)
+
+    holder: dict = {}
+
+    def _fwd(*a, **kw):
+        ctx = _TraceFunctionCtx(needs)
+        holder["ctx"] = ctx
+        wa = tuple(_to_torch_value(x) for x in a)
+        wkw = {k: _to_torch_value(v) for k, v in kw.items()}
+        with _TraceMode():
+            if new_style:
+                out = cls.forward(*wa, **wkw)
+                cls.setup_context(ctx, tuple(wa), out)
+            else:
+                out = cls.forward(ctx, *wa, **wkw)
+        holder["attr_names"] = list(ctx._tensor_attrs)
+        saved = tuple(_unwrap(t) for t in ctx._to_save) \
+            + tuple(_unwrap(v) for v in ctx._tensor_attrs.values())
+        return _unwrap(out), saved
+
+    inner, inner_inputs, _ = _trace_subfn(_fwd, core_args, core_kwargs)
+    frees = promote_free_vars(inner, inner_inputs)
+
+    sid = f"autograd_function_{cls.__name__}_{_autograd_fn_counter}"
+    _autograd_fn_counter += 1
+
+    def meta(*ps):
+        out, _saved = eval_trace(inner, *ps)
+        return out
+
+    sym = Symbol(f"autograd_function_{cls.__name__}", meta, id=sid)
+
+    # map each apply positional arg to its position among the proxy inputs
+    # (the user's backward returns one grad per positional arg)
+    arg_to_proxy_idx: dict[int, int] = {}
+    pi = 0
+    for i, a in enumerate(core_args):
+        leaves = tree_flatten(a)[0]
+        if len(leaves) == 1 and isinstance(leaves[0], Proxy):
+            arg_to_proxy_idx[i] = pi
+        pi += sum(1 for l in leaves if isinstance(l, Proxy))
+
+    @register_vjp(sid)
+    def _fn_vjp(*rargs):
+        out, saved = eval_trace(inner, *rargs)
+
+        def pullback(g):
+            out_flat = [o for o in tree_flatten(out)[0] if isinstance(o, Proxy)]
+            gs = list(g) if isinstance(g, (tuple, list)) else [g]
+            ctx = holder["ctx"]
+            if ctx._materialize_grads:
+                gs = [ops.full(o.shape, 0.0, dtype=o.dtype) if gg is None else gg
+                      for gg, o in zip(gs, out_flat)]
+            attr_names = holder.get("attr_names", [])
+            n_save = len(saved) - len(attr_names)
+            ctx._to_save = tuple(_wrap(s) for s in saved[:n_save])
+            for name, val in zip(attr_names, saved[n_save:]):
+                object.__setattr__(ctx, name, _wrap(val))
+            with _TraceMode():
+                gin = cls.backward(ctx, *[_wrap(gg) for gg in gs])
+            gin = gin if isinstance(gin, tuple) else (gin,)
+            pairs = []
+            for i, gg in enumerate(gin):
+                j = arg_to_proxy_idx.get(i)
+                if j is not None and gg is not None:
+                    pairs.append((rargs[j], _unwrap(gg)))
+            return pairs
+
+        return out, pullback
+
+    proxy_args = [a for a in tree_flatten((core_args, core_kwargs))[0]
+                  if isinstance(a, Proxy)] + frees
+    return _wrap(sym(*proxy_args))
+
+
+# patch state for Function.apply / torch.utils.checkpoint while tracing:
+# a depth counter makes nested _TraceMode entries (e.g. the lookasides
+# themselves re-enter the mode) idempotent
+_ORIG_FUNCTION_APPLY: tuple | None = None
+_ORIG_CHECKPOINT = None
+_CHECKPOINT_CELL = None  # closure cell of the _disable_dynamo wrapper, if any
+_lookaside_patch_depth = 0
+
+# checkpoint()'s own control kwargs — everything else forwards to `function`
+_CKPT_CONTROL_KWARGS = frozenset(
+    ("context_fn", "determinism_check", "debug", "early_stop",
+     "preserve_rng_state"))
+
+
+def _traced_checkpoint(function, *args, use_reentrant=None, **ckpt_kwargs):
+    """``torch.utils.checkpoint.checkpoint`` lookaside → ``tt.checkpoint``:
+    the wrapped region recomputes in the backward instead of saving
+    intermediates (reference gap — no such lookaside upstream)."""
+    if not _has_wrapper(args, ckpt_kwargs):
+        return _ORIG_CHECKPOINT(function, *args, use_reentrant=use_reentrant,
+                                **ckpt_kwargs)
+    from thunder_tpu.core.rematerialization import checkpoint as tt_checkpoint
+
+    fn_kwargs = {k: v for k, v in ckpt_kwargs.items()
+                 if k not in _CKPT_CONTROL_KWARGS}
+    core_args = _unwrap(args)
+    core_kw = {k: _unwrap(v) for k, v in fn_kwargs.items()}
+    kw_keys = list(core_kw)
+
+    # fold function kwargs into the region's positional inputs so proxy
+    # kwargs (e.g. attention_mask=mask) participate in the traced region
+    def inner(*ps):
+        a = ps[:len(core_args)]
+        kvals = ps[len(core_args):]
+        with _TraceMode():
+            return _unwrap(function(
+                *(_to_torch_value(x) for x in a),
+                **{k: _to_torch_value(v) for k, v in zip(kw_keys, kvals)}))
+
+    return _wrap(tt_checkpoint(inner)(*core_args, *core_kw.values()))
+
+
+def _patch_trace_lookasides():
+    global _ORIG_FUNCTION_APPLY, _ORIG_CHECKPOINT, _CHECKPOINT_CELL, \
+        _lookaside_patch_depth
+    if _lookaside_patch_depth == 0:
+        for klass in torch.autograd.Function.__mro__:
+            if "apply" in klass.__dict__:
+                _ORIG_FUNCTION_APPLY = (klass, klass.__dict__["apply"])
+                break
+
+        orig_desc = _ORIG_FUNCTION_APPLY[1]
+        import torch.utils.checkpoint as _tuc
+
+        def _traced_apply(cls, *args, **kwargs):
+            if not _has_wrapper(args, kwargs):
+                return orig_desc.__get__(None, cls)(*args, **kwargs)
+            if cls is _tuc.CheckpointFunction:
+                # direct reentrant-path use: CheckpointFunction.apply(fn,
+                # preserve_rng_state, *args) — route to the region lookaside
+                return _traced_checkpoint(args[0], *args[2:])
+            return _trace_autograd_function(cls, args, kwargs)
+
+        torch.autograd.Function.apply = classmethod(_traced_apply)
+
+        # torch.utils.checkpoint.checkpoint is a _disable_dynamo wrapper
+        # closing over the real implementation in a `fn` cell. Swapping the
+        # CELL reroutes EVERY early-bound reference to the wrapper — e.g.
+        # transformers' `from torch.utils.checkpoint import checkpoint`
+        # (modeling_utils.py) — not just the module attribute.
+        wrapper = _tuc.checkpoint
+        _CHECKPOINT_CELL = None
+        freevars = getattr(wrapper.__code__, "co_freevars", ())
+        if "fn" in freevars and wrapper.__closure__ is not None:
+            cell = wrapper.__closure__[freevars.index("fn")]
+            if callable(cell.cell_contents):
+                _CHECKPOINT_CELL = cell
+        if _CHECKPOINT_CELL is not None:
+            _ORIG_CHECKPOINT = _CHECKPOINT_CELL.cell_contents
+            _CHECKPOINT_CELL.cell_contents = _traced_checkpoint
+        else:  # no wrapper (other torch builds): module-attribute patch
+            _ORIG_CHECKPOINT = wrapper
+            _tuc.checkpoint = _traced_checkpoint
+    _lookaside_patch_depth += 1
+
+
+def _unpatch_trace_lookasides():
+    global _lookaside_patch_depth
+    _lookaside_patch_depth -= 1
+    if _lookaside_patch_depth == 0:
+        klass, desc = _ORIG_FUNCTION_APPLY
+        if klass is torch.autograd.Function:
+            torch.autograd.Function.apply = desc
+        else:  # patched onto the subclass dict; remove to restore inheritance
+            del torch.autograd.Function.apply
+        import torch.utils.checkpoint as _tuc
+
+        if _CHECKPOINT_CELL is not None:
+            _CHECKPOINT_CELL.cell_contents = _ORIG_CHECKPOINT
+        else:
+            _tuc.checkpoint = _ORIG_CHECKPOINT
+
+
 class _TraceMode(TorchFunctionMode):
     """Active while tracing a torch program: routes every torch API call that
     involves a TorchProxy — and all factory functions — into the thunder map;
@@ -199,6 +472,8 @@ class _TraceMode(TorchFunctionMode):
         # tracing those branches must take the trace-safe route exactly as
         # they would under torch.jit.trace
         torch.jit.is_tracing = lambda: True
+        # custom autograd.Function.apply + torch.utils.checkpoint lookasides
+        _patch_trace_lookasides()
         return super().__enter__()
 
     def __exit__(self, *exc):
@@ -208,6 +483,7 @@ class _TraceMode(TorchFunctionMode):
         except Exception:
             pass
         torch.jit.is_tracing = self._orig_is_tracing
+        _unpatch_trace_lookasides()
         return super().__exit__(*exc)
 
 

@@ -40,7 +40,9 @@ def jax_to_tensor(a) -> torch.Tensor:
     arr = np.asarray(a)
     if arr.dtype.name == "bfloat16":
         return torch.from_numpy(arr.astype(np.float32)).bfloat16()
-    arr = np.ascontiguousarray(arr)
+    # ascontiguousarray promotes 0-d to 1-d — undo, or 0-d losses round-trip
+    # to torch as shape (1,) and their cotangents mismatch the traced shapes
+    arr = np.ascontiguousarray(arr).reshape(arr.shape)
     if not arr.flags.writeable:  # jax exposes read-only buffers
         arr = arr.copy()
     return torch.from_numpy(arr)
