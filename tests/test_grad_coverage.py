@@ -92,6 +92,18 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
                     "grads covered via the var and mean OpInfos over the same prims",
     "ops.max_with_indices": "tuple (values, indices) output; values grad covered by amax",
     "ops.min_with_indices": "tuple (values, indices) output; values grad covered by amin",
+    "ops.searchsorted": "integer-index output (insertion positions); non-differentiable",
+    "ops.bucketize": "integer-index output; non-differentiable",
+    "ops.bincount": "integer counting op (float only via weights, which scale "
+                    "one-hot masks; grads stop at the integer input)",
+    "ops.kthvalue": "tuple (values, indices) output; values grad covered by the "
+                    "kthvalue_values OpInfo (gather-based decomposition)",
+    "nn.grid_sample": "grads (input AND grid) verified vs torch autograd in "
+                      "test_ops.py::test_grid_sample_grads_vs_torch",
+    "nn.ctc_loss": "grads verified END-TO-END vs torch at the logits in "
+                   "test_ops.py::test_ctc_loss_logits_grads (torch's own "
+                   "log_probs-level grad folds the softmax Jacobian in, so a "
+                   "per-op comparison is not meaningful)",
     "nn.ring_attention": "registered lazily by the context-parallel transform; its VJP "
                          "is the ring backward in distributed/ring.py, exercised by "
                          "tests/test_distributed.py ring-attention parity tests",

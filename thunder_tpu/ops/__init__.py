@@ -1006,7 +1006,11 @@ def argsort(a, dim=-1, descending=False):
 
 
 def topk(a, k, dim=-1):
-    return prims.topk(a, int(pyval(k)), canonicalize_dim(a.ndim, dim))
+    d = canonicalize_dim(a.ndim, dim)
+    k = int(pyval(k))
+    check(0 <= k <= a.shape[d],
+          lambda: f"topk: k={k} out of range for dim {d} of size {a.shape[d]}")
+    return prims.topk(a, k, d)
 
 
 # ---------------------------------------------------------------------------
@@ -1578,10 +1582,14 @@ def numel(a):
 def narrow(a, dim, start, length):
     d = canonicalize_dim(a.ndim, dim)
     start = int(pyval(start))
+    length = int(pyval(length))
     if start < 0:
         start += int(a.shape[d])
+    check(0 <= start and length >= 0 and start + length <= a.shape[d],
+          lambda: f"narrow: [{start}, {start + length}) out of bounds for "
+                  f"dim {d} of size {a.shape[d]}")
     idx = [slice(None)] * a.ndim
-    idx[d] = slice(start, start + int(length))
+    idx[d] = slice(start, start + length)
     return getitem(a, tuple(idx))
 
 
@@ -1683,6 +1691,155 @@ def cdist(a, b, p=2.0):
     check(p == 2.0, "cdist: only p=2 supported")
     diff = sub(unsqueeze(a, -2), unsqueeze(b, -3))
     return sqrt(clamp(sum(mul(diff, diff), -1), min=0.0))
+
+
+# ---------------------------------------------------------------------------
+# batch 7 (round 3): op-surface tail — searchsorted family, bincount,
+# kthvalue, cross, renorm, full multinomial
+# (reference: thunder/torch/__init__.py torchsymbols; VERDICT r2 item 3)
+# ---------------------------------------------------------------------------
+
+@opsymbol
+def searchsorted(sorted_sequence, values, *, right=False, side=None):
+    """Insertion indices that keep ``sorted_sequence`` sorted. TPU-first:
+    a broadcast compare + reduction (vectorizes on the VPU, no
+    data-dependent control flow) instead of binary search; indices are
+    int32 (this framework's index convention — torch returns int64)."""
+    if side is not None:
+        check(side in ("left", "right"),
+              lambda: f"searchsorted: side must be 'left' or 'right', got {side!r}")
+        check(not (right and side == "left"),
+              "searchsorted: side and right can't be set to opposites")
+        right = side == "right"
+    scalar_out = isinstance(values, Number)
+    if scalar_out:
+        values = full((), values,
+                      dtype=dtypes.float32 if isinstance(values, float) else dtypes.int32)
+    cmp_fn = le if right else lt
+    if sorted_sequence.ndim == 1:
+        cmp = cmp_fn(sorted_sequence, unsqueeze(values, -1))
+        out = sum(convert_element_type(cmp, dtypes.int32), -1)
+    else:
+        check(sorted_sequence.shape[:-1] == values.shape[:-1], lambda: (
+            f"searchsorted: leading dims of sorted_sequence "
+            f"{tuple(sorted_sequence.shape)} and values {tuple(values.shape)} "
+            f"must match"))
+        cmp = cmp_fn(unsqueeze(sorted_sequence, -2), unsqueeze(values, -1))
+        out = sum(convert_element_type(cmp, dtypes.int32), -1)
+    return squeeze(out, -1) if scalar_out and out.ndim else out
+
+
+@opsymbol
+def bucketize(input, boundaries, *, right=False):
+    """torch.bucketize: bucket index of each input among 1-D ``boundaries``."""
+    check(boundaries.ndim == 1,
+          lambda: f"bucketize: boundaries must be 1-D, got {boundaries.ndim}-D")
+    return searchsorted(boundaries, input, right=right)
+
+
+@opsymbol
+def bincount(a, weights=None, minlength=0):
+    """Count occurrences of each value in a 1-D integer tensor.
+
+    XLA programs have static shapes, so the torch behavior (output length
+    ``max(input)+1``) is data-dependent and unsupported: ``minlength`` is
+    REQUIRED (> 0) and fixes the output length; values ``>= minlength``
+    are dropped (same as ``jnp.bincount(..., length=minlength)``).
+    TPU-first: one-hot compare + sum-reduction, not scatter."""
+    check(a.ndim == 1, lambda: f"bincount: input must be 1-D, got {a.ndim}-D")
+    check(a.dtype.is_int, lambda: "bincount: input must be an integer tensor")
+    minlength = int(pyval(minlength))
+    check(minlength > 0,
+          "bincount: static shapes require minlength > 0 (the torch default "
+          "output length max(input)+1 is data-dependent)")
+    onehot = eq(unsqueeze(a, 1), reshape(arange(minlength), (1, minlength)))
+    if weights is not None:
+        check(weights.shape == a.shape,
+              lambda: "bincount: weights must have the same shape as input")
+        w = convert_element_type(weights, dtypes.float32) \
+            if not weights.dtype.is_inexact else weights
+        return sum(mul(convert_element_type(onehot, w.dtype), unsqueeze(w, 1)), 0)
+    return sum(convert_element_type(onehot, dtypes.int32), 0)
+
+
+@opsymbol
+def kthvalue(a, k, dim=-1, keepdim=False):
+    """k-th smallest value (and its index) along ``dim``; differentiable in
+    ``a`` via gather-by-index (the sort itself carries no gradient)."""
+    d = canonicalize_dim(a.ndim, dim)
+    k = int(pyval(k))
+    check(1 <= k <= a.shape[d],
+          lambda: f"kthvalue: k={k} out of range for dim of size {a.shape[d]}")
+    inds = prims.argsort(a, d, False)
+    idx = narrow(inds, d, k - 1, 1)
+    vals = gather(a, d, idx)
+    if not keepdim:
+        vals, idx = squeeze(vals, d), squeeze(idx, d)
+    return vals, idx
+
+
+@opsymbol
+def cross(a, b, dim=None):
+    """3-D cross product along ``dim`` (default: the first size-3 dim, torch
+    semantics; ``linalg.cross`` passes dim=-1)."""
+    if dim is None:
+        dim = next((i for i, s in enumerate(a.shape) if s == 3), None)
+        check(dim is not None, "cross: no dimension of size 3 found")
+    d = canonicalize_dim(a.ndim, dim)
+    check(a.shape[d] == 3 and b.shape[d] == 3,
+          lambda: f"cross: dim {d} must have size 3 "
+                  f"(got {a.shape[d]} and {b.shape[d]})")
+
+    def comp(x, i):
+        return squeeze(narrow(x, d, i, 1), d)
+
+    a0, a1, a2 = (comp(a, i) for i in range(3))
+    b0, b1, b2 = (comp(b, i) for i in range(3))
+    return stack([sub(mul(a1, b2), mul(a2, b1)),
+                  sub(mul(a2, b0), mul(a0, b2)),
+                  sub(mul(a0, b1), mul(a1, b0))], d)
+
+
+@opsymbol
+def renorm(a, p, dim, maxnorm):
+    """Renormalize sub-tensors along ``dim`` whose p-norm exceeds
+    ``maxnorm`` (torch.renorm, incl. its 1e-7 guard epsilon)."""
+    p = float(pyval(p))
+    maxnorm = float(pyval(maxnorm))
+    check(p > 0, lambda: f"renorm: non-positive norm degree p={p}")
+    check(maxnorm >= 0, lambda: f"renorm: negative maxnorm {maxnorm}")
+    d = canonicalize_dim(a.ndim, dim)
+    axes = tuple(i for i in range(a.ndim) if i != d)
+    norms = vector_norm(a, ord=p, dim=axes, keepdim=True)
+    factor = where(gt(norms, maxnorm),
+                   true_divide(maxnorm, add(norms, 1e-7)),
+                   full((), 1.0, dtype=norms.dtype))
+    return mul(a, convert_element_type(factor, a.dtype))
+
+
+@opsymbol
+def multinomial(a, num_samples, replacement=False, *, key=None):
+    """Categorical sampling via the Gumbel trick — TPU-first: with
+    replacement, iid Gumbel-argmax per draw; without replacement,
+    Gumbel-TOP-K (one fused topk, no sequential renormalization)."""
+    check(a.ndim in (1, 2),
+          lambda: f"multinomial: input must be 1-D or 2-D, got {a.ndim}-D")
+    n = int(pyval(num_samples))
+    C = a.shape[-1]
+    check(n >= 1, lambda: f"multinomial: num_samples must be >= 1, got {n}")
+    logp = log(clamp(a, min=1e-30))
+    if replacement:
+        gshape = tuple(a.shape[:-1]) + (n, C)
+        u = uniform(gshape, 1e-20, 1.0, dtype=dtypes.float32, key=key)
+        g = neg(log(neg(log(u))))
+        return argmax(add(unsqueeze(logp, -2), g), dim=-1)
+    check(n <= C, lambda: (
+        f"multinomial: cannot draw {n} samples without replacement from "
+        f"{C} categories"))
+    u = uniform(tuple(a.shape), 1e-20, 1.0, dtype=dtypes.float32, key=key)
+    g = neg(log(neg(log(u))))
+    _, idx = prims.topk(add(logp, g), n, a.ndim - 1)
+    return idx
 
 
 # nn composites live in ops.nn; re-export the common entry points

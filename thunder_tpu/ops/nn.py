@@ -750,3 +750,183 @@ def batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
         new_var = ops.add(ops.mul(running_var, 1 - momentum), ops.mul(unbiased_var, momentum))
         new_stats = (new_mean, new_var)
     return out, new_stats
+
+
+# ---------------------------------------------------------------------------
+# round 3: grid_sample + ctc_loss (reference thunder/torch F.* coverage)
+# ---------------------------------------------------------------------------
+
+@opsymbol(id="nn.grid_sample")
+def grid_sample(input, grid, mode: str = "bilinear", padding_mode: str = "zeros",
+                align_corners: bool = False):
+    """4-D ``F.grid_sample``: sample ``input`` (N,C,H,W) at normalized
+    ``grid`` (N,Ho,Wo,2) coordinates. TPU-first: the four corner reads are
+    flat gathers over H*W (one fused gather per corner, no scatter/loops);
+    differentiable in both ``input`` and ``grid`` (bilinear mode)."""
+    check(input.ndim == 4 and grid.ndim == 4 and grid.shape[-1] == 2,
+          lambda: f"grid_sample: expected input (N,C,H,W) and grid (N,Ho,Wo,2), "
+                  f"got {tuple(input.shape)} and {tuple(grid.shape)}")
+    check(mode in ("bilinear", "nearest"),
+          lambda: f"grid_sample: unsupported mode {mode!r}")
+    check(padding_mode in ("zeros", "border"),
+          lambda: f"grid_sample: unsupported padding_mode {padding_mode!r}")
+    check(input.shape[0] == grid.shape[0],
+          lambda: f"grid_sample: batch mismatch {input.shape[0]} vs {grid.shape[0]}")
+    N, C, H, W = input.shape
+    _, Ho, Wo, _ = grid.shape
+    gx = ops.squeeze(ops.narrow(grid, 3, 0, 1), 3)  # (N,Ho,Wo) x in [-1,1]
+    gy = ops.squeeze(ops.narrow(grid, 3, 1, 1), 3)
+
+    def unnorm(g, size):
+        if align_corners:
+            return ops.mul(ops.add(g, 1.0), (size - 1) / 2.0)
+        return ops.true_divide(ops.sub(ops.mul(ops.add(g, 1.0), float(size)), 1.0), 2.0)
+
+    x = unnorm(gx, W)
+    y = unnorm(gy, H)
+    inp_flat = ops.reshape(input, (N, C, H * W))
+
+    def read(ix, iy):
+        """Gather input at integer (iy, ix); returns ((N,C,Ho,Wo), inbounds)."""
+        inb = ops.logical_and(
+            ops.logical_and(ops.ge(ix, 0), ops.le(ix, W - 1)),
+            ops.logical_and(ops.ge(iy, 0), ops.le(iy, H - 1)))
+        cx = ops.clamp(ix, 0, W - 1)
+        cy = ops.clamp(iy, 0, H - 1)
+        flat = ops.reshape(ops.add(ops.mul(cy, W), cx), (N, 1, Ho * Wo))
+        idx = ops.expand(flat, (N, C, Ho * Wo))
+        vals = ops.reshape(ops.gather(inp_flat, 2, idx), (N, C, Ho, Wo))
+        return vals, ops.reshape(inb, (N, 1, Ho, Wo))
+
+    def masked(vals, inb):
+        if padding_mode == "zeros":
+            return ops.mul(vals, ops.convert_element_type(inb, vals.dtype))
+        return vals  # border: clamped read is already the border value
+
+    to_i = lambda v: ops.convert_element_type(v, dtypes.int32)
+    if mode == "nearest":
+        # torch rounds half toward nearest-even via round(); floor(x+0.5)
+        # matches its kernel behavior for the sampling use case
+        vals, inb = read(to_i(ops.floor(ops.add(x, 0.5))),
+                         to_i(ops.floor(ops.add(y, 0.5))))
+        return masked(vals, inb)
+    x0f, y0f = ops.floor(x), ops.floor(y)
+    wx = ops.reshape(ops.sub(x, x0f), (N, 1, Ho, Wo))
+    wy = ops.reshape(ops.sub(y, y0f), (N, 1, Ho, Wo))
+    x0, y0 = to_i(x0f), to_i(y0f)
+    x1, y1 = ops.add(x0, 1), ops.add(y0, 1)
+    v00 = masked(*read(x0, y0))
+    v01 = masked(*read(x1, y0))
+    v10 = masked(*read(x0, y1))
+    v11 = masked(*read(x1, y1))
+    one = 1.0
+    return ops.add(
+        ops.add(ops.mul(v00, ops.mul(ops.sub(one, wx), ops.sub(one, wy))),
+                ops.mul(v01, ops.mul(wx, ops.sub(one, wy)))),
+        ops.add(ops.mul(v10, ops.mul(ops.sub(one, wx), wy)),
+                ops.mul(v11, ops.mul(wx, wy))))
+
+
+# log-space "impossible" marker: a large FINITE negative (optax-style).
+# A true -inf would NaN the VJP (0 * inf in the where/exp pullbacks);
+# exp(_CTC_LOG_EPS - x) is exactly 0.0 in f32 for any realistic x.
+_CTC_LOG_EPS = -1e5
+
+
+def _safe_lse(parts):
+    """logsumexp over same-shape tensors padded with _CTC_LOG_EPS."""
+    m = parts[0]
+    for p in parts[1:]:
+        m = ops.maximum(m, p)
+    s = None
+    for p in parts:
+        e = ops.exp(ops.sub(p, m))
+        s = e if s is None else ops.add(s, e)
+    return ops.add(m, ops.log(s))
+
+
+@opsymbol(id="nn.ctc_loss")
+def ctc_loss(log_probs, targets, input_lengths, target_lengths, blank: int = 0,
+             reduction: str = "mean", zero_infinity: bool = False):
+    """CTC loss (``F.ctc_loss``): the standard alpha recursion over the
+    blank-extended target, expressed as a statically-unrolled scan of
+    batched gather/logsumexp steps — every step is a (B, 2S+1) vector op,
+    so XLA fuses the whole recursion; gradients are exact soft alignments
+    via autodiff of the recursion (torch uses a hand-written backward).
+
+    ``targets`` must be the padded 2-D (B, S) form (the 1-D concatenated
+    form is data-dependent and unsupported under static shapes).
+    ``log_probs`` is (T, B, C) and must already be log-softmaxed."""
+    check(log_probs.ndim == 3,
+          lambda: f"ctc_loss: log_probs must be (T,B,C), got {log_probs.ndim}-D")
+    check(targets.ndim == 2,
+          "ctc_loss: only the padded 2-D targets form is supported (the 1-D "
+          "concatenated form has data-dependent layout; pad to (B, S))")
+    check(reduction in ("none", "mean", "sum"),
+          lambda: f"ctc_loss: unknown reduction {reduction!r}")
+    T, B, C = log_probs.shape
+    S = targets.shape[1]
+    check(int(pyval(blank)) >= 0 and int(pyval(blank)) < C,
+          lambda: f"ctc_loss: blank={blank} out of range for {C} classes")
+    blank = int(pyval(blank))
+    S2 = 2 * S + 1
+    f32 = dtypes.float32
+    neg_inf = ops.full((), _CTC_LOG_EPS, dtype=f32)
+
+    # blank-extended targets ext (B, S2): [blank, t0, blank, t1, ..., blank]
+    pos = ops.arange(S2)                                   # (S2,)
+    tgt_idx = ops.clamp(ops.true_divide(ops.sub(pos, 1), 2), min=0)
+    tgt_idx = ops.convert_element_type(tgt_idx, dtypes.int32)
+    tgt_gathered = ops.gather(targets, 1,
+                              ops.expand(ops.reshape(tgt_idx, (1, S2)), (B, S2)))
+    is_label = ops.eq(ops.remainder(pos, 2), 1)            # (S2,) odd = label
+    ext = ops.where(ops.reshape(is_label, (1, S2)), tgt_gathered,
+                    ops.full((), blank, dtype=targets.dtype))
+
+    # skip transition s-2 -> s allowed when ext[s] is a label differing from
+    # ext[s-2]
+    ext_m2 = ops.cat([ops.full((B, 2), blank, dtype=ext.dtype),
+                      ops.narrow(ext, 1, 0, S2 - 2)], 1)
+    allow_skip = ops.logical_and(ops.reshape(is_label, (1, S2)),
+                                 ops.ne(ext, ext_m2))      # (B, S2)
+
+    def emit(t):
+        """log_probs[t] gathered at the extended targets: (B, S2)."""
+        lp_t = ops.squeeze(ops.narrow(log_probs, 0, t, 1), 0)  # (B, C)
+        return ops.gather(ops.convert_element_type(lp_t, f32), 1,
+                          ops.convert_element_type(ext, dtypes.int32))
+
+    # alpha_0: only s=0 (blank) and s=1 (first label) can start
+    start_mask = ops.reshape(ops.le(pos, 1), (1, S2))
+    alpha = ops.where(start_mask, emit(0), neg_inf)
+
+    ilen = ops.convert_element_type(input_lengths, dtypes.int32)
+    for t in range(1, T):
+        a1 = ops.cat([ops.full((B, 1), _CTC_LOG_EPS, dtype=f32),
+                      ops.narrow(alpha, 1, 0, S2 - 1)], 1)
+        a2 = ops.cat([ops.full((B, 2), _CTC_LOG_EPS, dtype=f32),
+                      ops.narrow(alpha, 1, 0, S2 - 2)], 1)
+        a2 = ops.where(allow_skip, a2, neg_inf)
+        new_alpha = ops.add(_safe_lse([alpha, a1, a2]), emit(t))
+        active = ops.reshape(ops.gt(ilen, t), (B, 1))  # t < input_length
+        alpha = ops.where(active, new_alpha, alpha)
+
+    # total log-likelihood: alpha at s = 2*target_len (final blank) and
+    # s = 2*target_len - 1 (final label; absent when target_len == 0)
+    tlen = ops.convert_element_type(target_lengths, dtypes.int32)
+    idx_blank = ops.reshape(ops.mul(tlen, 2), (B, 1))
+    l_blank = ops.squeeze(ops.gather(alpha, 1, idx_blank), 1)
+    idx_label = ops.clamp(ops.sub(idx_blank, 1), min=0)
+    l_label = ops.squeeze(ops.gather(alpha, 1, idx_label), 1)
+    l_label = ops.where(ops.gt(tlen, 0), l_label, neg_inf)
+    ll = _safe_lse([l_blank, l_label])
+    loss = ops.neg(ll)
+    if zero_infinity:
+        impossible = ops.gt(loss, -0.5 * _CTC_LOG_EPS)
+        loss = ops.where(impossible, ops.full((), 0.0, dtype=f32), loss)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return ops.sum(loss, None)
+    denom = ops.convert_element_type(ops.maximum(tlen, 1), f32)
+    return ops.mean(ops.true_divide(loss, denom), None)

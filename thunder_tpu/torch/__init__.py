@@ -1332,12 +1332,9 @@ def _t_amin(a, dim=None, keepdim=False, *, out=None):
 
 
 def _t_multinomial(a, num_samples, replacement=False, *, generator=None, out=None):
-    check(num_samples == 1 and a.ndim <= 2, "multinomial: only num_samples=1")
-    # Gumbel-max trick: argmax(log p + G) ~ Categorical(p)
-    logp = ops.log(ops.clamp(a, min=1e-30))
-    g = ops.neg(ops.log(ops.neg(ops.log(
-        ops.uniform(tuple(a.shape), 1e-20, 1.0, dtype=dtypes.float32)))))
-    return ops.unsqueeze(ops.argmax(ops.add(logp, g), dim=-1), -1)
+    out = ops.multinomial(a, num_samples, replacement=replacement)
+    # torch shape contract: 1-D input -> (num_samples,), 2-D -> (B, num_samples)
+    return out
 
 
 def _make_simple(op):
@@ -2361,3 +2358,65 @@ for _name, _impl in _EXTRA_METHODS.items():
     _desc = getattr(torch.Tensor, _name, None)
     if _desc is not None and _desc not in _torch_to_thunder_function_map:
         _torch_to_thunder_function_map[_desc] = _impl
+
+
+# ---------------------------------------------------------------------------
+# round-3 op tail: searchsorted family, bincount, kthvalue, grid_sample,
+# ctc_loss, cross, renorm (reference thunder/torch/__init__.py torchsymbols)
+# ---------------------------------------------------------------------------
+
+def _t_searchsorted(sorted_sequence, input, *, out_int32=False, right=False,
+                    side=None, out=None, sorter=None):
+    check(sorter is None, "searchsorted: sorter is unsupported (pre-sort instead)")
+    return ops.searchsorted(sorted_sequence, input, right=right, side=side)
+
+
+def _t_bucketize(input, boundaries, *, out_int32=False, right=False, out=None):
+    return ops.bucketize(input, boundaries, right=right)
+
+
+def _t_bincount(input, weights=None, minlength=0):
+    return ops.bincount(input, weights=weights, minlength=minlength)
+
+
+def _t_kthvalue(input, k, dim=-1, keepdim=False, *, out=None):
+    return ops.kthvalue(input, k, dim=dim, keepdim=keepdim)
+
+
+def _t_grid_sample(input, grid, mode="bilinear", padding_mode="zeros",
+                   align_corners=None):
+    return ops_nn.grid_sample(input, grid, mode=mode, padding_mode=padding_mode,
+                              align_corners=bool(align_corners))
+
+
+def _t_ctc_loss(log_probs, targets, input_lengths, target_lengths, blank=0,
+                reduction="mean", zero_infinity=False):
+    return ops_nn.ctc_loss(log_probs, targets, input_lengths, target_lengths,
+                           blank=blank, reduction=reduction,
+                           zero_infinity=zero_infinity)
+
+
+def _t_cross(input, other, dim=None, *, out=None):
+    return ops.cross(input, other, dim=dim)
+
+
+def _t_linalg_cross(input, other, *, dim=-1, out=None):
+    return ops.cross(input, other, dim=dim)
+
+
+def _t_renorm(input, p, dim, maxnorm, *, out=None):
+    return ops.renorm(input, p, dim, maxnorm)
+
+
+for _tfn, _impl in [
+    (torch.searchsorted, _t_searchsorted),
+    (torch.bucketize, _t_bucketize),
+    (torch.bincount, _t_bincount),
+    (torch.kthvalue, _t_kthvalue),
+    (F.grid_sample, _t_grid_sample),
+    (F.ctc_loss, _t_ctc_loss),
+    (torch.cross, _t_cross),
+    (torch.linalg.cross, _t_linalg_cross),
+    (torch.renorm, _t_renorm),
+]:
+    _torch_to_thunder_function_map[_tfn] = _impl
