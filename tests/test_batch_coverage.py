@@ -1,0 +1,130 @@
+"""Batching-rule coverage guard (VERDICT r2 item 6).
+
+Mirror of ``test_grad_coverage.py`` for the vmap transform: every prim must
+have a batching story — a registered rule, pointwise membership, or a
+documented reason it relies on the per-op opaque fallback / is exempt.
+Reference: per-prim batching rules, ``thunder/core/transforms.py:1656-1796``.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.core.batching import _POINTWISE, _batch_rules
+from thunder_tpu.core.prims import PrimIDs
+
+# prims that never appear in a batched computation (trace plumbing / guards)
+_NON_COMPUTE = {
+    PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL,
+    PrimIDs.PYTHON_PRINT, PrimIDs.SINK, PrimIDs.UNPACK_TRIVIAL,
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE,
+    PrimIDs.CHECK_NUMBER_TYPE, PrimIDs.DEVICE_PUT, PrimIDs.SHARDING_CONSTRAINT,
+}
+
+# batch-invariant producers: emit the same unbatched value for every batch
+# element; replay_batched re-emits them unbatched and broadcasts on use
+_BATCH_INVARIANT = {
+    PrimIDs.FULL, PrimIDs.IOTA, PrimIDs.RNG_KEY, PrimIDs.RNG_SPLIT,
+    PrimIDs.UNIFORM, PrimIDs.NORMAL, PrimIDs.RANDOM_BITS,
+}
+
+# prims that rely on the PER-OP opaque jax.vmap fallback: correct, but that
+# single op is invisible to executor claiming and trace-level grad. Each
+# entry carries the reason a trace-level rule hasn't been written.
+_PER_OP_FALLBACK_REASONED = {
+    PrimIDs.TAKE_ALONG_AXIS: "per-batch index semantics need a gather-with-"
+                             "batch-dims rule; fallback is a single gather",
+    PrimIDs.SCATTER_ADD: "batched scatter requires index prefixing; rare in "
+                         "vmapped models (optimizer-style op)",
+    PrimIDs.SCATTER: "same as SCATTER_ADD",
+    PrimIDs.INDEX_PUT: "same as SCATTER_ADD",
+    PrimIDs.INDEX_ADD: "same as SCATTER_ADD",
+    PrimIDs.DYNAMIC_SLICE: "batched start indices change per element; XLA "
+                           "lowers the vmap to gather efficiently",
+    PrimIDs.DYNAMIC_UPDATE_SLICE: "same as DYNAMIC_SLICE (KV-cache decode is "
+                                  "not a vmap workload)",
+    PrimIDs.CUMPROD_GRAD: "internal grad helper; reached only when "
+                          "differentiating under vmap of cumprod",
+    PrimIDs.CUMPROD_TANGENT: "internal jvp helper, same as CUMPROD_GRAD",
+    PrimIDs.SORT: "dim-shift rule possible but sort is claiming-neutral; "
+                  "jax.vmap(sort) lowers to the same batched sort",
+    PrimIDs.ARGSORT: "same as SORT",
+    PrimIDs.TOPK: "same as SORT",
+    PrimIDs.CONVOLUTION: "batch folding into feature dims needs layout "
+                         "plumbing; XLA's batched conv is already optimal",
+    PrimIDs.CONVOLUTION_BACKWARD: "same as CONVOLUTION",
+    PrimIDs.EINSUM: "equation rewriting (prepend batch subscript) is planned; "
+                    "fallback vmap of einsum is what jax itself does",
+}
+
+# genuinely impossible under vmap
+_UNSUPPORTED_REASONED = {
+    PrimIDs.ITEM: "host scalar extraction of a batched value is shape-"
+                  "dependent; jax.vmap rejects it identically",
+}
+
+
+def test_batching_rule_coverage_is_enumerable():
+    unaccounted = []
+    for p in PrimIDs:
+        if p in _batch_rules or p in _POINTWISE:
+            continue
+        if p in _NON_COMPUTE or p in _BATCH_INVARIANT:
+            continue
+        if p in _PER_OP_FALLBACK_REASONED:
+            assert _PER_OP_FALLBACK_REASONED[p], f"empty reason for {p}"
+            continue
+        if p in _UNSUPPORTED_REASONED:
+            continue
+        unaccounted.append(p.name)
+    assert not unaccounted, (
+        f"prims with no batching story: {unaccounted}. Register a rule in "
+        "core/batching.py or add a reasoned entry in this file.")
+
+
+def test_no_stale_exemptions():
+    stale = [p.name for p in list(_PER_OP_FALLBACK_REASONED) + list(_UNSUPPORTED_REASONED)
+             if p in _batch_rules or p in _POINTWISE]
+    assert not stale, f"exempted prims now have rules; drop them: {stale}"
+
+
+class TestPerOpFallback:
+    def test_surrounding_ops_stay_trace_level(self):
+        def f(a):
+            s, _ = ops.sort(a, -1)  # no batching rule: per-op opaque fallback
+            return ops.mul(s, 2.0)
+
+        vf = tt.jit(lambda a: tt.vmap(f)(a))
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(vf(x)), np.sort(x, -1) * 2,
+                                   rtol=1e-6)
+        src = tt.last_traces(vf)[0].python()
+        assert "vmap" in src   # only sort went opaque
+        assert "mul" in src    # neighbors remain ordinary trace IR
+
+    def test_vmapped_attention_keeps_pallas_claim(self, monkeypatch):
+        # VERDICT r2 done-criterion: a vmapped SDPA must still be claimed by
+        # the Pallas executor (the round-2 whole-function fallback lost it)
+        monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+        from thunder_tpu.ops import nn as ops_nn
+
+        rng = np.random.RandomState(0)
+        q = rng.randn(2, 2, 4, 8, 16).astype(np.float32)  # (vmap, B, H, T, hd)
+        k = rng.randn(2, 2, 4, 8, 16).astype(np.float32)
+        v = rng.randn(2, 2, 4, 8, 16).astype(np.float32)
+
+        def attn(q, k, v):
+            return ops_nn.scaled_dot_product_attention(q, k, v, is_causal=True)
+
+        jf = tt.jit(lambda q, k, v: tt.vmap(attn)(q, k, v),
+                    executors=["pallas", "xla"])
+        got = np.asarray(jf(q, k, v))
+        src = tt.last_execution_trace(jf).python()
+        assert "pallas_sdpa" in src or "sdpa_fwd" in src, src
+
+        # parity vs per-example computation
+        ref = np.stack([np.asarray(tt.jit(attn)(q[i], k[i], v[i]))
+                        for i in range(2)])
+        np.testing.assert_allclose(got, ref, atol=1e-5)

@@ -261,7 +261,9 @@ class ThunderTPUFunction:
         return args, kwargs
 
     # -- call ---------------------------------------------------------------
-    def __call__(self, *args, **kwargs):
+    def _entry_for(self, args, kwargs):
+        """Single cache-lookup/compile path shared by __call__ and the
+        compile-only entry point. Returns (entry, flat_inputs)."""
         if self.seq_buckets is not None:
             args, kwargs = self._pad_to_bucket(args, kwargs)
         flat, treedef = tree_flatten((args, kwargs))
@@ -275,6 +277,17 @@ class ThunderTPUFunction:
                 self._cache[key] = entry
         else:
             self._stats.cache_hits += 1
+        return entry, flat
+
+    def compile(self, *args, **kwargs) -> "CacheEntry":
+        """Compile for these inputs WITHOUT executing (tooling entry point:
+        ``examine`` and AOT-style inspection). Uses the same cache keying as
+        ``__call__``, so a later call with the same shapes hits the entry."""
+        entry, _ = self._entry_for(args, kwargs)
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        entry, flat = self._entry_for(args, kwargs)
         inps = [flat[i] for i in entry.tensor_indices]
         if entry.uses_rng:
             inps.append(_next_rng_key())

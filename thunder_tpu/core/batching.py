@@ -350,7 +350,27 @@ def replay_batched(bsyms, env: dict, B: int):
             check(not missing, lambda: f"batched replay of {bsym.sym.name} decomposition "
                                        f"left outputs unbound: {[m.name for m in missing]}")
             continue
-        raise NoBatchRule(f"no batching rule for prim {bsym.sym.name} (id={sid})")
+        # PER-OP opaque fallback (VERDICT r2 item 6): lower just THIS op via
+        # jax.vmap; everything else in the trace stays trace-level batched, so
+        # executor claims (Pallas SDPA) and grad visibility survive around it.
+        # Nested-list operands (cat-style) can't map onto vmap_call's
+        # positional in_axes — those still punt to the whole-function path.
+        if any(isinstance(bd, list) for bd in bdims):
+            raise NoBatchRule(
+                f"no batching rule for prim {bsym.sym.name} (id={sid}) with "
+                f"sequence operands")
+        if any(isinstance(v, Proxy) for v in bsym.kwargs.values()):
+            # a tensor kwarg would be closure-captured into vmap_call's inner
+            # trace (unbatched, and invisible to its env) — punt whole-function
+            raise NoBatchRule(
+                f"no batching rule for prim {bsym.sym.name} (id={sid}) with "
+                f"proxy kwargs")
+        from thunder_tpu.core.transforms import vmap_call
+
+        kwargs = {k: _map_args(env, v)[0] for k, v in bsym.kwargs.items()}
+        axes = tuple(0 if bd == 0 else None for bd in bdims)
+        out = vmap_call(lambda *a: bsym.sym(*a, **kwargs), in_axes=axes)(*vals)
+        bind(bsym.output, out, 0)
 
 
 def inline_vmap(fn: Callable, in_axes=0):
