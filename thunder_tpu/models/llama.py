@@ -172,8 +172,15 @@ def _block(h, layer, cfg: LlamaConfig, cos, sin):
     return _mlp(h, layer, cfg)
 
 
-def forward_hidden(params, tokens, cfg: LlamaConfig):
-    """tokens: (B, T) int32 -> final hidden states (B, T, D) (pre-lm_head)."""
+def forward_hidden(params, tokens, cfg: LlamaConfig, remat: bool = False):
+    """tokens: (B, T) int32 -> final hidden states (B, T, D) (pre-lm_head).
+
+    ``remat=True`` wraps every transformer block in ``tt.checkpoint``:
+    the backward recomputes each block from its input instead of saving
+    intermediates — per-layer activation memory drops from ~dozens of
+    (B,T,*) tensors to one, which is what lets deep 7B-geometry stacks
+    train on a single 16 GB chip (reference analog: litgpt
+    benchmark's activation checkpointing flag)."""
     B, T = tokens.shape
     h = ops.embedding(tokens, params["tok_embedding"])  # (B, T, D)
     from thunder_tpu.distributed import current_cp
@@ -188,32 +195,41 @@ def forward_hidden(params, tokens, cfg: LlamaConfig):
     n_rep = cfg.n_heads // cfg.kv_heads
     hd = cfg.head_dim
 
-    for layer in params["layers"]:
-        h = _block(h, layer, cfg, cos, sin)
+    if remat:
+        from thunder_tpu.core.rematerialization import checkpoint as _ckpt
+
+        block = _ckpt(lambda x, lyr: _block(x, lyr, cfg, cos, sin))
+        for layer in params["layers"]:
+            h = block(h, layer)
+    else:
+        for layer in params["layers"]:
+            h = _block(h, layer, cfg, cos, sin)
 
     return ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
 
 
-def forward(params, tokens, cfg: LlamaConfig):
+def forward(params, tokens, cfg: LlamaConfig, remat: bool = False):
     """tokens: (B, T) int32 -> logits (B, T, vocab)."""
-    return ops.linear(forward_hidden(params, tokens, cfg), params["lm_head"])
+    return ops.linear(forward_hidden(params, tokens, cfg, remat=remat),
+                      params["lm_head"])
 
 
-def loss_fn(params, tokens, targets, cfg: LlamaConfig):
-    logits = forward(params, tokens, cfg)
+def loss_fn(params, tokens, targets, cfg: LlamaConfig, remat: bool = False):
+    logits = forward(params, tokens, cfg, remat=remat)
     B, T, V = logits.shape
     logits = ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32)
     return ops.cross_entropy(logits, ops.reshape(targets, (B * T,)))
 
 
-def fused_loss_fn(params, tokens, targets, cfg: LlamaConfig, chunk: int = 8192):
+def fused_loss_fn(params, tokens, targets, cfg: LlamaConfig, chunk: int = 8192,
+                  remat: bool = False):
     """Chunked-vocab loss: lm_head projection fused into the cross-entropy
     (``nn.fused_linear_cross_entropy``) — the (B*T, vocab) logits are never
     materialized. Drop-in for ``loss_fn`` when activation memory is the
     constraint (large vocab / long sequence)."""
     from thunder_tpu.ops import nn as tnn
 
-    h = forward_hidden(params, tokens, cfg)
+    h = forward_hidden(params, tokens, cfg, remat=remat)
     B, T, D = h.shape
     loss, _lse = tnn.fused_linear_cross_entropy(
         ops.reshape(h, (B * T, D)), params["lm_head"],
