@@ -21,6 +21,7 @@ _NON_COMPUTE = {
     PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
     PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE,
     PrimIDs.CHECK_NUMBER_TYPE, PrimIDs.DEVICE_PUT, PrimIDs.SHARDING_CONSTRAINT,
+    PrimIDs.OPT_BARRIER,  # scheduling pin; appears only in backward emissions
 }
 
 # batch-invariant producers: emit the same unbatched value for every batch

@@ -42,6 +42,7 @@ from thunder_tpu.core.symbol import Symbol
 class PrimIDs(Enum):
     # utility
     PYTHON_RETURN = auto(); COMMENT = auto(); PYTHON_DEL = auto(); PYTHON_PRINT = auto(); SINK = auto()
+    OPT_BARRIER = auto()
     # prologue check/unpack
     UNPACK_TRIVIAL = auto(); CHECK_TENSOR_SHAPE_AND_METADATA = auto()
     CHECK_NUMBER_TYPE_AND_VALUE = auto(); CHECK_STRING_VALUE = auto(); CHECK_LITERAL_LIKE = auto()
@@ -183,6 +184,23 @@ comment = make_prim(PrimIDs.COMMENT, "comment", lambda s: None, tags=(OpTags.DON
 python_del = make_prim(PrimIDs.PYTHON_DEL, "python_del", lambda *args: None, tags=(OpTags.DONT_DCE,))
 python_print = make_prim(PrimIDs.PYTHON_PRINT, "python_print", lambda *args: None, tags=(OpTags.DONT_DCE,))
 sink = make_prim(PrimIDs.SINK, "sink", lambda *args, **kwargs: None, tags=(OpTags.DONT_DCE,))
+
+
+def _opt_barrier_meta(*args):
+    """Identity over its operands, opaque to optimization: lowers to
+    ``jax.lax.optimization_barrier``. Used to PIN rematerialized regions —
+    without it XLA (and this framework's own CSE, which keys on operand
+    identity) merges a checkpoint's recompute back into the forward's saved
+    value, silently voiding the memory saving."""
+    out = []
+    for a in args:
+        check(isinstance(a, TensorProxy),
+              lambda: "opt_barrier operands must be tensors")
+        out.append(TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device))
+    return tuple(out)
+
+
+opt_barrier = make_prim(PrimIDs.OPT_BARRIER, "opt_barrier", _opt_barrier_meta)
 
 
 # ---------------------------------------------------------------------------

@@ -339,9 +339,24 @@ def checkpoint(fn):
             out = sym(*rargs)
 
             def pullback(g):
-                # recompute: replay the region's forward collecting pullbacks
+                # recompute: replay the region's forward collecting pullbacks.
+                # The replay's tensor inputs pass through an opt_barrier tied
+                # to the incoming COTANGENT: without that pin, XLA (and this
+                # framework's own CSE) merges the recompute with the original
+                # forward, resurrecting the saved activations and silently
+                # voiding the checkpoint (measured: identical XLA temp bytes)
+                g_tensors = [x for x in (g if isinstance(g, (tuple, list)) else (g,))
+                             if isinstance(x, TensorProxy)]
+                tensor_slots = [i for i, leaf in enumerate(rargs)
+                                if isinstance(leaf, TensorProxy)]
+                pinned_args = list(rargs)
+                if tensor_slots and g_tensors:
+                    pinned = prims.opt_barrier(
+                        *[rargs[i] for i in tensor_slots], *g_tensors)
+                    for slot, i in enumerate(tensor_slots):
+                        pinned_args[i] = pinned[slot]
                 env: dict = {}
-                for p, leaf in zip(inner_inputs, rargs):
+                for p, leaf in zip(inner_inputs, pinned_args):
                     env[Variable(p)] = leaf
                 records = augmented_forward(inner.bound_symbols, env)
                 re_out = _env_map(env, inner.output)
@@ -353,8 +368,11 @@ def checkpoint(fn):
                     if ct is not None:
                         grads[Variable(o)] = ct
                 backward_pass(records, grads)
-                return [(leaf, grads.get(Variable(leaf)))
-                        for leaf in rargs if isinstance(leaf, TensorProxy)]
+                # grads accumulated against the PINNED proxies; hand them
+                # back keyed on the caller's original leaves
+                return [(orig, grads.get(Variable(pinned_leaf)))
+                        for orig, pinned_leaf in zip(rargs, pinned_args)
+                        if isinstance(orig, TensorProxy)]
 
             return out, pullback
 

@@ -1393,3 +1393,14 @@ def _dot_general_vjp(a, b, *, contract_dims, batch_dims=((), ()), preferred_elem
         return _pairs((a, ga), (b, gb))
 
     return out, pullback
+
+
+@register_vjp(PrimIDs.OPT_BARRIER)
+def _opt_barrier_vjp(*args):
+    out = prims.opt_barrier(*args)
+
+    def pullback(g):
+        gs = list(g) if isinstance(g, (tuple, list)) else [g]
+        return [(a, ct) for a, ct in zip(args, gs)]  # identity: 1:1 with args
+
+    return out, pullback

@@ -334,6 +334,11 @@ def propagate_specs(trc, input_specs: dict) -> dict:
                 continue  # already computed (e.g. fusion wrapper after subsymbols)
             tas = _tensor_args_specs(bsym, env)
 
+            if sid is PrimIDs.OPT_BARRIER:
+                # identity barrier: output i inherits operand i's layout
+                for (a, s), o in zip(tas, outs):
+                    env[Variable(o)] = s
+                continue
             if sid in _POINTWISE:
                 specs = []
                 for a, s in tas:

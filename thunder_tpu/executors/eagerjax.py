@@ -63,6 +63,13 @@ def _sink(*args, **kwargs):
     return None
 
 
+@impl(PrimIDs.OPT_BARRIER)
+def _opt_barrier(*args):
+    import jax
+
+    return tuple(jax.lax.optimization_barrier(tuple(args)))
+
+
 # -- prologue guards --------------------------------------------------------
 
 def _guard(cond, msg):
