@@ -13,6 +13,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import sys
 import time
 
 
@@ -37,6 +38,17 @@ def main():
     p.add_argument("--devices", type=int, default=None,
                    help="force an N-device virtual CPU mesh (hermetic "
                         "distributed benchmarking without hardware)")
+    p.add_argument("--data", default=None,
+                   help="tokenized binary shard (.bin) to stream from via the "
+                        "native input pipeline (epoch-exact shuffle, prefetch, "
+                        "restart-deterministic); default: synthetic tokens")
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--start-step", type=int, default=0,
+                   help="resume data position (the stream is a pure function "
+                        "of step: restarting at step k replays exactly)")
+    p.add_argument("--audit", action="store_true",
+                   help="print per-step losses (costs one host sync per step "
+                        "— replay verification, NOT for timing runs)")
     args = p.parse_args()
 
     import jax
@@ -119,9 +131,25 @@ def main():
 
     params = llama.init_params(llama.CONFIGS[args.model], seed=0, scale_layers=n_layers)
     opt_state = opt.init(params)
-    rng = np.random.RandomState(0)
-    tokens = rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq)).astype(np.int32)
-    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    if args.data:
+        from thunder_tpu.data import ShardedTokenStream
+
+        stream = ShardedTokenStream(args.data, batch=args.batch, seq=args.seq,
+                                    seed=args.data_seed)
+
+        def data_fn(step):
+            t, g = stream.batch_at(step)
+            return np.clip(t, 0, cfg.vocab_size - 1), \
+                np.clip(g, 0, cfg.vocab_size - 1)
+    else:
+        rng = np.random.RandomState(0)
+        fixed = rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq)).astype(np.int32)
+        fixed_t = np.roll(fixed, -1, 1).astype(np.int32)
+
+        def data_fn(step):
+            return fixed, fixed_t
+
+    tokens, targets = data_fn(args.start_step)
 
     def force(x):
         # block_until_ready is a no-op on tunneled platforms; a ONE-ELEMENT
@@ -141,8 +169,12 @@ def main():
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    for k in range(args.steps):
+        tokens, targets = data_fn(args.start_step + 1 + k)
         loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+        if args.audit:  # replay-audit mode: per-step loss (costs a sync)
+            print(f"step {args.start_step + 1 + k} "
+                  f"loss {float(np.asarray(loss)):.6f}", file=sys.stderr)
     force_chain(loss, params)
     dt = (time.perf_counter() - t0) / args.steps
 

@@ -84,7 +84,7 @@ def make_pipeline_loss(embed_fn: Callable, stage_fn: Callable, head_loss_fn: Cal
         if remat_stages:
             from thunder_tpu.core.rematerialization import checkpoint as _ckpt
 
-            run_stage = lambda p, h: _ckpt(stage_fn)(p, h)  # noqa: E731
+            run_stage = _ckpt(stage_fn)
 
         pp = current_pp()
         if pp is None or pp[1] == 1:
