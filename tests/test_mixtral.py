@@ -99,3 +99,74 @@ def test_expert_parallel_trace_has_all_to_all(eight_devices):
     jstep(params, opt.init(params), tokens, targets)
     src = tt.last_traces(jstep)[0].python()
     assert "all_to_all" in src
+
+
+def test_dropless_mode_drops_nothing_and_matches_large_capacity():
+    """dropless=True (C=S static worst case) must drop zero assignments and
+    agree with a generously-capacitated run (VERDICT r2 item 10)."""
+    import dataclasses
+
+    cfg = _cfg()
+    params = mixtral.init_params(cfg, seed=3)
+    rng = np.random.RandomState(3)
+    tokens = rng.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+
+    cfg_dl = dataclasses.replace(cfg, dropless=True)
+    cfg_big = dataclasses.replace(cfg, capacity_factor=100.0)
+    out_dl = np.asarray(tt.jit(lambda p, t: mixtral.forward(p, t, cfg_dl))(params, tokens))
+    out_big = np.asarray(tt.jit(lambda p, t: mixtral.forward(p, t, cfg_big))(params, tokens))
+    np.testing.assert_allclose(out_dl, out_big, rtol=1e-5, atol=1e-6)
+
+    rep = mixtral.expert_utilization(params, tokens, cfg_dl)
+    assert all(r["drop_rate"] == 0.0 for r in rep)
+    assert all(r["capacity"] == 64 for r in rep)  # S = 2*32
+
+
+def test_expert_utilization_report_shape():
+    cfg = _cfg()
+    params = mixtral.init_params(cfg, seed=4)
+    rng = np.random.RandomState(4)
+    tokens = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    rep = mixtral.expert_utilization(params, tokens, cfg)
+    assert len(rep) == cfg.n_layers
+    for r in rep:
+        assert len(r["tokens_per_expert"]) == cfg.n_experts
+        assert 0.0 <= r["drop_rate"] <= 1.0
+        assert 0.0 < r["expert_usage"] <= 1.0
+        assert abs(sum(r["router_load"]) - 1.0) < 1e-2
+
+
+def test_capacity_sweep_monotone():
+    cfg = _cfg()
+    params = mixtral.init_params(cfg, seed=5)
+    rng = np.random.RandomState(5)
+    tokens = rng.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    sweep = mixtral.capacity_sweep(params, tokens, cfg, factors=(1.0, 2.0, 4.0))
+    assert sweep[1.0] >= sweep[2.0] >= sweep[4.0] >= 0.0
+    assert sweep["dropless"] == 0.0
+
+
+def test_expert_parallel_dropless_matches_single_device(eight_devices):
+    """8-dev EP training in dropless mode == single device (the committed
+    MIXTRAL_EP.md claim)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(), dropless=True)
+    params = mixtral.init_params(cfg, seed=6)
+    opt = SGD(lr=1e-2)
+    rng = np.random.RandomState(6)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+
+    def run(jstep, p, s):
+        losses = []
+        for _ in range(3):
+            loss, p, s = jstep(p, s, tokens, targets)
+            losses.append(float(np.asarray(loss)))
+        return losses, p
+
+    ref_losses, _ = run(tt.jit(_make_step(cfg, opt)), params, opt.init(params))
+    jstep = expert_parallel(_make_step(cfg, opt), MeshSpec.make(ep=8),
+                            expert_patterns=mixtral.EP_PATTERNS)
+    ep_losses, _ = run(jstep, params, opt.init(params))
+    np.testing.assert_allclose(ref_losses, ep_losses, atol=1e-5, rtol=1e-5)

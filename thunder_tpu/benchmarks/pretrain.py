@@ -21,7 +21,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="tiny", help="llama config name")
     p.add_argument("--mode", default="single",
-                   choices=["single", "fsdp", "hsdp", "ddp", "tp", "cp",
+                   choices=["single", "fsdp", "hsdp", "ddp", "tp", "cp", "ep",
                             "tp_dp", "fsdp_tp"])
     p.add_argument("--replicas", type=int, default=2,
                    help="hsdp: replica-axis size (shard axis gets the rest)")
@@ -69,13 +69,21 @@ def main():
     from thunder_tpu.models import llama
     from thunder_tpu.optim import AdamW
 
-    cfg = llama.CONFIGS[args.model]
+    if args.mode == "ep":
+        from thunder_tpu.models import mixtral as model_mod
+
+        cfg = model_mod.CONFIGS["tiny-moe" if args.model == "tiny" else args.model]
+        loss_mod = model_mod
+    else:
+        model_mod = llama
+        cfg = llama.CONFIGS[args.model]
+        loss_mod = llama
     n_layers = args.layers if args.layers is not None else cfg.n_layers
     opt = AdamW(lr=args.lr)
 
     def train_step(params, opt_state, tokens, targets):
         loss, grads = tt.value_and_grad(
-            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+            lambda p: loss_mod.loss_fn(p, tokens, targets, cfg))(params)
         return loss, *opt.update(params, grads, opt_state)
 
     n_dev = len(jax.devices())
@@ -102,6 +110,18 @@ def main():
         from thunder_tpu.distributed import context_parallel
 
         jstep = context_parallel(train_step, MeshSpec.make(sp=n_dev))
+    elif args.mode == "ep":
+        from thunder_tpu.distributed import expert_parallel
+        from thunder_tpu.models import mixtral
+
+        if cfg.n_experts % n_dev:
+            raise SystemExit(f"{cfg.n_experts} experts must divide the "
+                             f"device count {n_dev}")
+        if args.batch % n_dev:
+            raise SystemExit(f"--batch {args.batch} must divide the device "
+                             f"count {n_dev} (the batch shards on the ep axis)")
+        jstep = expert_parallel(train_step, MeshSpec.make(ep=n_dev),
+                                expert_patterns=mixtral.EP_PATTERNS)
     elif args.mode == "tp":
         from thunder_tpu.distributed import tensor_parallel
 
@@ -129,7 +149,8 @@ def main():
                             column_patterns=llama.TP_COLUMN_PATTERNS,
                             row_patterns=llama.TP_ROW_PATTERNS)
 
-    params = llama.init_params(llama.CONFIGS[args.model], seed=0, scale_layers=n_layers)
+    params = model_mod.init_params(cfg if args.mode == "ep" else llama.CONFIGS[args.model],
+                                   seed=0, scale_layers=n_layers)
     opt_state = opt.init(params)
     if args.data:
         from thunder_tpu.data import ShardedTokenStream
@@ -178,11 +199,27 @@ def main():
     force_chain(loss, params)
     dt = (time.perf_counter() - t0) / args.steps
 
-    base_cfg = llama.CONFIGS[args.model]
     tokens_per_step = args.batch * args.seq
     tps = tokens_per_step / dt
-    fpt = llama.flops_per_token(base_cfg, args.seq, n_layers)
+    if args.mode == "ep":
+        # MoE FLOPs/token: attention as dense + top_k of E expert MLPs
+        base_cfg = cfg
+        fpt = llama.flops_per_token(cfg, args.seq, n_layers) \
+            * (1 + (cfg.top_k - 1) / max(1, cfg.n_experts))  # rough active-expert scale
+    else:
+        base_cfg = llama.CONFIGS[args.model]
+        fpt = llama.flops_per_token(base_cfg, args.seq, n_layers)
     mfu = tps * fpt / (args.peak_tflops * 1e12 * max(1, n_dev))
+    if args.mode == "ep":
+        # expert-utilization report (VERDICT r2 item 10): routing health of
+        # the trained params on the last batch
+        import json
+
+        from thunder_tpu.models import mixtral as _mx
+
+        rep = _mx.expert_utilization(params, tokens, cfg)
+        for li, r in enumerate(rep):
+            print(f"expert-utilization layer{li}: {json.dumps(r)}", file=sys.stderr)
     print(f"model={args.model} layers={n_layers} mode={args.mode} devices={n_dev}")
     print(f"compile {compile_s:.1f}s | {dt*1e3:.1f} ms/step | {tps:,.0f} tokens/s "
           f"| MFU {mfu*100:.1f}% | loss {float(np.asarray(loss)):.4f}")

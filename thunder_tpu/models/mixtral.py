@@ -31,6 +31,13 @@ class MixtralConfig:
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 2.0
+    # dropless: per-expert capacity = S (the static worst case — a token can
+    # reach an expert at most once), so NO token is ever dropped. Memory for
+    # the dispatch tensors grows from O(S·E·S·cf·k/E)=O(S²·cf·k) to O(S²·E);
+    # the TPU-idiomatic middle ground is a measured capacity_factor (see
+    # capacity_sweep / MIXTRAL_EP.md). Ragged MegaBlocks-style block-sparse
+    # dispatch needs a Pallas kernel and stays future work.
+    dropless: bool = False
     max_seq_len: int = 256
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -93,15 +100,21 @@ def init_params(cfg: MixtralConfig, seed: int = 0, scale_layers: int | None = No
     return params
 
 
-def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig):
-    """x: (S, D) flattened tokens. Returns (out (S, D), aux_loss scalar)."""
+def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
+            return_metrics: bool = False):
+    """x: (S, D) flattened tokens. Returns ``(out (S, D), aux_loss scalar)``,
+    plus a metrics dict (tokens kept per expert, assignment drop rate, router
+    load fractions) when ``return_metrics``."""
     from thunder_tpu.distributed import current_ep
     from thunder_tpu.distributed import prims as dist_prims
 
     S, D = x.shape
     E = router_w.shape[0]
     k = cfg.top_k
-    C = max(1, int(math.ceil(S * cfg.capacity_factor * k / E)))
+    if cfg.dropless:
+        C = S  # static worst case: every token can reach an expert at most once
+    else:
+        C = max(1, min(S, int(math.ceil(S * cfg.capacity_factor * k / E))))
 
     logits = ops.linear(ops.convert_element_type(x, dtypes.float32),
                         ops.convert_element_type(router_w, dtypes.float32))  # (S, E)
@@ -126,10 +139,21 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig):
         dispatch = disp_j if dispatch is None else ops.add(dispatch, disp_j)
         combine = comb_j if combine is None else ops.add(combine, comb_j)
 
-    # load-balancing auxiliary loss (Switch/Mixtral style)
+    # load-balancing auxiliary loss (Switch/Mixtral style). Under expert
+    # parallelism the batch is sharded, and the loss is NONLINEAR in the
+    # router statistics — the fractions must be averaged over the ep axis
+    # BEFORE the product, or per-shard aux averaged afterwards diverges from
+    # the single-device value (measured 0.008 on a 6.66 loss)
     frac_tokens = ops.mean(ops.convert_element_type(
         ops.one_hot(topi[:, 0], E), dtypes.float32), 0)
     frac_probs = ops.mean(probs, 0)
+    ep = current_ep()
+    if ep is not None:
+        axis, n = ep
+        frac_tokens = ops.true_divide(
+            dist_prims.wait(dist_prims.all_reduce(frac_tokens, axis, "sum")), float(n))
+        frac_probs = ops.true_divide(
+            dist_prims.wait(dist_prims.all_reduce(frac_probs, axis, "sum")), float(n))
     aux = ops.mul(ops.sum(ops.mul(frac_tokens, frac_probs)), float(E) * cfg.router_aux_coef)
 
     xf = ops.convert_element_type(x, dtypes.float32)
@@ -155,16 +179,29 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig):
         expert_out = dist_prims.wait(dist_prims.all_to_all(expert_out, axis, 1, 0, n))  # (E, C, D)
 
     out = prims.dot_general(combine, expert_out, contract_dims=(((1, 2)), ((0, 1))))  # (S, D)
-    return ops.convert_element_type(out, x.dtype), aux
+    out = ops.convert_element_type(out, x.dtype)
+    if return_metrics:
+        total_assignments = float(S * k)
+        metrics = {
+            "tokens_per_expert": counts,                       # kept, (E,)
+            "drop_rate": ops.sub(1.0, ops.true_divide(
+                ops.sum(counts, None), total_assignments)),    # scalar
+            "router_load": frac_probs,                         # (E,) mean prob
+            "capacity": C,
+        }
+        return out, aux, metrics
+    return out, aux
 
 
-def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False):
+def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False,
+            return_metrics: bool = False):
     B, T = tokens.shape
     h = ops.embedding(tokens, params["tok_embedding"])
     cos, sin = _llama._rope_cos_sin(cfg, T, h.dtype)
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.kv_heads
     aux_total = None
+    layer_metrics = []
 
     for layer in params["layers"]:
         x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
@@ -186,13 +223,21 @@ def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False):
         h = ops.add(h, ops.linear(attn, layer["wo"]))
 
         x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
-        moe_out, aux = moe_ffn(ops.reshape(x, (B * T, cfg.dim)), layer["router"],
-                               layer["we_gate"], layer["we_up"], layer["we_down"], cfg)
+        res = moe_ffn(ops.reshape(x, (B * T, cfg.dim)), layer["router"],
+                      layer["we_gate"], layer["we_up"], layer["we_down"], cfg,
+                      return_metrics=return_metrics)
+        if return_metrics:
+            moe_out, aux, metrics = res
+            layer_metrics.append(metrics)
+        else:
+            moe_out, aux = res
         h = ops.add(h, ops.reshape(moe_out, (B, T, cfg.dim)))
         aux_total = aux if aux_total is None else ops.add(aux_total, aux)
 
     h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
     logits = ops.linear(h, params["lm_head"])
+    if return_metrics:
+        return logits, aux_total, layer_metrics
     if return_aux:
         return logits, aux_total
     return logits
@@ -204,3 +249,44 @@ def loss_fn(params, tokens, targets, cfg: MixtralConfig):
     ce = ops.cross_entropy(ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32),
                            ops.reshape(targets, (B * T,)))
     return ops.add(ce, aux)
+
+
+def expert_utilization(params, tokens, cfg: MixtralConfig):
+    """Per-layer expert routing report (VERDICT r2 item 10): tokens kept per
+    expert, assignment drop rate, router load fractions, fraction of experts
+    used, and max/mean load imbalance. Compiled+run once on ``tokens``."""
+    import numpy as np
+
+    import thunder_tpu as tt
+
+    jf = tt.jit(lambda p, t: forward(p, t, cfg, return_metrics=True))
+    _logits, _aux, metrics = jf(params, tokens)
+    report = []
+    for m in metrics:
+        tpe = np.asarray(m["tokens_per_expert"])
+        report.append({
+            "tokens_per_expert": tpe.astype(int).tolist(),
+            "drop_rate": float(np.asarray(m["drop_rate"])),
+            "router_load": np.round(np.asarray(m["router_load"]), 4).tolist(),
+            "capacity": int(m["capacity"]),
+            "expert_usage": float((tpe > 0).mean()),
+            "load_imbalance": float(tpe.max() / max(tpe.mean(), 1e-9)),
+        })
+    return report
+
+
+def capacity_sweep(params, tokens, cfg: MixtralConfig,
+                   factors=(1.0, 1.25, 1.5, 2.0, 4.0)):
+    """Max per-layer assignment drop rate for each capacity factor (plus the
+    dropless mode as reference) — the tuning table MIXTRAL_EP.md commits."""
+    import dataclasses
+
+    out = {}
+    for f in factors:
+        c2 = dataclasses.replace(cfg, capacity_factor=f, dropless=False)
+        rep = expert_utilization(params, tokens, c2)
+        out[f] = max(r["drop_rate"] for r in rep)
+    c_dropless = dataclasses.replace(cfg, dropless=True)
+    rep = expert_utilization(params, tokens, c_dropless)
+    out["dropless"] = max(r["drop_rate"] for r in rep)
+    return out
