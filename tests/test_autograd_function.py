@@ -402,3 +402,26 @@ class TestCheckpointLookaside:
         # saves are region inputs (x, w, b) + the region output only
         saved = [a for a in bwd_raw.args]
         assert len([s for s in saved if getattr(s, "ndim", 0) == 2]) <= 3
+
+
+class TestCheckpointSequential:
+    def test_checkpoint_sequential_traces_with_recompute(self):
+        """torch.utils.checkpoint_sequential resolves the module-global
+        checkpoint at call time, so the closure-cell lookaside covers it."""
+        import torch.utils.checkpoint as tuc
+
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.seq = torch.nn.Sequential(
+                    torch.nn.Linear(8, 8), torch.nn.Tanh(),
+                    torch.nn.Linear(8, 8), torch.nn.Tanh())
+
+            def forward(self, x):
+                return tuc.checkpoint_sequential(
+                    self.seq, 2, x, use_reentrant=False).sum()
+
+        torch.manual_seed(0)
+        jm = _grads_match(M, torch.randn(4, 8), atol=1e-4)
+        step = next(iter(jm._autograd_cache.values()))
+        assert "checkpoint(" in step.computation_trace.python()

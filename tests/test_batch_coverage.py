@@ -56,8 +56,6 @@ _PER_OP_FALLBACK_REASONED = {
     PrimIDs.CONVOLUTION: "batch folding into feature dims needs layout "
                          "plumbing; XLA's batched conv is already optimal",
     PrimIDs.CONVOLUTION_BACKWARD: "same as CONVOLUTION",
-    PrimIDs.EINSUM: "equation rewriting (prepend batch subscript) is planned; "
-                    "fallback vmap of einsum is what jax itself does",
 }
 
 # genuinely impossible under vmap
@@ -129,3 +127,23 @@ class TestPerOpFallback:
         ref = np.stack([np.asarray(tt.jit(attn)(q[i], k[i], v[i]))
                         for i in range(2)])
         np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_einsum_batching_rule_trace_level():
+    """Einsum batches by equation rewriting (fresh batch subscript), staying
+    trace-level — no opaque vmap symbol."""
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4, 5).astype(np.float32)
+    b = rng.randn(5, 6).astype(np.float32)
+    c = rng.randn(3, 6, 2).astype(np.float32)
+
+    def f(a, c):
+        h = ops.einsum("ij,jk->ik", a, b)   # closure operand stays unbatched
+        return ops.einsum("ik,kl->il", h, c)
+
+    vf = tt.jit(lambda a, c: tt.vmap(f)(a, c))
+    got = np.asarray(vf(a, c))
+    want = np.stack([(a[i] @ b) @ c[i] for i in range(3)])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    src = tt.last_traces(vf)[0].python()
+    assert "einsum" in src and "vmap0" not in src

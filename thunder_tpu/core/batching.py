@@ -440,3 +440,28 @@ def inline_vmap(fn: Callable, in_axes=0):
         return tree_map(read, inner.output, is_leaf=lambda x: isinstance(x, Proxy))
 
     return wrapped
+
+
+@register_batching_rule(PrimIDs.EINSUM)
+def _einsum_batch(bsym, vals, bdims, B):
+    """Equation rewriting: prepend a fresh batch subscript to every batched
+    operand and to the output. Ellipsis / implicit-output equations punt to
+    the per-op fallback (same behavior jax.vmap would give them)."""
+    equation = vals[0]
+    eq = equation.replace(" ", "") if isinstance(equation, str) else None
+    if not eq or "->" not in eq or "." in eq:
+        raise NoBatchRule("einsum batching needs an explicit '->' and no ellipsis")
+    lhs, rhs = eq.split("->")
+    specs = lhs.split(",")
+    operands = vals[1:]
+    obdims = bdims[1:]
+    if len(specs) != len(operands):
+        raise NoBatchRule("einsum spec/operand arity mismatch")
+    batch_char = next((c for c in "zyxwvutsrqponmlkjihgfedcbaZYXWVUTSRQPONMLKJIHGFEDCBA"
+                       if c not in eq), None)
+    if batch_char is None:
+        raise NoBatchRule("einsum equation exhausts the subscript alphabet")
+    new_specs = [(batch_char + s) if bd == 0 else s
+                 for s, bd in zip(specs, obdims)]
+    out = prims.einsum(",".join(new_specs) + "->" + batch_char + rhs, *operands)
+    return out, 0
