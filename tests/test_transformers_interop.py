@@ -225,3 +225,39 @@ def test_hf_vit_parity():
     logits = _logits(out)
     arr = logits.detach().numpy() if isinstance(logits, torch.Tensor) else np.asarray(logits)
     np.testing.assert_allclose(arr, ref.numpy(), atol=1e-4)
+
+
+@pytest.mark.parametrize("family,make", [
+    ("mistral", lambda tr: tr.MistralModel(tr.MistralConfig(
+        num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+        num_key_value_heads=1, intermediate_size=64, vocab_size=100))),
+    ("qwen2", lambda tr: tr.Qwen2Model(tr.Qwen2Config(
+        num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+        num_key_value_heads=1, intermediate_size=64, vocab_size=100))),
+    ("gptneox", lambda tr: tr.GPTNeoXModel(tr.GPTNeoXConfig(
+        num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+        intermediate_size=64, vocab_size=100))),
+    ("roberta", lambda tr: tr.RobertaModel(tr.RobertaConfig(
+        num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+        intermediate_size=64, vocab_size=100))),
+    ("distilbert", lambda tr: tr.DistilBertModel(tr.DistilBertConfig(
+        n_layers=1, dim=32, n_heads=2, hidden_dim=64, vocab_size=100))),
+])
+def test_hf_family_forward_parity(family, make):
+    """Round-3 families: GQA/sliding-window decoders (Mistral/Qwen2),
+    parallel-residual (GPT-NeoX), and encoder variants. The decoders return
+    DynamicCache state; its tensor leaves flow through the jit while
+    non-returnable metadata (torch.device/dtype) is filtered at unwrap."""
+    transformers = pytest.importorskip("transformers")
+
+    torch.manual_seed(0)
+    m = make(transformers)
+    m.eval()
+    jm = tt.jit(m)
+    ids = torch.randint(0, 100, (1, 16))
+    with torch.no_grad():
+        got = jm(ids)
+        want = m(ids)
+    g = got["last_hidden_state"] if isinstance(got, dict) else got.last_hidden_state
+    np.testing.assert_allclose(np.asarray(g), want.last_hidden_state.numpy(),
+                               atol=5e-6)

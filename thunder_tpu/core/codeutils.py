@@ -52,6 +52,15 @@ def prettyprint(x: Any) -> str:
         return "[" + ", ".join(prettyprint(i) for i in x) + "]"
     if isinstance(x, dict):
         return "{" + ", ".join(f"{prettyprint(k)}: {prettyprint(v)}" for k, v in x.items()) + "}"
+    # torch values leaking into a traced OUTPUT tree (HF model outputs can
+    # carry config dtypes): print their canonical torch repr — the exec
+    # namespace includes torch whenever it is loaded
+    tname = type(x).__module__ + "." + type(x).__name__
+    if tname == "torch.dtype":
+        return repr(x)  # e.g. "torch.float32"
+    if tname == "torch.device":
+        return f'torch.device("{x}")'
+
     from enum import Enum
 
     if isinstance(x, Enum):

@@ -145,6 +145,10 @@ class TraceCtx:
 
         ctx: dict[str, Any] = {"dtypes": _dt, "devices": _dev, "thunder_tpu": _tt,
                                "DistParallelType": DistParallelType}
+        import sys as _sys
+
+        if "torch" in _sys.modules:  # printed torch.dtype constants resolve
+            ctx.setdefault("torch", _sys.modules["torch"])
         for bsym in self.bound_symbols:
             bsym.gather_ctx(ctx)
         ctx.update(self._python_ctx_extra)

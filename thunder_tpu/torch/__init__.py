@@ -757,10 +757,24 @@ def _unwrap_out_tree(out):
         try:
             out = {k: _unwrap_out_tree(v) for k, v in out.items()}
         except (AttributeError, TypeError):
-            # non-mapping containers (DynamicCache): unwrap attribute-wise
+            # non-mapping containers (DynamicCache): unwrap attribute-wise,
+            # keeping only jit-returnable state — metadata leaves
+            # (torch.device/dtype, layer objects) can be neither traced nor
+            # returned by the whole-program jit
+            def _jit_safe(v):
+                if isinstance(v, Proxy) or v is None \
+                        or isinstance(v, (Number, str, bool)):
+                    return True
+                if isinstance(v, (tuple, list)):
+                    return all(_jit_safe(i) for i in v)
+                if isinstance(v, dict):
+                    return all(_jit_safe(x) for x in v.values())
+                return False
+
             try:
-                out = {k: _unwrap_out_tree(v) for k, v in vars(out).items()
-                       if not k.startswith("_")}
+                unwrapped = {k: _unwrap_out_tree(v) for k, v in vars(out).items()
+                             if not k.startswith("_")}
+                out = {k: v for k, v in unwrapped.items() if _jit_safe(v)}
             except TypeError:
                 pass
     elif isinstance(out, (tuple, list)) and any(
