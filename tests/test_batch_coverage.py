@@ -147,3 +147,24 @@ def test_einsum_batching_rule_trace_level():
     np.testing.assert_allclose(got, want, rtol=1e-5)
     src = tt.last_traces(vf)[0].python()
     assert "einsum" in src and "vmap0" not in src
+
+
+def test_declined_rule_falls_to_per_op_not_whole_function(monkeypatch):
+    """A registered rule raising NoBatchRule (ellipsis einsum) must punt to
+    the PER-OP opaque fallback — neighbors keep their claims."""
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    from thunder_tpu.ops import nn as ops_nn
+
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 2, 4, 8, 16).astype(np.float32)
+    w = rng.randn(16, 16).astype(np.float32)
+
+    def f(q):
+        h = ops.einsum("...ij,jk->...ik", q, w)  # ellipsis: rule declines
+        return ops_nn.scaled_dot_product_attention(h, h, h, is_causal=True)
+
+    jf = tt.jit(lambda q: tt.vmap(f)(q), executors=["pallas", "xla"])
+    jf(q)
+    src = tt.last_execution_trace(jf).python()
+    assert "pallas_sdpa" in src or "sdpa_fwd" in src
+    assert "vmap" in tt.last_traces(jf)[0].python()

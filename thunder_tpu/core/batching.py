@@ -341,10 +341,16 @@ def replay_batched(bsyms, env: dict, B: int):
             continue
         rule = _batch_rules.get(sid)
         if rule is not None:
-            out, obdim = rule(bsym, vals, bdims, B)
-            bind(bsym.output, out, obdim)
-            continue
-        if bsym.subsymbols:
+            try:
+                out, obdim = rule(bsym, vals, bdims, B)
+            except NoBatchRule:
+                rule = None  # rule declined (e.g. ellipsis einsum, full-
+                # reduce argmax): fall through to the per-op opaque fallback
+                # below instead of demoting the WHOLE function
+            else:
+                bind(bsym.output, out, obdim)
+                continue
+        if rule is None and bsym.subsymbols:
             replay_batched(bsym.subsymbols, env, B)
             missing = [o for o in bsym.flat_proxy_outs() if Variable(o) not in env]
             check(not missing, lambda: f"batched replay of {bsym.sym.name} decomposition "

@@ -221,6 +221,14 @@ def examine_torch(fn, *args, claims: bool = False, **kwargs) -> dict:
         "unsupported": dict(unsupported),
         "coverage": (len(supported) / max(len(called), 1)),
     }
+    if claims and unsupported:
+        # the claims view requires a traceable model; make the gap explicit
+        # instead of silently omitting the keys
+        report["claims_by_executor"] = None
+        report["op_dtypes"] = None
+        report["claims_skipped_reason"] = (
+            f"{len(unsupported)} unsupported torch ops block tracing: "
+            f"{sorted(unsupported)[:5]}")
     if claims and not unsupported:
         import thunder_tpu as tt
         import thunder_tpu.torch as ttorch

@@ -758,23 +758,31 @@ def _unwrap_out_tree(out):
             out = {k: _unwrap_out_tree(v) for k, v in out.items()}
         except (AttributeError, TypeError):
             # non-mapping containers (DynamicCache): unwrap attribute-wise,
-            # keeping only jit-returnable state — metadata leaves
-            # (torch.device/dtype, layer objects) can be neither traced nor
-            # returned by the whole-program jit
-            def _jit_safe(v):
+            # PRUNING the leaves the whole-program jit cannot return
+            # (torch.device/dtype, layer objects) while keeping tensor state
+            # that shares a container with them
+            _DROP = object()
+
+            def _prune_unsafe(v):
                 if isinstance(v, Proxy) or v is None \
                         or isinstance(v, (Number, str, bool)):
-                    return True
+                    return v
                 if isinstance(v, (tuple, list)):
-                    return all(_jit_safe(i) for i in v)
+                    kept = [p for p in (_prune_unsafe(i) for i in v)
+                            if p is not _DROP]
+                    return type(v)(kept)
                 if isinstance(v, dict):
-                    return all(_jit_safe(x) for x in v.values())
-                return False
+                    return {k: p for k, p in ((k, _prune_unsafe(x))
+                                              for k, x in v.items())
+                            if p is not _DROP}
+                return _DROP
 
             try:
                 unwrapped = {k: _unwrap_out_tree(v) for k, v in vars(out).items()
                              if not k.startswith("_")}
-                out = {k: v for k, v in unwrapped.items() if _jit_safe(v)}
+                out = {k: p for k, p in ((k, _prune_unsafe(v))
+                                         for k, v in unwrapped.items())
+                       if p is not _DROP}
             except TypeError:
                 pass
     elif isinstance(out, (tuple, list)) and any(
