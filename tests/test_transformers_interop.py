@@ -261,3 +261,37 @@ def test_hf_family_forward_parity(family, make):
     g = got["last_hidden_state"] if isinstance(got, dict) else got.last_hidden_state
     np.testing.assert_allclose(np.asarray(g), want.last_hidden_state.numpy(),
                                atol=5e-6)
+
+
+def test_hf_mistral_trains_through_bridge():
+    """A GQA/sliding-window decoder LM (Mistral) trains through loss.backward()
+    + a stock torch optimizer, matching eager losses step for step."""
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.MistralConfig(
+        num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+        num_key_value_heads=1, intermediate_size=64, vocab_size=100,
+        attention_dropout=0.0)
+    torch.manual_seed(0)
+    model = transformers.MistralForCausalLM(cfg)
+    ref = transformers.MistralForCausalLM(cfg)
+    ref.load_state_dict({k: v.clone() for k, v in model.state_dict().items()})
+    model.train()
+    ref.train()
+
+    jm = tt.jit(model)
+    opt = torch.optim.SGD(model.parameters(), lr=1e-2)
+    opt_ref = torch.optim.SGD(ref.parameters(), lr=1e-2)
+    ids = torch.randint(0, 100, (2, 12))
+    for _ in range(3):
+        opt.zero_grad()
+        out = jm(input_ids=ids, labels=ids, use_cache=False)
+        loss = out["loss"] if isinstance(out, dict) else out.loss
+        loss.backward()
+        opt.step()
+
+        opt_ref.zero_grad()
+        rloss = ref(input_ids=ids, labels=ids, use_cache=False).loss
+        rloss.backward()
+        opt_ref.step()
+        assert float(loss) == pytest.approx(float(rloss), abs=2e-4)
