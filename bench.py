@@ -84,6 +84,11 @@ def main():
     model_loss = (functools.partial(base_loss, remat=True) if use_remat
                   else base_loss)
 
+    if use_fp8 and use_remat:
+        sys.exit("BENCH_FP8 + BENCH_REMAT: fp8 delayed scaling does not "
+                 "compose with checkpoint recompute yet (each replayed "
+                 "linear would need its original slot's scales); run the "
+                 "depth mode in bf16 or fp8 without remat")
     if use_fp8:
         from thunder_tpu import fp8
 

@@ -1207,3 +1207,37 @@ set_error_inputs("searchsorted", lambda rng, _prev=next(
     ErrorSample((_sorted_t(rng, 8), _t(rng, 5)), RuntimeError,
                 "opposites", {"right": True, "side": "left"}),
 ])
+
+# round-3 breadth: error inputs for the high-traffic composites
+set_error_inputs("linear", lambda rng: [
+    ErrorSample((_t(rng, 2, 4), _t(rng, 5, 3)), RuntimeError,
+                "contract dim mismatch"),
+])
+set_error_inputs("take", lambda rng: [
+    ErrorSample((_t(rng, 4, 4), _i32(rng, 3, hi=3), 5), IndexError,
+                "out of range"),
+])
+set_error_inputs("expand", lambda rng: [
+    ErrorSample((_t(rng, 2, 4), (3, 5)), RuntimeError, "incompatible"),
+])
+set_error_inputs("transpose", lambda rng: [
+    ErrorSample((_t(rng, 2, 4), (0, 2)), IndexError, "out of range"),
+])
+set_error_inputs("clamp", lambda rng: [
+    ErrorSample((_t(rng, 4),), RuntimeError, "at least one of min or max"),
+])
+set_error_inputs("cross_entropy", lambda rng: [
+    ErrorSample((_t(rng, 2, 3, 4), _i32(rng, 2, 3, hi=3)), RuntimeError,
+                "target shape"),
+])
+set_error_inputs("one_hot", lambda rng: [
+    ErrorSample((_i32(rng, 3, hi=3), -2), RuntimeError, "must be positive"),
+])
+set_error_inputs("embedding", lambda rng: [
+    ErrorSample((_i32(rng, 2, hi=3), _t(rng, 5)), RuntimeError,
+                "must be .num_embeddings, dim."),
+])
+set_error_inputs("stack", lambda rng: [
+    ErrorSample((_t(rng, 2, 3), _t(rng, 2, 4)), RuntimeError,
+                "shape mismatch"),
+])

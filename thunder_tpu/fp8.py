@@ -135,7 +135,11 @@ class autocast:
         if self.state is not None:
             check(slot < self.state["x_hist"].shape[0],
                   lambda: f"fp8 state has {self.state['x_hist'].shape[0]} slots but "
-                          f"the program contains more linears; re-run init_state/count_linears")
+                          f"the program contains more linears; re-run "
+                          f"init_state/count_linears. (Known cause: "
+                          f"tt.checkpoint/remat regions — the backward's "
+                          f"RECOMPUTED linears allocate fresh slots; fp8 "
+                          f"delayed scaling does not compose with remat yet)")
             sx = _scale_from_hist(self.state["x_hist"][slot], E4M3_MAX, self.margin)
             sw = _scale_from_hist(self.state["w_hist"][slot], E4M3_MAX, self.margin)
         else:

@@ -438,6 +438,8 @@ def where(pred, a, b):
 
 @opsymbol
 def clamp(a, min=None, max=None):
+    check(min is not None or max is not None,
+          "clamp: at least one of min or max must be given")
     out = a
     if min is not None:
         out = maximum(out, min)

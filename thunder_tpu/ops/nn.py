@@ -21,12 +21,17 @@ from thunder_tpu.ops import opsymbol
 
 @opsymbol(id="nn.embedding")
 def embedding(ids, weight, padding_idx=None):
+    check(weight.ndim == 2, lambda: (
+        f"embedding: weight must be (num_embeddings, dim), got "
+        f"{weight.ndim}-D {tuple(weight.shape)}"))
     out = prims.take(weight, ids, 0)
     return out
 
 
 @opsymbol(id="nn.one_hot")
 def one_hot(ids, num_classes: int):
+    check(int(num_classes) > 0,
+          lambda: f"one_hot: num_classes must be positive, got {num_classes}")
     classes = prims.iota(num_classes, dtype=dtypes.int32, device=ids.device)
     classes = ops.expand_to(classes, ids.shape + (num_classes,))
     expanded = ops.expand_to(ops.unsqueeze(ids, -1), ids.shape + (num_classes,))
@@ -90,6 +95,11 @@ def cross_entropy(logits, target, weight=None, ignore_index: int = -100,
     """logits: (N, C) or (N, C, ...) float; target: (N, ...) int class ids."""
     check(weight is None, "cross_entropy: class weights not yet supported")
     C = logits.shape[1] if logits.ndim > 1 else logits.shape[0]
+    expect = (logits.shape[0],) + tuple(logits.shape[2:]) if logits.ndim > 1 else ()
+    check(tuple(target.shape) == expect, lambda: (
+        f"cross_entropy: target shape {tuple(target.shape)} does not match "
+        f"logits {tuple(logits.shape)} — expected {expect} "
+        f"(N, d1, ...; the class dim C={C} is dim 1 of logits)"))
     if logits.ndim > 2:
         # (N, C, d1..) -> (N*d1.., C)
         perm = (0,) + tuple(range(2, logits.ndim)) + (1,)
