@@ -115,3 +115,33 @@ def test_fp8_training_converges():
         loss, w, os_, st = js(w, os_, st, x, y)
         losses.append(float(np.asarray(loss)))
     assert losses[-1] < 0.5 * losses[0]
+
+
+def test_tied_weight_shares_slot():
+    """Weight-keyed slots: the same weight proxy used at two call sites (tied
+    lm_head/embedding style) shares one delayed-scaling slot — and replays of
+    a recorded trace that reuse the same proxies stay slot-stable."""
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu import fp8, ops
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    w = rng.randn(16, 16).astype(np.float32)
+
+    def loss(w_):
+        h = ops.tanh(ops.linear(x, w_))
+        return ops.sum(ops.linear(h, w_), None)  # same weight, second site
+
+    n = fp8.count_linears(loss, w)
+    assert n == 1  # tied: one slot, not two
+    fstate = fp8.init_state(n_slots=n)
+
+    def step(w_, fstate):
+        with fp8.autocast(fstate) as ctx:
+            l, g = tt.value_and_grad(loss)(w_)
+        return l, g, ctx.updated_state()
+
+    l, g, fs = tt.jit(step)(w, fstate)
+    assert np.isfinite(float(np.asarray(l)))

@@ -40,7 +40,7 @@ from typing import Any
 
 from thunder_tpu.core import dtypes
 from thunder_tpu.core.baseutils import check
-from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.proxies import TensorProxy, Variable
 
 E4M3_MAX = 448.0
 E5M2_MAX = 57344.0
@@ -72,7 +72,8 @@ def count_linears(fn, *args, **kwargs) -> int:
             self.count = 0
 
         def linear(self, a, w, bias):
-            self.count += 1
+            self._slot_for(w)
+            self.count = self._slot
             from thunder_tpu import ops
 
             out = ops.prims.dot_general(a, w, contract_dims=((a.ndim - 1,), (1,)))
@@ -99,6 +100,22 @@ class autocast:
         self.min_dim_multiple = min_dim_multiple
         self._slot = 0
         self._amaxes: dict[int, tuple] = {}  # slot -> (amax_x, amax_w); last write wins
+        self._slot_by_weight: dict = {}
+
+    def _slot_for(self, w) -> int:
+        """Slot keyed by the WEIGHT proxy's identity, not a bare counter:
+        replays (eval_trace of a checkpoint composite, VJP recompute) re-run
+        ops.linear's meta with the SAME weight proxy and must land on the
+        same slot — the recompute then uses identical delayed scales, which
+        is exactly the semantics remat requires. (Tied weights used at two
+        call sites share a slot/history; acceptable for the same tensor.)"""
+        v = Variable(w)
+        s = self._slot_by_weight.get(v)
+        if s is None:
+            s = self._slot
+            self._slot += 1
+            self._slot_by_weight[v] = s
+        return s
 
     def _record(self, slot: int, amax_x, amax_w) -> None:
         """Called from the ``nn.fp8_linear`` meta on every (re)trace, so the
@@ -110,6 +127,7 @@ class autocast:
     def __enter__(self):
         self._slot = 0
         self._amaxes = {}
+        self._slot_by_weight = {}
         _fp8_stack.append(self)
         return self
 
@@ -130,8 +148,7 @@ class autocast:
     def linear(self, a, w, bias):
         from thunder_tpu.ops import nn
 
-        slot = self._slot
-        self._slot += 1
+        slot = self._slot_for(w)
         if self.state is not None:
             check(slot < self.state["x_hist"].shape[0],
                   lambda: f"fp8 state has {self.state['x_hist'].shape[0]} slots but "
