@@ -508,3 +508,15 @@ def test_examine_torch_claims_breakdown():
     total = sum(sum(c.values()) for c in rep["claims_by_executor"].values())
     assert total > 0
     assert any(sigs for sigs in rep["op_dtypes"].values())
+
+
+def test_xla_memory_and_cost():
+    from thunder_tpu import ops
+    from thunder_tpu.examine import xla_cost, xla_memory
+
+    jf = tt.jit(lambda a, b: ops.matmul(a, b))
+    jf(np.ones((64, 64), np.float32), np.ones((64, 64), np.float32))
+    m = xla_memory(jf)
+    assert m["argument_size_in_bytes"] >= 2 * 64 * 64 * 4
+    c = xla_cost(jf)
+    assert c.get("flops", 0) >= 2 * 64 ** 3 * 0.9  # XLA counts FMA as 2
