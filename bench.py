@@ -40,6 +40,16 @@ import time
 
 def main():
     import jax
+
+    if "--smoke" in sys.argv:
+        # verify-skill hook: tiny config on whatever backend is available,
+        # proving the bench path end-to-end without a real TPU or long run
+        os.environ.setdefault("BENCH_LAYERS", "1")
+        os.environ.setdefault("BENCH_BATCH", "2")
+        os.environ.setdefault("BENCH_SEQ", "128")
+        os.environ.setdefault("BENCH_STEPS", "2")
+        if jax.default_backend() != "tpu":
+            jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
