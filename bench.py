@@ -43,12 +43,17 @@ def main():
 
     if "--smoke" in sys.argv:
         # verify-skill hook: tiny config on whatever backend is available,
-        # proving the bench path end-to-end without a real TPU or long run
+        # proving the bench path end-to-end without a real TPU or long run.
+        # Decide the platform WITHOUT initializing a backend
+        # (jax.default_backend() would finalize selection first)
         os.environ.setdefault("BENCH_LAYERS", "1")
         os.environ.setdefault("BENCH_BATCH", "2")
         os.environ.setdefault("BENCH_SEQ", "128")
         os.environ.setdefault("BENCH_STEPS", "2")
-        if jax.default_backend() != "tpu":
+        # force CPU unless explicitly on a real local TPU: smoke's job is a
+        # fast end-to-end path check, and tunneled chips (axon) turn a tiny
+        # 2-step run into seconds of RTT
+        if "tpu" not in os.environ.get("JAX_PLATFORMS", ""):
             jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np

@@ -113,7 +113,7 @@ class CompileStats:
 class CacheEntry:
     __slots__ = ("computation_fn", "run_fn", "tensor_indices", "uses_rng", "traces",
                  "prologue_trace", "prologue_fn", "out_spec", "arg_of_flat",
-                 "input_avals", "jit_obj", "is_sharded")
+                 "input_avals", "jit_obj", "is_sharded", "_examine_compiled")
 
     def __init__(self, computation_fn, tensor_indices, uses_rng, traces, prologue_trace,
                  prologue_fn, out_spec):

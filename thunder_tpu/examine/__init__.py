@@ -251,18 +251,32 @@ def examine_torch(fn, *args, claims: bool = False, **kwargs) -> dict:
     return report
 
 
-def xla_memory(jfn) -> dict:
-    """XLA's own memory accounting for the most recent compiled entry
-    (argument/output/temp/generated-code bytes) — the ground truth behind
-    ``estimate_memory``'s trace-level approximation. Used throughout round 3
-    to verify remat actually changes liveness; now a first-class tool."""
+def _compiled_entry(jfn):
+    """The XLA-compiled executable of the most recent entry, memoized on the
+    entry — a full model compile is seconds-to-minutes, so xla_memory +
+    xla_cost must share one."""
     import thunder_tpu as tt
 
     entry = tt.compile_stats(jfn).last_entry
     if entry is None or entry.jit_obj is None or entry.input_avals is None:
         raise RuntimeError("no whole-program-jitted entry to analyze "
                            "(compile first; device-sync ops disable the outer jit)")
-    ma = entry.jit_obj.lower(*entry.input_avals).compile().memory_analysis()
+    compiled = getattr(entry, "_examine_compiled", None)
+    if compiled is None:
+        compiled = entry.jit_obj.lower(*entry.input_avals).compile()
+        try:
+            entry._examine_compiled = compiled
+        except AttributeError:  # __slots__: cache beside the stats instead
+            pass
+    return compiled
+
+
+def xla_memory(jfn) -> dict:
+    """XLA's own memory accounting for the most recent compiled entry
+    (argument/output/temp/generated-code bytes) — the ground truth behind
+    ``estimate_memory``'s trace-level approximation. Used throughout round 3
+    to verify remat actually changes liveness; now a first-class tool."""
+    ma = _compiled_entry(jfn).memory_analysis()
     out = {}
     for k in ("argument_size_in_bytes", "output_size_in_bytes",
               "temp_size_in_bytes", "generated_code_size_in_bytes",
@@ -276,12 +290,7 @@ def xla_memory(jfn) -> dict:
 def xla_cost(jfn) -> dict:
     """XLA's cost analysis (flops, bytes accessed) for the most recent
     compiled entry — the denominator source for MFU accounting."""
-    import thunder_tpu as tt
-
-    entry = tt.compile_stats(jfn).last_entry
-    if entry is None or entry.jit_obj is None or entry.input_avals is None:
-        raise RuntimeError("no whole-program-jitted entry to analyze")
-    ca = entry.jit_obj.lower(*entry.input_avals).compile().cost_analysis()
+    ca = _compiled_entry(jfn).cost_analysis()
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}
     return {k: float(v) for k, v in dict(ca).items()

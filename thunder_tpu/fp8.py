@@ -69,11 +69,9 @@ def count_linears(fn, *args, **kwargs) -> int:
     class _Counter(autocast):
         def __init__(self):
             super().__init__(None)
-            self.count = 0
 
         def linear(self, a, w, bias):
             self._slot_for(w)
-            self.count = self._slot
             from thunder_tpu import ops
 
             out = ops.prims.dot_general(a, w, contract_dims=((a.ndim - 1,), (1,)))
@@ -85,7 +83,7 @@ def count_linears(fn, *args, **kwargs) -> int:
         tt.jit(fn, cache="no caching")(*args, **kwargs)
     finally:
         _fp8_stack.pop()
-    return ctr.count
+    return ctr._slot
 
 
 class autocast:
@@ -104,11 +102,12 @@ class autocast:
 
     def _slot_for(self, w) -> int:
         """Slot keyed by the WEIGHT proxy's identity, not a bare counter:
-        replays (eval_trace of a checkpoint composite, VJP recompute) re-run
-        ops.linear's meta with the SAME weight proxy and must land on the
-        same slot — the recompute then uses identical delayed scales, which
-        is exactly the semantics remat requires. (Tied weights used at two
-        call sites share a slot/history; acceptable for the same tensor.)"""
+        replays that reuse the same proxies (eval_trace of a composite,
+        tied lm_head/embedding call sites) land on the same slot. NOTE:
+        the grad transform's checkpoint recompute substitutes FRESH weight
+        proxies, so fp8 x remat still allocates new slots and remains
+        gated (see the slot check below) — this keying is necessary for
+        that composition but not yet sufficient."""
         v = Variable(w)
         s = self._slot_by_weight.get(v)
         if s is None:
@@ -118,10 +117,23 @@ class autocast:
         return s
 
     def _record(self, slot: int, amax_x, amax_w) -> None:
-        """Called from the ``nn.fp8_linear`` meta on every (re)trace, so the
-        recorded amax proxies are always the live ones (autograd replay /
-        checkpoint recompute re-emit the composite with fresh proxies)."""
-        self._amaxes[slot] = (amax_x, amax_w)
+        """Called from the ``nn.fp8_linear`` meta on every (re)trace.
+
+        Within ONE live trace, multiple call sites sharing a slot (tied
+        weights) max-combine their amaxes so the shared history covers
+        both sites' activations; across trace passes (replays re-emit with
+        fresh proxies) the newest — live — proxies win, since combining
+        with a stale pass's proxies would reference dead variables."""
+        from thunder_tpu.core.trace import get_tracectx
+
+        tid = id(get_tracectx())
+        prev = self._amaxes.get(slot)
+        if prev is not None and prev[0] == tid:
+            from thunder_tpu import ops
+
+            amax_x = ops.maximum(prev[1], amax_x)
+            amax_w = ops.maximum(prev[2], amax_w)
+        self._amaxes[slot] = (tid, amax_x, amax_w)
 
     # -- context -----------------------------------------------------------
     def __enter__(self):
@@ -180,7 +192,7 @@ class autocast:
             xh = self.state["x_hist"][i]
             wh = self.state["w_hist"][i]
             if i in amap:
-                ax, aw = amap[i]
+                _tid, ax, aw = amap[i]
                 xh = ops.cat([ops.reshape(ax, (1,)), xh[:-1]], 0)
                 wh = ops.cat([ops.reshape(aw, (1,)), wh[:-1]], 0)
             x_rows.append(xh)
