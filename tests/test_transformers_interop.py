@@ -295,3 +295,25 @@ def test_hf_mistral_trains_through_bridge():
         rloss.backward()
         opt_ref.step()
         assert float(loss) == pytest.approx(float(rloss), abs=2e-4)
+
+
+def test_hf_whisper_encoder_parity():
+    """Audio family: Whisper's conv1d patch stem + encoder stack."""
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.WhisperConfig(
+        encoder_layers=1, decoder_layers=1, d_model=32,
+        encoder_attention_heads=2, decoder_attention_heads=2,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, vocab_size=100,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        decoder_start_token_id=1, max_source_positions=150, num_mel_bins=8)
+    torch.manual_seed(0)
+    m = transformers.WhisperModel(cfg).encoder
+    m.eval()
+    feats = torch.randn(1, 8, 300)
+    with torch.no_grad():
+        want = m(feats).last_hidden_state
+        jm = tt.jit(m)
+        got = jm(feats)
+    g = got["last_hidden_state"] if isinstance(got, dict) else got.last_hidden_state
+    np.testing.assert_allclose(np.asarray(g), want.numpy(), atol=5e-6)
