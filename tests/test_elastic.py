@@ -125,3 +125,24 @@ def test_heartbeat_and_stall_detection(tmp_path):
     with open(hb.path, "w") as f:
         json.dump(d, f)
     assert check_stalled(hb.path, timeout_s=60)
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """Async saves overlap the filesystem write with training; restore (or
+    wait_for_checkpoints) joins the in-flight save."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.checkpoint import (load_checkpoint, save_checkpoint,
+                                        wait_for_checkpoints)
+
+    state = {"w": jnp.arange(100, dtype=jnp.float32), "step": jnp.int32(3)}
+    p = str(tmp_path / "ck")
+    save_checkpoint(p, state, asynchronous=True)
+    wait_for_checkpoints()
+    back = load_checkpoint(p, template=state)
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(state["w"]))
+
+    p2 = str(tmp_path / "ck2")
+    save_checkpoint(p2, state, asynchronous=True)
+    back2 = load_checkpoint(p2, template=state)  # implicit join
+    assert int(back2["step"]) == 3

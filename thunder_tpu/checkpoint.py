@@ -29,11 +29,26 @@ def _orbax():
         return None
 
 
-def save_checkpoint(path: str, state: Any) -> None:
-    """Save a pytree of arrays (params / optimizer state / step counters)."""
+_async_ckptr = None
+
+
+def save_checkpoint(path: str, state: Any, *, asynchronous: bool = False) -> None:
+    """Save a pytree of arrays (params / optimizer state / step counters).
+
+    ``asynchronous=True``: orbax AsyncCheckpointer — the device→host copy
+    happens now, the filesystem write in a background thread, so training
+    continues while the checkpoint lands (call :func:`wait_for_checkpoints`
+    before exiting, or the next save/restore joins automatically)."""
+    global _async_ckptr
+
     ocp = _orbax()
     path = os.path.abspath(path)
     if ocp is not None:
+        if asynchronous:
+            if _async_ckptr is None:
+                _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+            _async_ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
+            return
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(path, state, force=True)
         ckptr.wait_until_finished()
@@ -47,10 +62,17 @@ def save_checkpoint(path: str, state: Any) -> None:
         pickle.dump(treedef, f)
 
 
+def wait_for_checkpoints() -> None:
+    """Block until every asynchronous save has committed to disk."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
 def load_checkpoint(path: str, template: Any | None = None) -> Any:
     """Load a checkpoint. ``template`` (a pytree of arrays or ShapeDtypeStructs,
     possibly sharded) restores with matching shardings — pass the current
     (possibly freshly-sharded) state to reshard onto a new mesh."""
+    wait_for_checkpoints()  # join any in-flight async save of this path
     ocp = _orbax()
     path = os.path.abspath(path)
     if ocp is not None and not os.path.exists(os.path.join(path, "treedef.pkl")):
