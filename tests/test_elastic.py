@@ -146,3 +146,22 @@ def test_async_checkpoint_roundtrip(tmp_path):
     save_checkpoint(p2, state, asynchronous=True)
     back2 = load_checkpoint(p2, template=state)  # implicit join
     assert int(back2["step"]) == 3
+
+
+def test_async_checkpoint_manager_pipeline(tmp_path):
+    """Async CheckpointManager: LATEST always names a COMMITTED checkpoint
+    (depth-1 pipeline), and finalize commits the tail save."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.elastic import CheckpointManager
+
+    ck = CheckpointManager(str(tmp_path), keep=2, asynchronous=True)
+    for step in (2, 4, 6):
+        ck.save(step, {"w": jnp.full((8,), float(step))})
+    # last save may still be in flight; LATEST must name a committed one
+    assert ck.latest_step() in (2, 4)
+    ck.finalize()
+    assert ck.latest_step() == 6
+    step, state = ck.restore_latest(template={"w": jnp.zeros((8,))})
+    assert step == 6
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full((8,), 6.0))

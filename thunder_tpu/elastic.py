@@ -34,25 +34,55 @@ from thunder_tpu.checkpoint import load_checkpoint, save_checkpoint
 
 class CheckpointManager:
     """Rotating step checkpoints under ``root/step_N`` with a ``LATEST``
-    pointer written only after a successful save (atomic rename)."""
+    pointer written only after a successful save (atomic rename).
 
-    def __init__(self, root: str, keep: int = 3):
+    ``asynchronous=True``: saves overlap training with a depth-1 pipeline —
+    requesting save N first JOINS save N-1 and flips LATEST to it, then
+    kicks off N in the background. LATEST therefore always names a
+    fully-committed checkpoint; call :meth:`finalize` (ElasticTrainer does)
+    before exiting so the last save commits too."""
+
+    def __init__(self, root: str, keep: int = 3, asynchronous: bool = False):
         self.root = os.path.abspath(root)
         self.keep = keep
+        self.asynchronous = asynchronous
+        self._pending: int | None = None
         os.makedirs(self.root, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.root, f"step_{step}")
 
-    def save(self, step: int, state: Any) -> None:
-        d = self._step_dir(step)
-        if os.path.exists(d):
-            shutil.rmtree(d)
-        save_checkpoint(d, state)
+    def _write_latest(self, step: int) -> None:
         tmp = os.path.join(self.root, ".LATEST.tmp")
         with open(tmp, "w") as f:
             json.dump({"step": step, "time": time.time()}, f)
         os.replace(tmp, os.path.join(self.root, "LATEST"))
+
+    def _commit_pending(self) -> None:
+        if self._pending is None:
+            return
+        from thunder_tpu.checkpoint import wait_for_checkpoints
+
+        wait_for_checkpoints()
+        self._write_latest(self._pending)
+        self._pending = None
+        self._gc()
+
+    def finalize(self) -> None:
+        """Join and commit any in-flight asynchronous save."""
+        self._commit_pending()
+
+    def save(self, step: int, state: Any) -> None:
+        d = self._step_dir(step)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        if self.asynchronous:
+            self._commit_pending()
+            save_checkpoint(d, state, asynchronous=True)
+            self._pending = step
+            return
+        save_checkpoint(d, state)
+        self._write_latest(step)
         self._gc()
 
     def latest_step(self) -> int | None:
@@ -63,6 +93,7 @@ class CheckpointManager:
             return int(json.load(f)["step"])
 
     def restore_latest(self, template: Any | None = None) -> tuple[int, Any] | None:
+        self._commit_pending()
         step = self.latest_step()
         if step is None:
             return None
@@ -158,6 +189,8 @@ class ElasticTrainer:
                     self.heartbeat.beat(step)
                 if step % self.save_every == 0 or step == n_steps:
                     self.ckpt.save(step, state)
+                if step == n_steps and hasattr(self.ckpt, "finalize"):
+                    self.ckpt.finalize()
             except self.RETRYABLE as e:
                 self.restarts += 1
                 self.on_event("failure", {"step": step, "error": repr(e),
