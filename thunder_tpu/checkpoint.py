@@ -49,6 +49,7 @@ def save_checkpoint(path: str, state: Any, *, asynchronous: bool = False) -> Non
                 _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
             _async_ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
             return
+        wait_for_checkpoints()  # a sync save must not race an async writer
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(path, state, force=True)
         ckptr.wait_until_finished()

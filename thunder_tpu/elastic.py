@@ -29,7 +29,8 @@ import shutil
 import time
 from typing import Any, Callable
 
-from thunder_tpu.checkpoint import load_checkpoint, save_checkpoint
+from thunder_tpu.checkpoint import (load_checkpoint, save_checkpoint,
+                                    wait_for_checkpoints)
 
 
 class CheckpointManager:
@@ -61,8 +62,6 @@ class CheckpointManager:
     def _commit_pending(self) -> None:
         if self._pending is None:
             return
-        from thunder_tpu.checkpoint import wait_for_checkpoints
-
         wait_for_checkpoints()
         self._write_latest(self._pending)
         self._pending = None
@@ -74,13 +73,17 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any) -> None:
         d = self._step_dir(step)
-        if os.path.exists(d):
-            shutil.rmtree(d)
         if self.asynchronous:
+            # join the in-flight save BEFORE any delete: re-saving the
+            # pending step must not rmtree a directory being written
             self._commit_pending()
+            if os.path.exists(d):
+                shutil.rmtree(d)
             save_checkpoint(d, state, asynchronous=True)
             self._pending = step
             return
+        if os.path.exists(d):
+            shutil.rmtree(d)
         save_checkpoint(d, state)
         self._write_latest(step)
         self._gc()
