@@ -145,3 +145,55 @@ def test_tied_weight_shares_slot():
 
     l, g, fs = tt.jit(step)(w, fstate)
     assert np.isfinite(float(np.asarray(l)))
+
+
+def test_fp8_composes_with_checkpoint():
+    """fp8 delayed scaling x tt.checkpoint (the round-3 gate, now removed):
+    the backward's RECOMPUTED linears must resolve to the forward's
+    weight-keyed slots via substitution propagation — not allocate fresh
+    slots — so a state sized by count_linears fits, grads match the
+    un-checkpointed fp8 program exactly, and the amax-history update is
+    identical. Reference analog: TE fp8 under torch.utils.checkpoint
+    (``thunder/executors/transformer_engineex.py:181,585``)."""
+    rng = np.random.RandomState(7)
+    D = 16
+    params = [(rng.randn(D, D).astype(np.float32) * 0.3,
+               rng.randn(D, D).astype(np.float32) * 0.3) for _ in range(2)]
+    x = rng.randn(4, D).astype(np.float32)
+
+    def block(h, w1, w2):
+        return ops.linear(ops.relu(ops.linear(h, w1)), w2)
+
+    def loss_ckpt(p):
+        h = x
+        for (w1, w2) in p:
+            h = tt.checkpoint(block)(h, w1, w2)
+        return ops.sum(h * h)
+
+    def loss_plain(p):
+        h = x
+        for (w1, w2) in p:
+            h = block(h, w1, w2)
+        return ops.sum(h * h)
+
+    # slot count is the LOGICAL linear count — recompute doesn't inflate it
+    n = fp8.count_linears(loss_ckpt, params)
+    assert n == 4
+
+    def step(loss_fn):
+        def _step(p, st):
+            with fp8.autocast(st) as ctx:
+                l, g = tt.value_and_grad(loss_fn)(p)
+            return l, g, ctx.updated_state()
+        return _step
+
+    st0 = fp8.init_state(n_slots=n)
+    l_c, g_c, st_c = tt.jit(step(loss_ckpt))(params, st0)
+    l_p, g_p, st_p = tt.jit(step(loss_plain))(params, st0)
+
+    assert np.allclose(float(np.asarray(l_c)), float(np.asarray(l_p)), rtol=1e-6)
+    for gc, gp in zip(np.asarray(g_c, dtype=object).ravel(), np.asarray(g_p, dtype=object).ravel()):
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gp), rtol=1e-5, atol=1e-6)
+    # the delayed-scaling state update is the same program either way
+    np.testing.assert_allclose(np.asarray(st_c["x_hist"]), np.asarray(st_p["x_hist"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(st_c["w_hist"]), np.asarray(st_p["w_hist"]), rtol=1e-6)

@@ -36,6 +36,12 @@ from thunder_tpu.core.transforms import (
     jvp_call,
     vmap_call,
 )
+# load the checkpoint-IO SUBMODULE first: the import system sets the package's
+# ``checkpoint`` attribute to the module exactly once (at first load), so
+# importing it eagerly here — before the function binding below — means a later
+# ``from thunder_tpu.checkpoint import save_checkpoint`` elsewhere can never
+# shadow ``tt.checkpoint`` (the activation-checkpoint function) back to a module
+import thunder_tpu.checkpoint as checkpoint_io  # noqa: F401
 from thunder_tpu.core.rematerialization import (
     checkpoint,
     rematerialize_forward_and_backward,
@@ -177,6 +183,13 @@ class ThunderTPUFunction:
         self.fn_name = fn_name or getattr(fn, "__name__", "fn")
         self._cache: dict = {}
         self._stats = CompileStats()
+        # Frontends may stash call-varying specialization context here (the
+        # torch dialect's input-alias pattern: which args share a storage —
+        # reference guards aliases via the prologue, thunder/__init__.py:
+        # 357-375). It joins the cache key, so a call with aliased views
+        # never hits an entry compiled for distinct tensors (and vice versa:
+        # distinct tensors never re-trace an aliased specialization).
+        self._extra_cache_key = None
         self.compile_options = dict(compile_options)
         self._compile_ctx = None  # last CompileContext (option usage report)
         self.__name__ = f"thunder_tpu.jit({self.fn_name})"
@@ -267,7 +280,8 @@ class ThunderTPUFunction:
         if self.seq_buckets is not None:
             args, kwargs = self._pad_to_bucket(args, kwargs)
         flat, treedef = tree_flatten((args, kwargs))
-        key = (treedef, tuple(self._leaf_cache_key(l) for l in flat)) \
+        key = (treedef, self._extra_cache_key,
+               tuple(self._leaf_cache_key(l) for l in flat)) \
             if self.cache_option != "no caching" else None
         entry = self._cache.get(key) if key is not None else None
         if entry is None:

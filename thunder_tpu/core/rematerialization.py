@@ -349,15 +349,19 @@ def checkpoint(fn):
                              if isinstance(x, TensorProxy)]
                 tensor_slots = [i for i, leaf in enumerate(rargs)
                                 if isinstance(leaf, TensorProxy)]
+                from thunder_tpu.core.transforms import notify_substitution
+
                 pinned_args = list(rargs)
                 if tensor_slots and g_tensors:
                     pinned = prims.opt_barrier(
                         *[rargs[i] for i in tensor_slots], *g_tensors)
                     for slot, i in enumerate(tensor_slots):
                         pinned_args[i] = pinned[slot]
+                        notify_substitution(rargs[i], pinned[slot])
                 env: dict = {}
                 for p, leaf in zip(inner_inputs, pinned_args):
                     env[Variable(p)] = leaf
+                    notify_substitution(p, leaf)
                 records = augmented_forward(inner.bound_symbols, env)
                 re_out = _env_map(env, inner.output)
                 out_flat = [o for o in tree_flatten(re_out)[0]

@@ -40,6 +40,22 @@ from thunder_tpu.core.utils import free_vars
 # trace evaluation (replay)
 # ---------------------------------------------------------------------------
 
+# Substitution listeners: trace-time contexts that key state off proxy
+# IDENTITY (e.g. fp8 delayed-scaling slots keyed by the weight proxy) register
+# a callback here; every replay engine that renames proxies (eval_trace
+# composite emission, sub-trace input mirroring, value_and_grad env binding,
+# checkpoint recompute pinning) reports orig -> replacement pairs so such
+# state follows the logical value across passes instead of multiplying.
+_subst_listeners: list = []
+
+
+def notify_substitution(orig, new) -> None:
+    if not _subst_listeners or orig is new:
+        return
+    for cb in _subst_listeners:
+        cb(orig, new)
+
+
 def _env_map(env: dict, x):
     if isinstance(x, Proxy):
         v = Variable(x)
@@ -68,6 +84,7 @@ def eval_trace(trc: TraceCtx, *args):
     check(len(args) == len(trc.args), lambda: f"eval_trace: expected {len(trc.args)} args, got {len(args)}")
     for p, a in zip(trc.args, args):
         env[Variable(p)] = a
+        notify_substitution(p, a)
     result = None
     for bsym in trc.bound_symbols:
         if bsym.sym.id is PrimIDs.PYTHON_RETURN:
@@ -259,6 +276,7 @@ def _trace_subfn(fn, args, kwargs) -> tuple[TraceCtx, list, Any]:
                     if hasattr(leaf, attr):
                         setattr(p, attr, getattr(leaf, attr))
                 proxies.append(p)
+                notify_substitution(leaf, p)
                 # distributed param sync INSIDE the grad scope: FSDP params are
                 # all-gathered here and their VJP reduce-scatters the grads
                 # (reference: synchronize in fwd, prims.py:376-419)
@@ -358,6 +376,7 @@ def inline_value_and_grad(fn, argnums=0, has_aux: bool = False):
         for leaf in flat_actual:
             if isinstance(leaf, Proxy):
                 env[Variable(inner_inputs[j])] = leaf
+                notify_substitution(inner_inputs[j], leaf)
                 j += 1
         check(j == len(inner_inputs), "inline_value_and_grad: argument flattening mismatch")
         records = augmented_forward(inner.bound_symbols, env)
@@ -1062,6 +1081,7 @@ def jvp_call(fn, primals: tuple, tangents: tuple):
     for p, t in zip(flat_p, flat_t):
         if isinstance(p, Proxy):
             env[Variable(inner_inputs[j])] = p
+            notify_substitution(inner_inputs[j], p)
             if t is not None:
                 # key tangents by the OUTER (mapped) proxies — replayed bsym
                 # args are env-mapped before tangent lookup

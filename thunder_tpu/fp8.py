@@ -78,11 +78,9 @@ def count_linears(fn, *args, **kwargs) -> int:
             return out if bias is None else ops.add(out, bias)
 
     ctr = _Counter()
-    _fp8_stack.append(ctr)
-    try:
+    with ctr:  # context entry registers the substitution listener too,
+        # so checkpoint/remat replays don't inflate the count
         tt.jit(fn, cache="no caching")(*args, **kwargs)
-    finally:
-        _fp8_stack.pop()
     return ctr._slot
 
 
@@ -102,12 +100,14 @@ class autocast:
 
     def _slot_for(self, w) -> int:
         """Slot keyed by the WEIGHT proxy's identity, not a bare counter:
-        replays that reuse the same proxies (eval_trace of a composite,
-        tied lm_head/embedding call sites) land on the same slot. NOTE:
-        the grad transform's checkpoint recompute substitutes FRESH weight
-        proxies, so fp8 x remat still allocates new slots and remains
-        gated (see the slot check below) — this keying is necessary for
-        that composition but not yet sufficient."""
+        replays that reuse the same proxies (tied lm_head/embedding call
+        sites) land on the same slot, and replays that RENAME proxies
+        (eval_trace composite emission, value_and_grad's sub-trace, the
+        checkpoint recompute's pinned inputs) land on the same slot via the
+        substitution-listener propagation registered in ``__enter__`` —
+        this is what lets fp8 delayed scaling compose with tt.checkpoint:
+        the backward's recomputed linears resolve to the forward's
+        weight-keyed slots instead of allocating fresh ones."""
         v = Variable(w)
         s = self._slot_by_weight.get(v)
         if s is None:
@@ -116,35 +116,54 @@ class autocast:
             self._slot_by_weight[v] = s
         return s
 
+    def _on_substitution(self, orig, new) -> None:
+        """Replay engines report proxy renames; a weight that already owns a
+        slot hands it to its replacement so re-lowered linears reuse it."""
+        if not isinstance(orig, TensorProxy) or not isinstance(new, TensorProxy):
+            return
+        s = self._slot_by_weight.get(Variable(orig))
+        if s is not None:
+            self._slot_by_weight.setdefault(Variable(new), s)
+
     def _record(self, slot: int, amax_x, amax_w) -> None:
         """Called from the ``nn.fp8_linear`` meta on every (re)trace.
 
         Within ONE live trace, multiple call sites sharing a slot (tied
-        weights) max-combine their amaxes so the shared history covers
-        both sites' activations; across trace passes (replays re-emit with
-        fresh proxies) the newest — live — proxies win, since combining
-        with a stale pass's proxies would reference dead variables."""
+        weights, checkpoint forward + backward recompute of the same
+        linear) max-combine their amaxes so the shared history covers all
+        sites; across trace passes (replays re-emit with fresh proxies)
+        the newest — live — proxies win, since combining with a stale
+        pass's proxies would reference dead variables. The trace is held
+        and compared BY OBJECT IDENTITY (not id()): a bare int id can be
+        reused by CPython after a TraceCtx is collected, which would alias
+        a dead pass with a live one (advisor r3, medium)."""
         from thunder_tpu.core.trace import get_tracectx
 
-        tid = id(get_tracectx())
+        tctx = get_tracectx()
         prev = self._amaxes.get(slot)
-        if prev is not None and prev[0] == tid:
+        if prev is not None and prev[0] is tctx:
             from thunder_tpu import ops
 
             amax_x = ops.maximum(prev[1], amax_x)
             amax_w = ops.maximum(prev[2], amax_w)
-        self._amaxes[slot] = (tid, amax_x, amax_w)
+        self._amaxes[slot] = (tctx, amax_x, amax_w)
 
     # -- context -----------------------------------------------------------
     def __enter__(self):
+        from thunder_tpu.core.transforms import _subst_listeners
+
         self._slot = 0
         self._amaxes = {}
         self._slot_by_weight = {}
         _fp8_stack.append(self)
+        _subst_listeners.append(self._on_substitution)
         return self
 
     def __exit__(self, *exc):
+        from thunder_tpu.core.transforms import _subst_listeners
+
         _fp8_stack.pop()
+        _subst_listeners.remove(self._on_substitution)
         return False
 
     # -- eligibility -------------------------------------------------------
@@ -165,10 +184,8 @@ class autocast:
             check(slot < self.state["x_hist"].shape[0],
                   lambda: f"fp8 state has {self.state['x_hist'].shape[0]} slots but "
                           f"the program contains more linears; re-run "
-                          f"init_state/count_linears. (Known cause: "
-                          f"tt.checkpoint/remat regions — the backward's "
-                          f"RECOMPUTED linears allocate fresh slots; fp8 "
-                          f"delayed scaling does not compose with remat yet)")
+                          f"init_state with n_slots=count_linears(...) on "
+                          f"this exact program")
             sx = _scale_from_hist(self.state["x_hist"][slot], E4M3_MAX, self.margin)
             sw = _scale_from_hist(self.state["w_hist"][slot], E4M3_MAX, self.margin)
         else:

@@ -815,10 +815,9 @@ def grid_sample(input, grid, mode: str = "bilinear", padding_mode: str = "zeros"
 
     to_i = lambda v: ops.convert_element_type(v, dtypes.int32)
     if mode == "nearest":
-        # torch rounds half toward nearest-even via round(); floor(x+0.5)
-        # matches its kernel behavior for the sampling use case
-        vals, inb = read(to_i(ops.floor(ops.add(x, 0.5))),
-                         to_i(ops.floor(ops.add(y, 0.5))))
+        # torch's kernel uses std::nearbyint — round half to even; ops.round
+        # (lax round-to-nearest-even) matches it exactly on .5 boundaries
+        vals, inb = read(to_i(ops.round(x)), to_i(ops.round(y)))
         return masked(vals, inb)
     x0f, y0f = ops.floor(x), ops.floor(y)
     wx = ops.reshape(ops.sub(x, x0f), (N, 1, Ho, Wo))
