@@ -165,3 +165,44 @@ def test_async_checkpoint_manager_pipeline(tmp_path):
     step, state = ck.restore_latest(template={"w": jnp.zeros((8,))})
     assert step == 6
     np.testing.assert_array_equal(np.asarray(state["w"]), np.full((8,), 6.0))
+
+
+def test_two_managers_interleaved_async_saves(tmp_path):
+    """Two CheckpointManagers (e.g. params + data-state, or two trainers in
+    one process) interleaving asynchronous saves must not collide: each save
+    owns its own AsyncCheckpointer keyed by path — no module-global singleton
+    (verdict r3 #10). Both managers' checkpoints restore intact."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.elastic import CheckpointManager
+
+    a = CheckpointManager(str(tmp_path / "a"), keep=2, asynchronous=True)
+    b = CheckpointManager(str(tmp_path / "b"), keep=2, asynchronous=True)
+    for step in (1, 2, 3):
+        a.save(step, {"w": jnp.full((16,), float(step))})
+        b.save(step, {"w": jnp.full((16,), float(-step))})  # in flight together
+    a.finalize()
+    b.finalize()
+    sa, st_a = a.restore_latest(template={"w": jnp.zeros((16,))})
+    sb, st_b = b.restore_latest(template={"w": jnp.zeros((16,))})
+    assert (sa, sb) == (3, 3)
+    np.testing.assert_array_equal(np.asarray(st_a["w"]), np.full((16,), 3.0))
+    np.testing.assert_array_equal(np.asarray(st_b["w"]), np.full((16,), -3.0))
+
+
+def test_async_inflight_backlog_bounded(tmp_path):
+    """Distinct-path async saves must not leak one AsyncCheckpointer per path
+    forever: the in-flight backlog is joined down to a small cap."""
+    import jax.numpy as jnp
+
+    from thunder_tpu import checkpoint_io as ckpt_io
+
+    for i in range(10):
+        ckpt_io.save_checkpoint(str(tmp_path / f"s{i}"), {"w": jnp.ones((4,))},
+                                asynchronous=True)
+    assert len(ckpt_io._inflight) <= ckpt_io._MAX_INFLIGHT
+    ckpt_io.wait_for_checkpoints()
+    assert len(ckpt_io._inflight) == 0
+    back = ckpt_io.load_checkpoint(str(tmp_path / "s0"),
+                                   template={"w": jnp.zeros((4,))})
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.ones((4,)))

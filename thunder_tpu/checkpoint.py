@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from typing import Any
 
 import numpy as np
@@ -29,53 +30,114 @@ def _orbax():
         return None
 
 
-_async_ckptr = None
+# In-flight asynchronous saves, keyed by destination path. Each save owns its
+# OWN AsyncCheckpointer (orbax allows one operation per checkpointer), so two
+# CheckpointManagers — or any two direct callers — saving concurrently to
+# different paths never collide on shared state (advisor r3 / verdict r3 #10:
+# the previous module-global singleton hit orbax's single-operation
+# constraint on the second concurrent save). ``_save_lock`` serializes save
+# INITIATIONS only (the join-prior-writer + start + register sequence, all
+# fast host work) so two threads saving one path can't both become writers;
+# the background filesystem writes themselves still overlap freely.
+_inflight: dict[str, Any] = {}
+_inflight_lock = threading.Lock()
+_save_lock = threading.Lock()
+
+# Distinct-path async saves would otherwise accumulate one never-joined
+# AsyncCheckpointer (and its thread resources) per path for the process
+# lifetime; cap the backlog — oldest saves are joined+closed once more than
+# this many are in flight (a deeper pipeline than this buys nothing anyway).
+_MAX_INFLIGHT = 4
 
 
-def save_checkpoint(path: str, state: Any, *, asynchronous: bool = False) -> None:
+def save_checkpoint(path: str, state: Any, *, asynchronous: bool = False) -> bool:
     """Save a pytree of arrays (params / optimizer state / step counters).
 
     ``asynchronous=True``: orbax AsyncCheckpointer — the device→host copy
     happens now, the filesystem write in a background thread, so training
     continues while the checkpoint lands (call :func:`wait_for_checkpoints`
-    before exiting, or the next save/restore joins automatically)."""
-    global _async_ckptr
+    before exiting, or the next save/restore of the same path joins
+    automatically).
 
+    Returns ``True`` when the save continues in the background, ``False``
+    when the data is fully committed on return (synchronous orbax, or the
+    numpy fallback — which has no async path, so callers deferring commit
+    markers can flip them immediately instead)."""
     ocp = _orbax()
     path = os.path.abspath(path)
     if ocp is not None:
-        if asynchronous:
-            if _async_ckptr is None:
-                _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-            _async_ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
-            return
-        wait_for_checkpoints()  # a sync save must not race an async writer
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path, state, force=True)
-        ckptr.wait_until_finished()
-        return
-    # numpy fallback
+        # one in-flight save per destination: re-saving a path joins the
+        # previous writer first so we never have two writers on one dir.
+        # Joins happen OUTSIDE _save_lock (they can take as long as a full
+        # filesystem write; holding the lock would stall unrelated-path
+        # saves); the lock covers only the fast claim-the-path window, and
+        # the loop re-checks after joining in case another thread claimed
+        # the path while we waited.
+        while True:
+            wait_for_checkpoints(path)
+            with _save_lock:
+                with _inflight_lock:
+                    busy = path in _inflight
+                if busy:
+                    continue  # another thread registered a writer: join it
+                if asynchronous:
+                    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+                    ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
+                    with _inflight_lock:
+                        _inflight[path] = ckptr
+                        overflow = list(_inflight)[:-_MAX_INFLIGHT]
+                else:
+                    ckptr = ocp.StandardCheckpointer()
+                    ckptr.save(path, state, force=True)
+                    ckptr.wait_until_finished()
+                    return False
+            # bound the distinct-path backlog, joining outside the lock
+            for k in overflow:
+                wait_for_checkpoints(k)
+            return True
+    # numpy fallback (always synchronous)
     os.makedirs(path, exist_ok=True)
     flat, treedef = tree_flatten(state)
     np.savez(os.path.join(path, "arrays.npz"),
              **{f"a{i}": np.asarray(x) for i, x in enumerate(flat)})
     with open(os.path.join(path, "treedef.pkl"), "wb") as f:
         pickle.dump(treedef, f)
+    return False
 
 
-def wait_for_checkpoints() -> None:
-    """Block until every asynchronous save has committed to disk."""
-    if _async_ckptr is not None:
-        _async_ckptr.wait_until_finished()
+def wait_for_checkpoints(path: str | None = None) -> None:
+    """Block until asynchronous saves commit: all of them (``path=None``)
+    or just the one writing ``path``."""
+    if path is None:
+        with _inflight_lock:
+            keys = list(_inflight)
+    else:
+        keys = [os.path.abspath(path)]
+    for k in keys:
+        with _inflight_lock:
+            ckptr = _inflight.get(k)
+        if ckptr is not None:
+            # wait FIRST, remove after: a concurrent joiner of the same path
+            # must find the entry and block too (popping before the wait
+            # would let it sail past while the write is still landing)
+            ckptr.wait_until_finished()
+            with _inflight_lock:
+                owned = _inflight.get(k) is ckptr
+                if owned:
+                    del _inflight[k]
+            if owned:  # exactly one joiner closes
+                close = getattr(ckptr, "close", None)
+                if close is not None:
+                    close()
 
 
 def load_checkpoint(path: str, template: Any | None = None) -> Any:
     """Load a checkpoint. ``template`` (a pytree of arrays or ShapeDtypeStructs,
     possibly sharded) restores with matching shardings — pass the current
     (possibly freshly-sharded) state to reshard onto a new mesh."""
-    wait_for_checkpoints()  # join any in-flight async save of this path
-    ocp = _orbax()
     path = os.path.abspath(path)
+    wait_for_checkpoints(path)  # join any in-flight async save of this path
+    ocp = _orbax()
     if ocp is not None and not os.path.exists(os.path.join(path, "treedef.pkl")):
         import jax
 

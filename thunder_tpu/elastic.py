@@ -62,7 +62,9 @@ class CheckpointManager:
     def _commit_pending(self) -> None:
         if self._pending is None:
             return
-        wait_for_checkpoints()
+        # join only OUR pending save — other managers' in-flight saves are
+        # their business (per-path checkpointers, no shared singleton)
+        wait_for_checkpoints(self._step_dir(self._pending))
         self._write_latest(self._pending)
         self._pending = None
         self._gc()
@@ -79,7 +81,14 @@ class CheckpointManager:
             self._commit_pending()
             if os.path.exists(d):
                 shutil.rmtree(d)
-            save_checkpoint(d, state, asynchronous=True)
+            was_async = save_checkpoint(d, state, asynchronous=True)
+            if not was_async:
+                # sync fallback (no orbax): the data is already on disk —
+                # deferring LATEST would leave a committed checkpoint
+                # unreferenced across a crash for no benefit (advisor r3)
+                self._write_latest(step)
+                self._gc()
+                return
             self._pending = step
             return
         if os.path.exists(d):
