@@ -675,3 +675,115 @@ def test_function_bridge_opt_out_and_weighted_mse():
         pytest.skip("this torch has no weighted mse_loss")
     got = ttorch.jit(lambda a, b, wt: F.mse_loss(a, b, weight=wt))(a, b, wt)
     np.testing.assert_allclose(_np(got), float(ref), atol=1e-5)
+
+
+class TestInputAliasGuards:
+    """Input-alias detection (verdict r3 #4; reference behaviors at
+    ``thunder/__init__.py:357-375,746-755``): the storage-sharing pattern of
+    the torch args joins the cache key, and an in-place write through an
+    input whose bytes overlap another input's errors loudly instead of
+    silently dropping the cross-view update."""
+
+    def test_overlapping_views_mutated_error_loudly(self):
+        from thunder_tpu.torch import AliasedInputMutationError
+
+        def f(a, b):
+            a.add_(1.0)
+            return a + b
+
+        jf = ttorch.jit(f)
+        base = torch.arange(8, dtype=torch.float32)
+        with pytest.raises(AliasedInputMutationError, match="overlaps"):
+            jf(base[0:4], base[2:6])
+
+    def test_aliased_readonly_inputs_are_fine(self):
+        def f(a, b):
+            return a + b
+
+        jf = ttorch.jit(f)
+        base = torch.arange(8, dtype=torch.float32)
+        out = np.asarray(jf(base[0:4], base[2:6]))
+        np.testing.assert_allclose(out, (base[0:4] + base[2:6]).numpy())
+
+    def test_distinct_tensors_do_not_retrace(self):
+        def f(a, b):
+            a.mul_(2.0)
+            return a + b
+
+        jf = ttorch.jit(f)
+        x1, y1 = torch.ones(4), torch.ones(4) * 3
+        x2, y2 = torch.full((4,), 2.0), torch.full((4,), 5.0)
+        np.testing.assert_allclose(np.asarray(jf(x1, y1)), [5.0] * 4)
+        misses_before = thunder_tpu.compile_stats(jf._jfn).cache_misses
+        hits_before = thunder_tpu.compile_stats(jf._jfn).cache_hits
+        np.testing.assert_allclose(np.asarray(jf(x2, y2)), [9.0] * 4)
+        stats = thunder_tpu.compile_stats(jf._jfn)
+        assert stats.cache_misses == misses_before  # same entry reused
+        assert stats.cache_hits == hits_before + 1
+
+    def test_alias_pattern_specializes_cache(self):
+        """distinct-tensor call then aliased-view call: the second must NOT
+        hit the first entry (alias pattern is in the key) — and since this
+        fn mutates, the aliased retrace errors."""
+        from thunder_tpu.torch import AliasedInputMutationError
+
+        def f(a, b):
+            a.add_(10.0)
+            return a + b
+
+        jf = ttorch.jit(f)
+        out = np.asarray(jf(torch.zeros(4), torch.ones(4)))
+        np.testing.assert_allclose(out, [11.0] * 4)
+        base = torch.zeros(8)
+        with pytest.raises(AliasedInputMutationError):
+            jf(base[0:4], base[1:5])
+
+    def test_same_storage_disjoint_views_ok(self):
+        """Non-overlapping views of one storage: mutation through one cannot
+        be seen through the other even in eager torch — allowed."""
+        def f(a, b):
+            a.add_(1.0)
+            return a + b
+
+        jf = ttorch.jit(f)
+        base = torch.arange(8, dtype=torch.float32)
+        out = np.asarray(jf(base[0:4], base[4:8]))
+        np.testing.assert_allclose(out, (base[0:4] + 1 + base[4:8]).numpy())
+
+    def test_bridge_path_guards_aliases_too(self):
+        """grad-enabled calls route through the autograd bridge — the alias
+        audit must cover that path as well (review r4 finding)."""
+        from thunder_tpu.torch import AliasedInputMutationError
+
+        w = torch.randn(4, requires_grad=True)
+
+        def f(w, a, b):
+            a.add_(1.0)
+            return (w * a + b).sum()
+
+        jf = ttorch.jit(f)
+        base = torch.zeros(8)
+        with pytest.raises(AliasedInputMutationError):
+            jf(w, base[0:4], base[2:6])
+        # distinct tensors still train fine through the bridge
+        loss = jf(w, torch.ones(4), torch.ones(4))
+        loss.backward()
+        assert w.grad is not None
+
+    def test_module_path_guards_aliases(self):
+        """ThunderModule inputs that are overlapping views get the same
+        audit as function inputs (review r4 finding)."""
+        from thunder_tpu.torch import AliasedInputMutationError
+
+        class Mut(nn.Module):
+            def forward(self, a, b):
+                a.add_(1.0)
+                return a + b
+
+        tm = ttorch.jit(Mut())
+        base = torch.zeros(8)
+        with torch.no_grad():
+            with pytest.raises(AliasedInputMutationError):
+                tm(base[0:4], base[2:6])
+            out = np.asarray(tm(torch.zeros(4), torch.ones(4)))
+        np.testing.assert_allclose(out, [2.0] * 4)

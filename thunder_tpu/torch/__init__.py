@@ -254,8 +254,20 @@ def _trace_autograd_function(cls, args, kwargs):
           "autograd.Function tracing requires an active trace")
     core_args = _unwrap(args)
     core_kwargs = _unwrap(kwargs or {})
-    needs = tuple(isinstance(a, TensorProxy) and a.dtype.is_inexact
-                  for a in core_args)
+    # ctx.needs_input_grad: use the REAL requires_grad carried by the torch
+    # tensors the bridge captured; only pure-proxy inputs (no torch origin)
+    # fall back to the is-a-float-tensor heuristic. User backward()s that
+    # branch on needs_input_grad then skip grads for frozen float inputs,
+    # matching torch (advisor r3). Non-tensor positional args get False,
+    # as torch does.
+    def _arg_needs_grad(orig, a):
+        if isinstance(orig, torch.Tensor):
+            return bool(orig.requires_grad)
+        if isinstance(orig, TorchProxy) and orig._requires_grad is not None:
+            return bool(orig._requires_grad)
+        return isinstance(a, TensorProxy) and a.dtype.is_inexact
+
+    needs = tuple(_arg_needs_grad(orig, a) for orig, a in zip(args, core_args))
 
     # new-style Functions define forward WITHOUT ctx + a setup_context hook
     base_setup = getattr(torch.autograd.Function, "setup_context", None)
@@ -531,11 +543,15 @@ class TorchProxy:
     TensorProxy; all torch functions/methods/operators on it record trace
     operations. In-place methods rebind ``_p`` (functionalization)."""
 
-    __slots__ = ("_p", "_orig_p", "_subscript_view")
+    __slots__ = ("_p", "_orig_p", "_subscript_view", "_requires_grad")
 
-    def __init__(self, p: TensorProxy):
+    def __init__(self, p: TensorProxy, requires_grad: bool | None = None):
         object.__setattr__(self, "_p", p)
         object.__setattr__(self, "_orig_p", p)
+        # None = unknown (intermediate values); the module-acquisition path
+        # stamps the REAL requires_grad of the wrapped torch parameter so
+        # autograd.Function's ctx.needs_input_grad reflects frozen params
+        object.__setattr__(self, "_requires_grad", requires_grad)
 
     # -- torch override protocol -------------------------------------------
     @classmethod
@@ -561,7 +577,7 @@ class TorchProxy:
 
     @property
     def requires_grad(self) -> bool:
-        return False
+        return bool(self._requires_grad) if self._requires_grad is not None else False
 
     @property
     def is_cuda(self) -> bool:
@@ -1649,16 +1665,26 @@ class _patched_module:
 
 
 def trace_torch_module(module: torch.nn.Module, params: dict, buffers: dict,
-                       args: tuple, kwargs: dict):
+                       args: tuple, kwargs: dict, arg_overlap=frozenset()):
     """Run ``module.forward`` over proxies; returns (output, mutated_buffers).
 
     ``params``/``buffers`` map qualified names to TensorProxies (or jax arrays
     when called concretely). Mutated buffers (via in-place torch ops) are the
-    epilogue: they come back as explicit outputs for write-back."""
-    wp = {k: TorchProxy(v) if isinstance(v, TensorProxy) else v for k, v in params.items()}
-    wb = {k: TorchProxy(v) if isinstance(v, TensorProxy) else v for k, v in buffers.items()}
+    epilogue: they come back as explicit outputs for write-back.
+    ``arg_overlap``: flat indices of (args, kwargs) leaves whose torch
+    storages byte-overlap another input's — in-place mutation through one of
+    those errors (the alias audit, shared with the function paths)."""
+    real_rg = {k: bool(p.requires_grad)
+               for k, p in module.named_parameters(remove_duplicate=False)}
+    wp = {k: TorchProxy(v, requires_grad=real_rg.get(k, True))
+          if isinstance(v, TensorProxy) else v for k, v in params.items()}
+    wb = {k: TorchProxy(v, requires_grad=False)
+          if isinstance(v, TensorProxy) else v for k, v in buffers.items()}
     with _patched_module(module, wp, wb), _TraceMode():
-        out = module(*_wrap(args), **_wrap(kwargs or {}))
+        wa = _wrap(args)
+        wk = _wrap(kwargs or {})
+        out = module(*wa, **wk)
+        _audit_aliased_mutation(wa, wk, arg_overlap)
     mutated = {k: w._p for k, w in wb.items()
                if isinstance(w, TorchProxy) and w._p is not w._orig_p}
     return _unwrap_out_tree(out), mutated
@@ -1738,6 +1764,9 @@ class ThunderModule:
         self._autograd_cache: dict = {}
         self._torch_dirty = False   # True once the bridge made the torch module live
         self._torch_fp = None
+        import threading as _threading
+
+        self._alias_lock = _threading.Lock()
         # seq_buckets on a module: pad the USER args/kwargs before dispatch
         # (never the parameters) — an HF-style attention_mask padded with
         # zeros gives exact masking for free. Padding happens in __call__
@@ -1767,8 +1796,9 @@ class ThunderModule:
             for dup, canon in self._tied.items():
                 (params if canon in params else buffers)[dup] = \
                     params.get(canon, buffers.get(canon))
-            out, mutated = trace_torch_module(self._torch_module, params, buffers,
-                                              args, kwargs)
+            out, mutated = trace_torch_module(
+                self._torch_module, params, buffers, args, kwargs,
+                arg_overlap=getattr(self, "_user_overlap", frozenset()))
         finally:
             self._torch_module.train(prev)
         return out, mutated
@@ -1814,12 +1844,25 @@ class ThunderModule:
                 self._buffers = {k: tensor_to_jax(v)
                                  for k, v in self._torch_module.named_buffers()}
                 self._torch_fp = fp
-        args, kwargs = _args_to_jax(args, kwargs)
-        p = dict(self._params)
-        p.update(self._overrides_parameters)
-        b = dict(self._buffers)
-        b.update(self._overrides_buffers)
-        out, mutated = self._jfn(p, b, self._training, args, kwargs)
+        # alias scan on the USER args (params/buffers are jax state — no
+        # torch view structure): the byte-overlap set keys the cache and
+        # arms the trace_torch_module audit via _user_overlap; serialized
+        # so concurrent calls can't disarm each other's audit
+        _, overlap = _alias_pattern(flat)
+        with self._alias_lock:
+            self._jfn._extra_cache_key = \
+                ("alias", tuple(sorted(overlap))) if overlap else None
+            self._user_overlap = overlap
+            try:
+                args, kwargs = _args_to_jax(args, kwargs)
+                p = dict(self._params)
+                p.update(self._overrides_parameters)
+                b = dict(self._buffers)
+                b.update(self._overrides_buffers)
+                out, mutated = self._jfn(p, b, self._training, args, kwargs)
+            finally:
+                self._jfn._extra_cache_key = None
+                self._user_overlap = frozenset()
         for k, v in mutated.items():
             target = self._overrides_buffers if k in self._overrides_buffers else self._buffers
             target[k] = v
@@ -1992,6 +2035,76 @@ def _args_to_jax(args, kwargs):
     return conv(args), conv(kwargs)
 
 
+class AliasedInputMutationError(RuntimeError):
+    """An in-place op wrote through an input that shares storage with another
+    input. The functionalized trace treats the two views as independent
+    tensors, so the write would NOT be visible through the other view — a
+    silent divergence from eager torch. The reference errors on this too
+    (``thunder/__init__.py:746-755``: in-place to aliased args is rejected)."""
+
+
+def _alias_spans(flat):
+    """Byte spans of the torch-tensor leaves: (leaf_idx, storage_ptr,
+    start_byte, end_byte). Empty tensors carry no span."""
+    spans = []
+    for i, t in enumerate(flat):
+        if not isinstance(t, torch.Tensor) or t.numel() == 0:
+            continue
+        try:
+            ptr = t.untyped_storage().data_ptr()
+        except Exception:
+            continue
+        esz = t.element_size()
+        start = t.storage_offset() * esz
+        extent = 1 + sum((s - 1) * abs(st) for s, st in zip(t.shape, t.stride()))
+        spans.append((i, ptr, start, start + extent * esz))
+    return spans
+
+
+def _alias_pattern(flat):
+    """The call's alias pattern: (shared_groups, overlap_indices).
+
+    ``shared_groups``: tuple of index-tuples sharing one storage (cache-key
+    material — an aliased call must not reuse a distinct-tensor entry).
+    ``overlap_indices``: the subset whose byte ranges actually intersect
+    some other arg's — mutating THOSE is the correctness hole."""
+    spans = _alias_spans(flat)
+    by_ptr: dict = {}
+    for rec in spans:
+        by_ptr.setdefault(rec[1], []).append(rec)
+    groups = []
+    overlap: set = set()
+    for recs in by_ptr.values():
+        if len(recs) < 2:
+            continue
+        groups.append(tuple(sorted(r[0] for r in recs)))
+        for a in recs:
+            for b in recs:
+                if a[0] != b[0] and a[2] < b[3] and b[2] < a[3]:
+                    overlap.add(a[0])
+    return tuple(sorted(groups)), frozenset(overlap)
+
+
+def _audit_aliased_mutation(wargs, wkw, overlap_indices) -> None:
+    """Shared trace-time audit: TorchProxy functionalization rebinds ``_p``
+    on in-place writes; an input so rebound whose bytes OVERLAP another
+    input's (per the caller's alias scan of the live call) must error —
+    eager torch would propagate the write, the pure trace cannot."""
+    if not overlap_indices:
+        return
+    from thunder_tpu.core.pytree import tree_flatten as _tf
+
+    wflat, _ = _tf((wargs, wkw))
+    for i, w in enumerate(wflat):
+        if (isinstance(w, TorchProxy) and i in overlap_indices
+                and w._p is not w._orig_p):
+            raise AliasedInputMutationError(
+                f"input #{i} was mutated in-place but overlaps another "
+                f"input's storage (indices {sorted(overlap_indices)}); the "
+                f"compiled trace cannot propagate the write to the other "
+                f"view. Pass .clone()d tensors or make the op out-of-place.")
+
+
 def jit(module_or_fn, **jit_kwargs):
     """torch-dialect entry: jit a torch.nn.Module (→ :class:`ThunderModule`)
     or a torch-calling function (args may be torch tensors; traced via the
@@ -2005,7 +2118,11 @@ def jit(module_or_fn, **jit_kwargs):
 
     def traced(*args, **kwargs):
         with _TraceMode():
-            out = _wrap(fn(*_wrap(args), **_wrap(kwargs)))
+            wargs = _wrap(args)
+            wkw = _wrap(kwargs)
+            out = _wrap(fn(*wargs, **wkw))
+            _audit_aliased_mutation(wargs, wkw,
+                                    getattr(traced, "_overlap_indices", None))
         return _unwrap_out_tree(out)
 
     traced.__name__ = getattr(fn, "__name__", "fn")
@@ -2031,9 +2148,12 @@ class _ConvertingWrapper:
     reference's ``thunder.jit(fn)`` function-training UX)."""
 
     def __init__(self, jfn, torch_fn=None):
+        import threading
+
         self._jfn = jfn
         self._torch_fn = torch_fn
         self._autograd_cache: dict = {}
+        self._alias_lock = threading.Lock()
 
     def __call__(self, *args, **kwargs):
         if getattr(self._jfn, "seq_buckets", None) is not None:
@@ -2043,10 +2163,11 @@ class _ConvertingWrapper:
                 self._jfn.seq_buckets, self._jfn.seq_dim, args, kwargs,
                 argnums=self._jfn.seq_argnums,
                 inject_seq_len=self._jfn._accepts_seq_len)
-        if self._torch_fn is not None and torch.is_grad_enabled():
-            from thunder_tpu.core.pytree import tree_flatten as _tf
+        from thunder_tpu.core.pytree import tree_flatten as _tf
 
-            flat, _ = _tf((args, kwargs))
+        # one flatten serves the grad-routing scan AND the alias scan
+        flat, _ = _tf((args, kwargs))
+        if self._torch_fn is not None and torch.is_grad_enabled():
             needs = any(isinstance(l, torch.Tensor) and l.requires_grad for l in flat)
             others = any(not isinstance(l, torch.Tensor) and hasattr(l, "shape")
                          and hasattr(l, "dtype") for l in flat)
@@ -2055,11 +2176,36 @@ class _ConvertingWrapper:
                     call_function_with_torch_autograd,
                 )
 
+                # the bridge runs its own alias scan/audit (it caches and
+                # traces independently of the core jit)
                 return call_function_with_torch_autograd(
                     self._torch_fn, args, kwargs, self._autograd_cache,
                     self._jfn.executors)
-        args, kwargs = _args_to_jax(args, kwargs)
-        return self._jfn(*args, **kwargs)
+        # input-alias scan (on the torch tensors, BEFORE jax conversion —
+        # jax arrays are immutable and carry no view structure): the
+        # byte-OVERLAP set both specializes the cache key (an overlapping-
+        # view call must never hit an entry whose trace-time mutation audit
+        # ran with different overlap indices — non-overlapping storage
+        # sharing compiles identically, so it does NOT key) and arms that
+        # audit in `traced`. The set→call→reset window is serialized so a
+        # concurrent call can't disarm this one's audit mid-flight.
+        _, overlap = _alias_pattern(flat)
+        fn_shim = getattr(self._jfn, "fn", None)
+        with self._alias_lock:
+            self._jfn._extra_cache_key = \
+                ("alias", tuple(sorted(overlap))) if overlap else None
+            if fn_shim is not None:
+                fn_shim._overlap_indices = overlap
+            try:
+                args, kwargs = _args_to_jax(args, kwargs)
+                return self._jfn(*args, **kwargs)
+            finally:
+                # per-call context must not leak to direct self._jfn uses
+                # (the tooling path / raw jax-array calls, where aliasing
+                # cannot occur): reset to the unspecialized default
+                self._jfn._extra_cache_key = None
+                if fn_shim is not None:
+                    fn_shim._overlap_indices = frozenset()
 
     def __getattr__(self, name):
         return getattr(self._jfn, name)

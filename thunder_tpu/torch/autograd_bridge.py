@@ -115,7 +115,8 @@ def _args_cache_key(flat, treedef, extra=()):
     return (treedef, tuple(parts))
 
 
-def compile_autograd_step(tm, args: tuple, kwargs: dict) -> CompiledAutogradStep:
+def compile_autograd_step(tm, args: tuple, kwargs: dict,
+                          arg_overlap=frozenset()) -> CompiledAutogradStep:
     """Trace ``tm``'s torch module functionally, split fwd/bwd, compile both.
 
     Trace-arg order: params (canonical named_parameters order), buffers,
@@ -171,7 +172,8 @@ def compile_autograd_step(tm, args: tuple, kwargs: dict) -> CompiledAutogradStep
         prev = module.training
         module.train(tm._training)
         try:
-            out, mutated = trace_torch_module(module, pparams, pbuffers, pargs, pkwargs)
+            out, mutated = trace_torch_module(module, pparams, pbuffers, pargs,
+                                              pkwargs, arg_overlap=arg_overlap)
         finally:
             module.train(prev)
         mutated_items = sorted(mutated.items())
@@ -266,14 +268,19 @@ def call_with_torch_autograd(tm, args: tuple, kwargs: dict):
     user's output tree with autograd-tracked torch tensors."""
     from thunder_tpu.torch import tensor_to_jax
 
+    from thunder_tpu.torch import _alias_pattern
+
     flat, treedef = tree_flatten((args, kwargs))
+    _, overlap = _alias_pattern(flat)
     module = tm._torch_module
     state_sig = tuple((tuple(t.shape), str(t.dtype)) for _, t in
                       list(module.named_parameters()) + list(module.named_buffers()))
-    key = _args_cache_key(flat, treedef, extra=(tm._training, state_sig))
+    key = _args_cache_key(flat, treedef,
+                          extra=(tm._training, state_sig,
+                                 tuple(sorted(overlap))))
     step = tm._autograd_cache.get(key)
     if step is None:
-        step = compile_autograd_step(tm, args, kwargs)
+        step = compile_autograd_step(tm, args, kwargs, arg_overlap=overlap)
         tm._autograd_cache[key] = step
 
     param_tensors = [t for _, t in module.named_parameters()]
@@ -305,10 +312,13 @@ def call_with_torch_autograd(tm, args: tuple, kwargs: dict):
 # (the reference's thunder.jit(fn) trains too, not only modules)
 # ---------------------------------------------------------------------------
 
-def compile_function_autograd_step(fn, args: tuple, kwargs: dict,
-                                   executors) -> CompiledAutogradStep:
+def compile_function_autograd_step(fn, args: tuple, kwargs: dict, executors,
+                                   overlap_indices=frozenset()) -> CompiledAutogradStep:
     """Trace a torch-calling function, split fwd/bwd, compile both. Trace-arg
-    order: tensor leaves of (args, kwargs) in flatten order (+ RNG key)."""
+    order: tensor leaves of (args, kwargs) in flatten order (+ RNG key).
+    ``overlap_indices``: flat-leaf indices whose storage bytes overlap another
+    input's — an in-place write through one of those must error (same audit
+    as the non-bridge path; see ``AliasedInputMutationError``)."""
     import jax
 
     from thunder_tpu.torch import _TraceMode, _unwrap_out_tree, _wrap, to_thunder_dtype
@@ -336,7 +346,12 @@ def compile_function_autograd_step(fn, args: tuple, kwargs: dict,
             proxies.append(p)
         pargs, pkwargs = tree_unflatten(treedef, pflat)
         with _TraceMode():
-            out = _wrap(fn(*_wrap(pargs), **_wrap(pkwargs)))
+            wa = _wrap(pargs)
+            wk = _wrap(pkwargs)
+            out = _wrap(fn(*wa, **wk))
+            from thunder_tpu.torch import _audit_aliased_mutation
+
+            _audit_aliased_mutation(wa, wk, overlap_indices)
         out = _unwrap_out_tree(out)
         full_out = (out, ())
         prims.python_return(full_out)
@@ -350,11 +365,15 @@ def call_function_with_torch_autograd(fn, args: tuple, kwargs: dict,
                                       cache: dict, executors):
     """Bridge body for jitted torch functions: outputs are autograd-tracked
     torch tensors; backward runs the compiled bwd trace."""
+    from thunder_tpu.torch import _alias_pattern
+
     flat, treedef = tree_flatten((args, kwargs))
-    key = _args_cache_key(flat, treedef)
+    _, overlap = _alias_pattern(flat)
+    key = (_args_cache_key(flat, treedef), tuple(sorted(overlap)))
     step = cache.get(key)
     if step is None:
-        step = compile_function_autograd_step(fn, args, kwargs, executors)
+        step = compile_function_autograd_step(fn, args, kwargs, executors,
+                                              overlap_indices=overlap)
         cache[key] = step
 
     tensor_args = [flat[i] for i in step.tensor_arg_positions]
