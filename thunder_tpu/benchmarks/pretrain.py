@@ -115,11 +115,11 @@ def main():
         from thunder_tpu.models import mixtral
 
         if cfg.n_experts % n_dev:
-            raise SystemExit(f"{cfg.n_experts} experts must divide the "
-                             f"device count {n_dev}")
+            raise SystemExit(f"n_experts {cfg.n_experts} must be divisible "
+                             f"by the device count {n_dev}")
         if args.batch % n_dev:
-            raise SystemExit(f"--batch {args.batch} must divide the device "
-                             f"count {n_dev} (the batch shards on the ep axis)")
+            raise SystemExit(f"--batch {args.batch} must be divisible by the "
+                             f"device count {n_dev} (the batch shards on the ep axis)")
         jstep = expert_parallel(train_step, MeshSpec.make(ep=n_dev),
                                 expert_patterns=mixtral.EP_PATTERNS)
     elif args.mode == "tp":

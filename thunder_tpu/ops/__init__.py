@@ -210,6 +210,17 @@ def arange(start, end=None, step=1, *, dtype=None, device=None):
     return prims.iota(length, start=start, step=step, dtype=dtypes.to_dtype(dtype), device=device)
 
 
+def _tensor_like(a, opname: str):
+    """Named trace-time type contract shared by the shape/dim ops: the
+    failure mode must be a TypeError naming the op, not an AttributeError
+    from ``.ndim`` somewhere downstream (reference: clang ops validate
+    inputs up front, ``thunder/clang/__init__.py``)."""
+    check(isinstance(a, TensorProxy) or hasattr(a, "ndim"),
+          lambda: f"{opname}: expected a tensor, got {type(a).__name__}",
+          exc_type=TypeError)
+    return a
+
+
 def tril_mask(rows: int, cols: int, diagonal: int = 0, *, device=None):
     """Boolean lower-triangular mask built from iota compares (fusible)."""
     r = prims.iota(rows, dtype=dtypes.int32, device=device)
@@ -220,11 +231,13 @@ def tril_mask(rows: int, cols: int, diagonal: int = 0, *, device=None):
 
 
 def tril(a, diagonal: int = 0):
+    _tensor_like(a, "tril")
     mask = tril_mask(a.shape[-2], a.shape[-1], diagonal, device=a.device)
     return where(expand_to(mask, a.shape), a, zeros_like(a))
 
 
 def triu(a, diagonal: int = 0):
+    _tensor_like(a, "triu")
     mask = tril_mask(a.shape[-2], a.shape[-1], diagonal - 1, device=a.device)
     return where(expand_to(mask, a.shape), zeros_like(a), a)
 
@@ -284,6 +297,11 @@ def _make_unary(name: str, prim, *, float_promote: bool = False, py=None):
         if isinstance(a, Number):
             check(py is not None, lambda: f"{name} of a python number is unsupported")
             return py(a)
+        # named trace-time contract (not a cryptic AttributeError downstream):
+        # the reference's clang ops validate inputs the same way
+        check(isinstance(a, (TensorProxy, NumberProxy)) or hasattr(a, "shape"),
+              lambda: f"{name}: expected a tensor or number, got {type(a).__name__}",
+              exc_type=TypeError)
         if float_promote:
             a = _float_promote(a)
         return prim(a)
@@ -358,6 +376,11 @@ def _make_binary(name: str, prim, *, py=None, float_promote: bool = False):
         if isinstance(a, Number) and isinstance(b, Number):
             check(py is not None, lambda: f"{name} of two python numbers is unsupported")
             return py(pyval(a), pyval(b))
+        for x in (a, b):
+            check(isinstance(x, (TensorProxy, NumberProxy, Number))
+                  or hasattr(x, "shape"),
+                  lambda: f"{name}: expected tensors or numbers, got {type(x).__name__}",
+                  exc_type=TypeError)
         if float_promote:
             a, b = _float_promote(a), _float_promote(b)
         a, b = maybe_broadcast(a, b)
@@ -503,6 +526,7 @@ def movedim(a, src, dst):
 
 
 def squeeze(a, dim=None):
+    _tensor_like(a, "squeeze")
     if dim is None:
         dims = tuple(i for i, s in enumerate(a.shape) if s == 1)
     else:
@@ -514,6 +538,7 @@ def squeeze(a, dim=None):
 
 
 def unsqueeze(a, dim):
+    _tensor_like(a, "unsqueeze")
     dim = canonicalize_dim(a.ndim + 1, dim)
     return reshape(a, a.shape[:dim] + (1,) + a.shape[dim:])
 
@@ -577,16 +602,19 @@ def chunk(a, chunks, dim=0):
 
 
 def flip(a, dims):
+    _tensor_like(a, "flip")
     return prims.flip(a, canonicalize_dims(a.ndim, tuple(dims) if isinstance(dims, (tuple, list)) else (dims,)))
 
 
 def pad(a, padding_config, value=0):
     """lax-style padding config: ((lo, hi, interior), ...) per dim."""
+    _tensor_like(a, "pad")
     return prims.pad(a, value, tuple(padding_config))
 
 
 def pad_last(a, pads: Sequence[int], value=0):
     """torch.nn.functional.pad semantics: pairs from the last dim backwards."""
+    _tensor_like(a, "pad_last")
     cfg = [(0, 0, 0)] * a.ndim
     pairs = [(pads[i], pads[i + 1]) for i in range(0, len(pads), 2)]
     for i, (lo, hi) in enumerate(pairs):
@@ -595,6 +623,7 @@ def pad_last(a, pads: Sequence[int], value=0):
 
 
 def take(a, indices, dim=0):
+    _tensor_like(a, "take")
     return prims.take(a, indices, canonicalize_dim(a.ndim, dim))
 
 
@@ -602,6 +631,7 @@ index_select = take
 
 
 def gather(a, dim, index):
+    _tensor_like(a, "gather")
     return prims.take_along_axis(a, index, canonicalize_dim(a.ndim, dim))
 
 
@@ -844,6 +874,7 @@ def getitem(a, idx):
 
 
 def roll(a, shifts, dims):
+    _tensor_like(a, "roll")
     shifts = (shifts,) if isinstance(shifts, int) else tuple(shifts)
     dims = (dims,) if isinstance(dims, int) else tuple(dims)
     out = a
@@ -869,6 +900,9 @@ def repeat_interleave_dim0(a, repeats: int):
 # ---------------------------------------------------------------------------
 
 def _reduce_dims(a, dim) -> tuple[int, ...]:
+    check(isinstance(a, TensorProxy) or hasattr(a, "ndim"),
+          lambda: f"reduction: expected a tensor, got {type(a).__name__}",
+          exc_type=TypeError)
     if dim is None:
         return tuple(range(a.ndim))
     return canonicalize_dims(a.ndim, dim if isinstance(dim, (tuple, list)) else (dim,))
@@ -999,11 +1033,13 @@ def cumprod(a, dim):
 
 
 def sort(a, dim=-1, descending=False):
+    _tensor_like(a, "sort")
     d = canonicalize_dim(a.ndim, dim)
     return prims.sort(a, d, descending), prims.argsort(a, d, descending)
 
 
 def argsort(a, dim=-1, descending=False):
+    _tensor_like(a, "argsort")
     return prims.argsort(a, canonicalize_dim(a.ndim, dim), descending)
 
 
