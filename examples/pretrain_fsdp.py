@@ -1,0 +1,70 @@
+"""FSDP pretraining on a device mesh — the multi-chip entry point.
+
+On real hardware this shards over the TPU slice; with no slice attached it
+runs identically on a virtual 8-device CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/pretrain_fsdp.py --steps 20
+
+Params and optimizer state are born sharded (ZeRO); the batch shards over
+the same axis; XLA inserts and overlaps the collectives.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import thunder_tpu as tt
+from thunder_tpu.core.devices import MeshSpec
+from thunder_tpu.distributed import fsdp
+from thunder_tpu.models import llama
+from thunder_tpu.optim import AdamW
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=8, help="GLOBAL batch")
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--zero", type=int, default=2, choices=(1, 2, 3))
+    ap.add_argument("--lr", type=float, default=3e-4)
+    args = ap.parse_args()
+
+    import jax
+
+    n_dev = len(jax.devices())
+    if args.batch % n_dev:
+        raise SystemExit(f"--batch {args.batch} must be divisible by the "
+                         f"device count {n_dev}")
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0)
+    opt = AdamW(lr=args.lr)
+    opt_state = opt.init(params)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_params, new_opt = opt.update(params, grads, opt_state)
+        return loss, new_params, new_opt
+
+    jstep = fsdp(train_step, MeshSpec.make(fsdp=n_dev), zero=args.zero)
+
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        tokens = rng.randint(0, cfg.vocab_size,
+                             (args.batch, args.seq)).astype(np.int32)
+        targets = np.roll(tokens, -1, 1).astype(np.int32)
+        loss, params, opt_state = jstep(params, opt_state, tokens, targets)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={float(np.asarray(loss)):.4f} "
+                  f"({n_dev}-device mesh, zero{args.zero})")
+    toks = args.steps * args.batch * args.seq
+    dt = time.perf_counter() - t0
+    print(f"done: {toks} tokens in {dt:.1f}s ({toks / dt:,.0f} tok/s global)")
+
+
+if __name__ == "__main__":
+    main()

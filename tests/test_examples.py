@@ -1,0 +1,48 @@
+"""Smoke tests for the runnable examples (verdict r3 #9: three <100-line
+entry-point scripts, each must run green on CPU with --steps 2)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script),
+         "--steps", "2", *extra],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert out.returncode == 0, f"{script} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def test_pretrain_tiny_runs():
+    out = _run("pretrain_tiny.py", "--batch", "2", "--seq", "32")
+    assert "done:" in out and "loss" in out
+
+
+def test_pretrain_fsdp_runs():
+    out = _run("pretrain_fsdp.py", "--batch", "8", "--seq", "32")
+    assert "8-device mesh" in out and "done:" in out
+
+
+def test_finetune_hf_runs():
+    pytest.importorskip("transformers")
+    out = _run("finetune_hf.py", "--batch", "2", "--seq", "32")
+    assert "done in" in out
+
+
+def test_examples_are_short():
+    """The entry points stay example-sized (<100 lines each, like the
+    reference's llama2.c train.py promise of a readable script)."""
+    for script in ("pretrain_tiny.py", "pretrain_fsdp.py", "finetune_hf.py"):
+        path = os.path.join(REPO, "examples", script)
+        n = sum(1 for _ in open(path))
+        assert n < 100, f"{script} has {n} lines"
