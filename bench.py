@@ -99,11 +99,9 @@ def main():
     model_loss = (functools.partial(base_loss, remat=True) if use_remat
                   else base_loss)
 
-    if use_fp8 and use_remat:
-        sys.exit("BENCH_FP8 + BENCH_REMAT: fp8 delayed scaling does not "
-                 "compose with checkpoint recompute yet (each replayed "
-                 "linear would need its original slot's scales); run the "
-                 "depth mode in bf16 or fp8 without remat")
+    # fp8 x remat composes since round 4: the checkpoint backward's
+    # recomputed linears resolve to the forward's weight-keyed slots via
+    # substitution propagation (fp8.py / core.transforms notify_substitution)
     if use_fp8:
         from thunder_tpu import fp8
 
@@ -253,6 +251,17 @@ def main():
     params = llama.init_params(cfg, seed=0, scale_layers=n_layers)
     t_ref, loss_ref = time_steps(jax_step, params, opt.init(params))
     print(f"jax.jit ref: {t_ref*1e3:.1f} ms/step loss={loss_ref:.3f}", file=sys.stderr)
+
+    if os.environ.get("BENCH_BREAKDOWN") == "1" and not use_fp8:
+        from thunder_tpu.benchmarks import breakdown as _bd
+
+        params = llama.init_params(cfg, seed=0, scale_layers=n_layers)  # prior
+        # copies were donated/consumed by the timed steps above
+        rows = _bd.run_breakdown(
+            cfg=cfg, n_layers=n_layers, params=params, tokens=tokens,
+            targets=targets, model_loss=model_loss, t_full=t_ours, steps=steps)
+        _bd.save(rows, {"model": model, "layers": n_layers, "batch": batch,
+                        "seq": seq, "remat": use_remat})
 
     tokens_per_sec = batch * seq / t_ours
     fpt = llama.flops_per_token(cfg, seq, n_layers)
