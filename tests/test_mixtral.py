@@ -170,3 +170,34 @@ def test_expert_parallel_dropless_matches_single_device(eight_devices):
                             expert_patterns=mixtral.EP_PATTERNS)
     ep_losses, _ = run(jstep, params, opt.init(params))
     np.testing.assert_allclose(ref_losses, ep_losses, atol=1e-5, rtol=1e-5)
+
+
+def test_mixtral_remat_and_fused_loss_parity():
+    """remat=True (per-block checkpoint) and the chunked-vocab fused loss
+    must match the plain path exactly — the memory shape that fits 8x7B
+    training (NORTHSTAR.md)."""
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu.models import mixtral
+
+    cfg = mixtral.CONFIGS["tiny-moe"]
+    params = mixtral.init_params(cfg, seed=0, scale_layers=2)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+
+    def g(loss_fn, **kw):
+        return tt.jit(lambda p: tt.value_and_grad(
+            lambda q: loss_fn(q, tokens, targets, cfg, **kw))(p))(params)
+
+    l0, g0 = g(mixtral.loss_fn)
+    l1, g1 = g(mixtral.loss_fn, remat=True)
+    l2, g2 = g(mixtral.fused_loss_fn, remat=True)
+    np.testing.assert_allclose(float(np.asarray(l0)), float(np.asarray(l1)), rtol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(l0)), float(np.asarray(l2)), rtol=1e-4)
+    from thunder_tpu.core.pytree import tree_flatten
+    for a, b in zip(tree_flatten(g0)[0], tree_flatten(g1)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+    for a, b in zip(tree_flatten(g0)[0], tree_flatten(g2)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3)

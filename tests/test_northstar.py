@@ -84,9 +84,9 @@ def mixtral_ep():
                                    + mcfg.top_k * expert))
     return ns.run_config(
         "mixtral-8x7b-ep-v5p16",
-        lambda: ns.abstract_mixtral_ep_step(batch=8, seq=4096, n_dev=8),
-        ns.TOPO_V5P_16, 8, 8 * 4096, n_active,
-        ns.analytic_train_flops(n_active, 8 * 4096, mcfg, 4096))
+        lambda: ns.abstract_mixtral_ep_step(batch=8, seq=2048, n_dev=8),
+        ns.TOPO_V5P_16, 8, 8 * 2048, n_active,
+        ns.analytic_train_flops(n_active, 8 * 2048, mcfg, 2048))
 
 
 @needs_run

@@ -194,7 +194,12 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
 
 
 def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False,
-            return_metrics: bool = False):
+            return_metrics: bool = False, remat: bool = False,
+            skip_head: bool = False):
+    """``remat=True`` checkpoints each block (attention + MoE) so only the
+    (B*T, dim) block inputs are saved — the expert-MLP intermediates at
+    (tokens, 14336) f32 are what blow HBM at 8x7B scale. ``skip_head=True``
+    returns the pre-lm_head hidden states (the fused-loss path)."""
     B, T = tokens.shape
     h = ops.embedding(tokens, params["tok_embedding"])
     cos, sin = _llama._rope_cos_sin(cfg, T, h.dtype)
@@ -203,11 +208,12 @@ def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False,
     aux_total = None
     layer_metrics = []
 
-    for layer in params["layers"]:
-        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
-        q = ops.linear(x, layer["wq"])
-        kk = ops.linear(x, layer["wk"])
-        v = ops.linear(x, layer["wv"])
+    def block(h, attn_norm, wq, wk, wv, wo, mlp_norm, router,
+              we_gate, we_up, we_down):
+        x = ops.rms_norm(h, attn_norm, eps=cfg.norm_eps)
+        q = ops.linear(x, wq)
+        kk = ops.linear(x, wk)
+        v = ops.linear(x, wv)
         q = ops.transpose(ops.reshape(q, (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
         kk = ops.transpose(ops.reshape(kk, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
         v = ops.transpose(ops.reshape(v, (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
@@ -220,21 +226,59 @@ def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False,
                             (B, cfg.n_heads, T, hd))
         attn = ops.scaled_dot_product_attention(q, kk, v, is_causal=True)
         attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
-        h = ops.add(h, ops.linear(attn, layer["wo"]))
+        h = ops.add(h, ops.linear(attn, wo))
 
+        x = ops.rms_norm(h, mlp_norm, eps=cfg.norm_eps)
+        moe_out, aux = moe_ffn(ops.reshape(x, (B * T, cfg.dim)), router,
+                               we_gate, we_up, we_down, cfg)
+        return ops.add(h, ops.reshape(moe_out, (B, T, cfg.dim))), aux
+
+    def block_with_metrics(h, layer):
+        # diagnostics path (un-checkpointed): same math as ``block`` but
+        # moe_ffn also returns per-layer routing metrics
+        x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+        q = ops.transpose(ops.reshape(ops.linear(x, layer["wq"]),
+                                      (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
+        kk = ops.transpose(ops.reshape(ops.linear(x, layer["wk"]),
+                                       (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        v = ops.transpose(ops.reshape(ops.linear(x, layer["wv"]),
+                                      (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        q = _llama._apply_rope(q, cos, sin)
+        kk = _llama._apply_rope(kk, cos, sin)
+        if n_rep > 1:
+            kk = ops.reshape(ops.expand(ops.unsqueeze(kk, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                             (B, cfg.n_heads, T, hd))
+            v = ops.reshape(ops.expand(ops.unsqueeze(v, 2), (B, cfg.kv_heads, n_rep, T, hd)),
+                            (B, cfg.n_heads, T, hd))
+        attn = ops.scaled_dot_product_attention(q, kk, v, is_causal=True)
+        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, cfg.n_heads * hd))
+        h = ops.add(h, ops.linear(attn, layer["wo"]))
         x = ops.rms_norm(h, layer["mlp_norm"], eps=cfg.norm_eps)
-        res = moe_ffn(ops.reshape(x, (B * T, cfg.dim)), layer["router"],
-                      layer["we_gate"], layer["we_up"], layer["we_down"], cfg,
-                      return_metrics=return_metrics)
+        moe_out, aux, metrics = moe_ffn(
+            ops.reshape(x, (B * T, cfg.dim)), layer["router"],
+            layer["we_gate"], layer["we_up"], layer["we_down"], cfg,
+            return_metrics=True)
+        return ops.add(h, ops.reshape(moe_out, (B, T, cfg.dim))), aux, metrics
+
+    for layer in params["layers"]:
         if return_metrics:
-            moe_out, aux, metrics = res
+            h, aux, metrics = block_with_metrics(h, layer)
             layer_metrics.append(metrics)
         else:
-            moe_out, aux = res
-        h = ops.add(h, ops.reshape(moe_out, (B, T, cfg.dim)))
+            fn = block
+            if remat:
+                import thunder_tpu as tt
+
+                fn = tt.checkpoint(block)
+            h, aux = fn(h, layer["attn_norm"], layer["wq"], layer["wk"],
+                        layer["wv"], layer["wo"], layer["mlp_norm"],
+                        layer["router"], layer["we_gate"], layer["we_up"],
+                        layer["we_down"])
         aux_total = aux if aux_total is None else ops.add(aux_total, aux)
 
     h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+    if skip_head:
+        return h, aux_total
     logits = ops.linear(h, params["lm_head"])
     if return_metrics:
         return logits, aux_total, layer_metrics
@@ -243,11 +287,28 @@ def forward(params, tokens, cfg: MixtralConfig, return_aux: bool = False,
     return logits
 
 
-def loss_fn(params, tokens, targets, cfg: MixtralConfig):
-    logits, aux = forward(params, tokens, cfg, return_aux=True)
+def loss_fn(params, tokens, targets, cfg: MixtralConfig, remat: bool = False):
+    logits, aux = forward(params, tokens, cfg, return_aux=True, remat=remat)
     B, T, V = logits.shape
     ce = ops.cross_entropy(ops.convert_element_type(ops.reshape(logits, (B * T, V)), dtypes.float32),
                            ops.reshape(targets, (B * T,)))
+    return ops.add(ce, aux)
+
+
+def fused_loss_fn(params, tokens, targets, cfg: MixtralConfig,
+                  remat: bool = False):
+    """Chunked-vocab loss (lm_head fused into the CE — the (B*T, vocab)
+    f32 logits are never materialized) + optional per-block remat: the
+    memory shape that fits Mixtral-8x7B training on real HBM budgets
+    (NORTHSTAR.md)."""
+    from thunder_tpu.ops import nn as tnn
+
+    B, T = tokens.shape
+    h, aux = forward(params, tokens, cfg, remat=remat, skip_head=True)
+    out = tnn.fused_linear_cross_entropy(
+        ops.reshape(h, (B * T, cfg.dim)), params["lm_head"],
+        ops.reshape(targets, (B * T,)))
+    ce = out[0] if isinstance(out, tuple) else out
     return ops.add(ce, aux)
 
 
