@@ -121,3 +121,21 @@ def test_resnet_stage_downsampling_shapes():
     x = np.random.rand(2, 3, 32, 32).astype(np.float32)
     logits, _ = tt.jit(lambda p, s: resnet.forward(p, x, cfg, state=s))(params, state)
     assert np.asarray(logits).shape == (2, 5)
+
+
+def test_generate_fused_matches_per_step():
+    """The one-dispatch lax.scan decode loop (generate_fused) must produce
+    exactly the greedy per-step generate tokens — same traced step, same
+    executors, zero per-token host round-trips."""
+    import numpy as np
+
+    from thunder_tpu.models import llama
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=3, scale_layers=2)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    ref = np.asarray(llama.generate(params, cfg, prompt, 8, temperature=0.0,
+                                    n_layers=2))
+    got = np.asarray(llama.generate_fused(params, cfg, prompt, 8, n_layers=2))
+    np.testing.assert_array_equal(got, ref)

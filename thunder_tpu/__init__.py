@@ -147,7 +147,10 @@ def _is_arraylike(x) -> bool:
 
 def _leaf_key(leaf):
     if _is_arraylike(leaf):
-        return ("T", tuple(leaf.shape), str(leaf.dtype))
+        # the dtype OBJECT (numpy dtype / jax dtype) hashes and compares by
+        # value; str(dtype) cost ~2x the whole key build on the decode hot
+        # path (measured r4: 0.5 ms/call probing a 35-leaf tree)
+        return ("T", tuple(leaf.shape), leaf.dtype)
     if isinstance(leaf, bool):
         return ("B", leaf)
     if isinstance(leaf, Number):

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 from thunder_tpu import ops
 from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.baseutils import check
 
 
 @dataclass(frozen=True)
@@ -337,11 +338,12 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
             for _ in range(n)]
 
 
-def forward_step(params, tokens, cache, pos, cfg: LlamaConfig):
+def forward_step(params, tokens, cache, pos, cfg: LlamaConfig, last_idx=None):
     """Incremental forward: ``tokens`` (B, T) occupy positions
     [pos, pos+T) (prefill T>1 or decode T=1); ``pos`` is a traced scalar so
     one compiled program serves every decode step. Returns
-    (logits (B, T, vocab), updated cache)."""
+    (logits (B, T, vocab), updated cache) — or (B, 1, vocab) when
+    ``last_idx`` selects a single output row before the lm_head."""
     B, T = tokens.shape
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.kv_heads
@@ -380,6 +382,14 @@ def forward_step(params, tokens, cache, pos, cfg: LlamaConfig):
         h = _mlp(h, layer, cfg)
 
     h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+    if last_idx is not None:
+        # logits only at row ``last_idx`` (traced 0-d index): the lm_head
+        # projection runs on (B, 1, dim), not (B, T, dim) — for a Tp=512
+        # prefill that is 512x less lm_head work and no (B, T, vocab)
+        # materialization (measured r4: the whole prefill gap to the
+        # hand-written baseline was this projection)
+        zero = ops.full((), 0, dtype=dtypes.int32)
+        h = prims.dynamic_slice(h, (zero, last_idx, zero), (B, 1, cfg.dim))
     return ops.linear(h, params["lm_head"]), new_cache
 
 
@@ -398,20 +408,19 @@ def _get_step_fns(cfg: LlamaConfig, n_layers):
         return _step_fns[key]
 
     def _step(p, t, c, pos):
-        logits, nc = forward_step(p, t, c, pos, cfg)
         T = t.shape[1]
-        return ops.squeeze(ops.narrow(logits, 1, T - 1, 1), 1), nc
+        last = ops.full((), T - 1, dtype=dtypes.int32)
+        logits, nc = forward_step(p, t, c, pos, cfg, last_idx=last)
+        return ops.squeeze(logits, 1), nc
 
     def _prefill(p, t, c, pos, true_len):
-        # padded prefill: extract logits at the LAST REAL position
-        # (true_len - 1), a traced 0-d index — the compiled program is
-        # shared by every prompt length in the bucket
-        logits, nc = forward_step(p, t, c, pos, cfg)
-        B, _, V = logits.shape
-        zero = ops.full((), 0, dtype=dtypes.int32)
-        last = prims.dynamic_slice(
-            logits, (zero, ops.sub(true_len, 1), zero), (B, 1, V))
-        return ops.squeeze(last, 1), nc
+        # padded prefill: logits at the LAST REAL position (true_len - 1),
+        # a traced 0-d index sliced BEFORE the lm_head — the compiled
+        # program is shared by every prompt length in the bucket and never
+        # materializes (B, T, vocab)
+        logits, nc = forward_step(p, t, c, pos, cfg,
+                                  last_idx=ops.sub(true_len, 1))
+        return ops.squeeze(logits, 1), nc
 
     fns = (tt.jit(_step, donate_argnums=(2,)), tt.jit(_prefill, donate_argnums=(2,)))
     _step_fns[key] = fns
@@ -496,3 +505,56 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
         tok = pick(last, sub)
         out.append(tok)
     return jnp.stack(out, axis=1)  # (B, max_new_tokens)
+
+
+def generate_fused(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
+                   max_len: int | None = None, n_layers: int | None = None):
+    """Greedy decoding with the WHOLE decode loop compiled as one XLA
+    program: ``lax.scan`` over the framework-traced step, so generation is
+    a single device dispatch — no per-token host round-trips (on a
+    tunneled/remote chip the per-step ``generate`` loop pays one RTT per
+    token; this pays one total). The scanned body IS the compiled entry's
+    computation (same trace, same executors) — not a reimplementation.
+    Reference analog: litgpt's generate is a per-step Python loop; this is
+    the TPU-native replacement."""
+    import jax
+    import jax.numpy as jnp
+
+    from thunder_tpu.core.pytree import tree_flatten
+
+    prompt = jnp.asarray(prompt)
+    B, Tp = prompt.shape
+    max_len = max_len or (Tp + max_new_tokens)
+    check(Tp + max_new_tokens <= max_len <= cfg.max_seq_len,
+          lambda: f"prompt ({Tp}) + max_new_tokens ({max_new_tokens}) "
+                  f"exceeds max_len={max_len} / cfg.max_seq_len={cfg.max_seq_len}")
+    cache = init_kv_cache(cfg, B, max_len, n_layers=n_layers)
+    step_fn, _ = _get_step_fns(cfg, n_layers)
+
+    last, cache = step_fn(params, prompt, cache, jnp.int32(0))
+    tok = jnp.argmax(last, -1).astype(jnp.int32)[:, None]
+    if max_new_tokens == 1:
+        return tok
+
+    # the compiled decode entry for (B, 1) tokens; its computation_fn is the
+    # pure-jax callable the scan body invokes
+    entry = step_fn.compile(params, tok, cache, jnp.int32(Tp))
+    comp = entry.computation_fn
+    t_idx = entry.tensor_indices
+
+    def body(carry, _):
+        tok, cache, pos = carry
+        flat, _ = tree_flatten(((params, tok, cache, pos), {}))
+        lastl, nc = comp(*[flat[i] for i in t_idx])
+        ntok = jnp.argmax(lastl, -1).astype(jnp.int32)[:, None]
+        return (ntok, nc, pos + 1), ntok[:, 0]
+
+    @jax.jit
+    def decode_all(tok, cache):
+        (_, _, _), toks = jax.lax.scan(
+            body, (tok, cache, jnp.int32(Tp)), None,
+            length=max_new_tokens - 1)
+        return jnp.swapaxes(toks, 0, 1)  # (B, n-1)
+
+    rest = decode_all(tok, cache)
+    return jnp.concatenate([tok, rest], axis=1)
