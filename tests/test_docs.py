@@ -1,0 +1,21 @@
+"""The onboarding tutorial's code blocks run verbatim, top to bottom
+(VERDICT r4 #9: a runnable zero-to-thunder_tpu path, reference parity with
+the reference's notebooks/zero_to_thunder.ipynb — but executed in CI)."""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "zero_to_thunder_tpu.md")
+
+
+def test_tutorial_blocks_execute():
+    with open(DOC) as f:
+        text = f.read()
+    blocks = re.findall(r"```python\n(.*?)```", text, re.DOTALL)
+    assert len(blocks) >= 8, "tutorial lost its code blocks"
+    ns: dict = {}
+    src = "\n\n".join(blocks)
+    exec(compile(src, DOC, "exec"), ns)  # noqa: S102 - the doc IS the test
+    # the tutorial's own asserts ran; spot-check its final state
+    assert ns["rep"]["total_in_bytes"] > 0

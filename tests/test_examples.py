@@ -12,7 +12,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _run(script, *extra):
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # hermetic: PYTHONPATH is the repo ONLY — an inherited sitecustomize dir
+    # (e.g. a TPU-plugin shim) must not override JAX_PLATFORMS in the child
+    env["PYTHONPATH"] = REPO
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     out = subprocess.run(

@@ -619,8 +619,14 @@ def test_setitem_edge_semantics():
     xf = torch.randn(3, 4)
     np.testing.assert_allclose(_np(ttorch.jit(f)(xf)), f(xf).numpy(), atol=1e-5)
 
-    with _pytest.raises(NotImplementedError, match="boolean-mask"):
-        thunder_tpu.jit(lambda a, m: tops.setitem(a, m, 0.0))(
+    # boolean-mask scalar assignment is supported (r5: lowered to ONE select);
+    # a per-position tensor value would have a data-dependent (nnz,) shape
+    # and stays a loud NotImplementedError
+    got = thunder_tpu.jit(lambda a, m: tops.setitem(a, m, 7.0))(
+        np.arange(4, dtype=np.float32), np.array([True, False, True, False]))
+    np.testing.assert_allclose(_np(got), [7.0, 1.0, 7.0, 3.0])
+    with _pytest.raises(NotImplementedError, match="scalar value"):
+        thunder_tpu.jit(lambda a, m: tops.setitem(a, m, np.ones(2, np.float32)))(
             np.zeros((4,), np.float32), np.array([True, False, True, False]))
 
 

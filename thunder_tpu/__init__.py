@@ -192,7 +192,13 @@ class ThunderTPUFunction:
         # 357-375). It joins the cache key, so a call with aliased views
         # never hits an entry compiled for distinct tensors (and vice versa:
         # distinct tensors never re-trace an aliased specialization).
-        self._extra_cache_key = None
+        # THREAD-LOCAL: each calling thread carries its own value, so
+        # concurrent calls to one jitted fn never serialize or clobber each
+        # other's specialization (advisor r4: the old shared field forced
+        # callers to hold a lock across the whole execution).
+        import threading as _threading
+
+        self._call_tls = _threading.local()
         self.compile_options = dict(compile_options)
         self._compile_ctx = None  # last CompileContext (option usage report)
         self.__name__ = f"thunder_tpu.jit({self.fn_name})"
@@ -502,6 +508,14 @@ class ThunderTPUFunction:
                 if entry.arg_of_flat.get(fi) in donate_args)
         entry.run_fn = jax.jit(entry.computation_fn, donate_argnums=donate)
         entry.jit_obj = entry.run_fn
+
+    @property
+    def _extra_cache_key(self):
+        return getattr(self._call_tls, "extra_cache_key", None)
+
+    @_extra_cache_key.setter
+    def _extra_cache_key(self, value):
+        self._call_tls.extra_cache_key = value
 
     # -- introspection ------------------------------------------------------
     @property

@@ -51,6 +51,7 @@ def time_fn(fn, *args, steps: int = 5, trials: int = 3) -> float:
 
 def run_breakdown(*, cfg, n_layers, params, tokens, targets,
                   model_loss, t_full: float, steps: int) -> dict:
+    import jax
     import numpy as np
 
     import thunder_tpu as tt
@@ -58,6 +59,12 @@ def run_breakdown(*, cfg, n_layers, params, tokens, targets,
     from thunder_tpu.ops import nn as ops_nn
 
     B, T = tokens.shape
+    # inputs for the ISOLATED sub-programs live on device up front: at the
+    # bench shape q/k/v and the (B·T, dim) hidden are ~256 MB each — feeding
+    # them as host numpy would re-ship them through the (tunneled) PCIe/grpc
+    # path every call and the transfer, not the kernel, would be measured
+    # (r4's toy-scale run hid this; the r5 chip run surfaced 36 s/call)
+    params = jax.device_put(params)
 
     # fwd only
     jfwd = tt.jit(lambda p: model_loss(p, tokens, targets, cfg))
@@ -71,10 +78,10 @@ def run_breakdown(*, cfg, n_layers, params, tokens, targets,
     # attention alone at the bench shape (per layer), fwd+bwd
     hd = cfg.head_dim
     rng = np.random.RandomState(0)
-    q = (rng.randn(B, cfg.n_heads, T, hd).astype(np.float32) * 0.1) \
-        .astype(cfg.dtype.jax)
-    k = np.array(q)
-    v = np.array(q)
+    q = jax.device_put((rng.randn(B, cfg.n_heads, T, hd).astype(np.float32) * 0.1)
+                       .astype(cfg.dtype.jax))
+    k = q  # read-only inputs (no donation): one device buffer serves all three
+    v = q
 
     def att_loss(qkv):
         qq, kk, vv = qkv
@@ -84,9 +91,10 @@ def run_breakdown(*, cfg, n_layers, params, tokens, targets,
     t_att1 = time_fn(jatt, (q, k, v), steps=steps)
 
     # lm_head matmul + CE at the bench shape, fwd+bwd
-    h = (rng.randn(B * T, cfg.dim).astype(np.float32) * 0.1).astype(cfg.dtype.jax)
-    w = np.asarray(params["lm_head"])
-    tg = targets.reshape(-1)
+    h = jax.device_put((rng.randn(B * T, cfg.dim).astype(np.float32) * 0.1)
+                       .astype(cfg.dtype.jax))
+    w = params["lm_head"]
+    tg = jax.device_put(targets.reshape(-1))
 
     def ce_loss(args):
         hh, ww = args

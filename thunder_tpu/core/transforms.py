@@ -983,27 +983,47 @@ def _index_put_vjp(a, indices, values, accumulate):
         from thunder_tpu import ops
         from thunder_tpu.core import dtypes as _dt
 
-        check(len(indices) == 1,
-              "index_put VJP supports a single index tensor (multi-tensor "
-              "advanced indexing grads are not implemented)",
-              NotImplementedError)
-        idx = indices[0]
-        n = int(idx.shape[0])
-        g_sel = prims.take(g, idx, 0)
+        # General k-tensor advanced indexing over the k LEADING dims (jax
+        # ``a.at[tuple].set`` semantics): linearize the jointly-broadcast
+        # indices over the leading dims' row-major strides, then the grad
+        # gather/zero-scatter reduce to the 1-D case on the flattened view.
+        k = len(indices)
+        lead = tuple(int(s) for s in a.shape[:k])
+        tail = tuple(int(s) for s in a.shape[k:])
+        L = 1
+        for s in lead:
+            L *= s
+        bshape = ()
+        for t in indices:
+            bshape = ops.compute_broadcast_shape(
+                bshape, tuple(getattr(t, "shape", ())))
+        N = 1
+        for s in bshape:
+            N *= s
+        linear = ops.linearize_indices(indices, list(lead), bshape)
+        if isinstance(linear, TensorProxy):
+            lin_flat = ops.reshape(linear, (N,))
+        else:  # all-int indices
+            lin_flat = ops.full((N,), int(linear), dtype=_dt.int32,
+                                device=a.device)
+        g_flat = ops.reshape(g, (L,) + tail) if k > 1 else g
+        g_sel = prims.take(g_flat, lin_flat, 0)
         if accumulate:
             g_a = g
         else:
             # replace semantics: with duplicate indices only the winning
             # write affects the output — replay the scatter with writer ids
             # and zero the grads of overwritten rows
-            ids = prims.iota(n, dtype=_dt.int32, device=a.device)
+            ids = prims.iota(N, dtype=_dt.int32, device=a.device)
             writer = prims.index_put(
-                ops.full((int(a.shape[0]),), -1, dtype=_dt.int32, device=a.device),
-                indices, ids, False)
-            win = ops.eq(prims.take(writer, idx, 0), ids)
-            g_sel = ops.where(ops.reshape(win, (n,) + (1,) * (g_sel.ndim - 1)),
+                ops.full((L,), -1, dtype=_dt.int32, device=a.device),
+                (lin_flat,), ids, False)
+            win = ops.eq(prims.take(writer, lin_flat, 0), ids)
+            g_sel = ops.where(ops.reshape(win, (N,) + (1,) * (g_sel.ndim - 1)),
                               g_sel, ops.zeros_like(g_sel))
-            g_a = prims.index_put(g, indices, ops.zeros_like(g_sel), False)
+            g_a = prims.index_put(g_flat, (lin_flat,), ops.zeros_like(g_sel), False)
+            g_a = ops.reshape(g_a, tuple(int(s) for s in a.shape)) if k > 1 else g_a
+        g_sel = ops.reshape(g_sel, bshape + tail)
         if not isinstance(values, TensorProxy):
             return _pairs((a, g_a))
         # values may have broadcast against the indexed slice: sum-to-shape

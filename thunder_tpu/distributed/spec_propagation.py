@@ -104,6 +104,32 @@ def canonicalize(spec: SpecInfo, shape) -> SpecInfo:
     return SpecInfo(dims, spec.partial, spec.varying) if any_change else spec
 
 
+def strip_trivial_axes(spec: SpecInfo, trivial: frozenset) -> SpecInfo:
+    """Remove size-1 mesh axes from a spec. A one-device axis cannot make a
+    value genuinely sharded (the single shard IS the value), partial (a sum
+    over one term is already reduced), or device-varying (there is only one
+    device to vary across) — so degenerate meshes (fsdp over 1 chip) must
+    behave exactly like the unsharded program. Reference anchor: the
+    reference's wrappers run unchanged at world size 1
+    (/root/reference/thunder/distributed/__init__.py:192-366)."""
+    if not trivial:
+        return spec
+
+    def strip_entry(e):
+        if e is None:
+            return None
+        if isinstance(e, Strided):
+            rest = e.axes - trivial
+            return Strided(rest) if rest else None
+        if isinstance(e, tuple):
+            rest = tuple(a for a in e if a not in trivial)
+            return rest[0] if len(rest) == 1 else (rest or None)
+        return None if e in trivial else e
+
+    return SpecInfo(tuple(strip_entry(d) for d in spec.dims),
+                    spec.partial - trivial, spec.varying - trivial)
+
+
 class SpecPropagationError(RuntimeError):
     def __init__(self, msg, kind: str = "layout"):
         super().__init__(msg)
@@ -243,11 +269,12 @@ def _tensor_args_specs(bsym, env):
 
 
 def _bind_out(env, bsym, spec):
+    trivial = env.get("__trivial_axes__", frozenset())
     for o in bsym.flat_proxy_outs():
         s = SpecInfo(spec.dims[: len(o.shape)] if len(spec.dims) >= len(o.shape)
                      else tuple(spec.dims) + (None,) * (len(o.shape) - len(spec.dims)),
                      spec.partial, spec.varying)
-        env[Variable(o)] = canonicalize(s, o.shape)
+        env[Variable(o)] = canonicalize(strip_trivial_axes(s, trivial), o.shape)
 
 
 def _reshape_spec(in_shape, out_shape, spec: SpecInfo, opname: str) -> SpecInfo:
@@ -298,14 +325,17 @@ def _reshape_spec(in_shape, out_shape, spec: SpecInfo, opname: str) -> SpecInfo:
     return SpecInfo(dims, spec.partial, spec.varying)
 
 
-def propagate_specs(trc, input_specs: dict) -> dict:
+def propagate_specs(trc, input_specs: dict, axis_sizes: dict | None = None) -> dict:
     """Walk ``trc`` and return {Variable: SpecInfo} for every traced value.
 
     ``input_specs`` maps Variable(input proxy) → SpecInfo (or PartitionSpec).
+    ``axis_sizes`` maps mesh axis name → size; size-1 axes are stripped from
+    every spec (degenerate meshes must propagate like unsharded programs).
     """
     from thunder_tpu.distributed.prims import DistPrimIDs
 
-    env: dict = {}
+    trivial = frozenset(ax for ax, n in (axis_sizes or {}).items() if int(n) == 1)
+    env: dict = {"__trivial_axes__": trivial}
     for p in trc.args:
         v = Variable(p)
         s = input_specs.get(v)
@@ -313,7 +343,7 @@ def propagate_specs(trc, input_specs: dict) -> dict:
             s = replicated(len(p.shape))
         elif not isinstance(s, SpecInfo):
             s = from_partition_spec(s, len(p.shape))
-        env[v] = canonicalize(s, p.shape)
+        env[v] = canonicalize(strip_trivial_axes(s, trivial), p.shape)
 
     cur = {"bsym": None}
     fuzzy: set = set()   # axes whose exact tracking was lost (degrades,
@@ -681,7 +711,7 @@ def _add_axis(entry, axis, name):
     return (entry, axis)
 
 
-def out_partition_specs(trc, input_specs: dict, fallback=None):
+def out_partition_specs(trc, input_specs: dict, fallback=None, axis_sizes: dict | None = None):
     """PartitionSpec pytree for ``trc.output`` via propagation. Raises
     SpecPropagationError when an output is a partial sum or device-varying
     (an unreduced value must not silently leave the shard_map) — unless
@@ -690,7 +720,7 @@ def out_partition_specs(trc, input_specs: dict, fallback=None):
     tile-structured internals defeat exact per-dim tracking)."""
     from jax.sharding import PartitionSpec
 
-    env = propagate_specs(trc, input_specs)
+    env = propagate_specs(trc, input_specs, axis_sizes=axis_sizes)
     from thunder_tpu.core.pytree import tree_map
 
     def to_pspec(leaf):

@@ -487,7 +487,8 @@ class DistributedFunction(ThunderTPUFunction):
                     out_fallback_by_id[id(leaf)] = cands[0]
         out_specs = out_partition_specs(
             exec_trc, input_specs,
-            fallback=lambda leaf: out_fallback_by_id.get(id(leaf)))
+            fallback=lambda leaf: out_fallback_by_id.get(id(leaf)),
+            axis_sizes=dict(zip(self.mesh_spec.axis_names, self.mesh_spec.axis_sizes)))
 
         sm = _shard_map()
         try:

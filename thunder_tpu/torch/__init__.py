@@ -1766,7 +1766,9 @@ class ThunderModule:
         self._torch_fp = None
         import threading as _threading
 
-        self._alias_lock = _threading.Lock()
+        # per-call alias context is THREAD-LOCAL (advisor r4: a lock held
+        # across the jfn call serialized all concurrent module calls)
+        self._call_tls = _threading.local()
         # seq_buckets on a module: pad the USER args/kwargs before dispatch
         # (never the parameters) — an HF-style attention_mask padded with
         # zeros gives exact masking for free. Padding happens in __call__
@@ -1798,7 +1800,7 @@ class ThunderModule:
                     params.get(canon, buffers.get(canon))
             out, mutated = trace_torch_module(
                 self._torch_module, params, buffers, args, kwargs,
-                arg_overlap=getattr(self, "_user_overlap", frozenset()))
+                arg_overlap=getattr(self._call_tls, "user_overlap", frozenset()))
         finally:
             self._torch_module.train(prev)
         return out, mutated
@@ -1846,23 +1848,23 @@ class ThunderModule:
                 self._torch_fp = fp
         # alias scan on the USER args (params/buffers are jax state — no
         # torch view structure): the byte-overlap set keys the cache and
-        # arms the trace_torch_module audit via _user_overlap; serialized
-        # so concurrent calls can't disarm each other's audit
+        # arms the trace_torch_module audit via _user_overlap. Both are
+        # thread-local, so concurrent calls neither serialize nor disarm
+        # each other's audit.
         _, overlap = _alias_pattern(flat)
-        with self._alias_lock:
-            self._jfn._extra_cache_key = \
-                ("alias", tuple(sorted(overlap))) if overlap else None
-            self._user_overlap = overlap
-            try:
-                args, kwargs = _args_to_jax(args, kwargs)
-                p = dict(self._params)
-                p.update(self._overrides_parameters)
-                b = dict(self._buffers)
-                b.update(self._overrides_buffers)
-                out, mutated = self._jfn(p, b, self._training, args, kwargs)
-            finally:
-                self._jfn._extra_cache_key = None
-                self._user_overlap = frozenset()
+        self._jfn._extra_cache_key = \
+            ("alias", tuple(sorted(overlap))) if overlap else None
+        self._call_tls.user_overlap = overlap
+        try:
+            args, kwargs = _args_to_jax(args, kwargs)
+            p = dict(self._params)
+            p.update(self._overrides_parameters)
+            b = dict(self._buffers)
+            b.update(self._overrides_buffers)
+            out, mutated = self._jfn(p, b, self._training, args, kwargs)
+        finally:
+            self._jfn._extra_cache_key = None
+            self._call_tls.user_overlap = frozenset()
         for k, v in mutated.items():
             target = self._overrides_buffers if k in self._overrides_buffers else self._buffers
             target[k] = v
@@ -2121,11 +2123,15 @@ def jit(module_or_fn, **jit_kwargs):
             wargs = _wrap(args)
             wkw = _wrap(kwargs)
             out = _wrap(fn(*wargs, **wkw))
-            _audit_aliased_mutation(wargs, wkw,
-                                    getattr(traced, "_overlap_indices", None))
+            _audit_aliased_mutation(
+                wargs, wkw,
+                getattr(traced._call_tls, "overlap_indices", None))
         return _unwrap_out_tree(out)
 
     traced.__name__ = getattr(fn, "__name__", "fn")
+    import threading as _threading
+
+    traced._call_tls = _threading.local()
     use_bridge = jit_kwargs.pop("torch_autograd", True)
     jfn = _jit(traced, **jit_kwargs)
     if jit_kwargs.get("seq_buckets") is not None:
@@ -2148,12 +2154,9 @@ class _ConvertingWrapper:
     reference's ``thunder.jit(fn)`` function-training UX)."""
 
     def __init__(self, jfn, torch_fn=None):
-        import threading
-
         self._jfn = jfn
         self._torch_fn = torch_fn
         self._autograd_cache: dict = {}
-        self._alias_lock = threading.Lock()
 
     def __call__(self, *args, **kwargs):
         if getattr(self._jfn, "seq_buckets", None) is not None:
@@ -2187,25 +2190,25 @@ class _ConvertingWrapper:
         # view call must never hit an entry whose trace-time mutation audit
         # ran with different overlap indices — non-overlapping storage
         # sharing compiles identically, so it does NOT key) and arms that
-        # audit in `traced`. The set→call→reset window is serialized so a
-        # concurrent call can't disarm this one's audit mid-flight.
+        # audit in `traced`. Both slots are THREAD-LOCAL, so concurrent
+        # calls neither serialize nor disarm each other's audit mid-flight.
         _, overlap = _alias_pattern(flat)
         fn_shim = getattr(self._jfn, "fn", None)
-        with self._alias_lock:
-            self._jfn._extra_cache_key = \
-                ("alias", tuple(sorted(overlap))) if overlap else None
-            if fn_shim is not None:
-                fn_shim._overlap_indices = overlap
-            try:
-                args, kwargs = _args_to_jax(args, kwargs)
-                return self._jfn(*args, **kwargs)
-            finally:
-                # per-call context must not leak to direct self._jfn uses
-                # (the tooling path / raw jax-array calls, where aliasing
-                # cannot occur): reset to the unspecialized default
-                self._jfn._extra_cache_key = None
-                if fn_shim is not None:
-                    fn_shim._overlap_indices = frozenset()
+        shim_tls = getattr(fn_shim, "_call_tls", None)
+        self._jfn._extra_cache_key = \
+            ("alias", tuple(sorted(overlap))) if overlap else None
+        if shim_tls is not None:
+            shim_tls.overlap_indices = overlap
+        try:
+            args, kwargs = _args_to_jax(args, kwargs)
+            return self._jfn(*args, **kwargs)
+        finally:
+            # per-call context must not leak to direct self._jfn uses
+            # (the tooling path / raw jax-array calls, where aliasing
+            # cannot occur): reset to the unspecialized default
+            self._jfn._extra_cache_key = None
+            if shim_tls is not None:
+                shim_tls.overlap_indices = frozenset()
 
     def __getattr__(self, name):
         return getattr(self._jfn, name)
