@@ -184,14 +184,25 @@ def wait_for_checkpoints(path: str | None = None) -> None:
                 if owned:
                     del _inflight[k]
             if owned:  # exactly one joiner closes (and surfaces a failure)
+                if failure is not None:
+                    # mark BEFORE closing so racing joiners can tell a real
+                    # failure from a post-close artifact
+                    ckptr._join_failed = True
+                ckptr._closed_by_joiner = True
                 close = getattr(ckptr, "close", None)
                 if close is not None:
                     close()
                 if failure is not None:
                     raise failure
-            # non-owning joiner: a racing owner already joined+closed — any
-            # error here is a post-close artifact of an already-committed
-            # write, not a save failure (advisor r4: double-join race)
+            elif failure is not None:
+                # non-owning joiner with an error in hand: swallow ONLY a
+                # post-close artifact of a write the owner saw commit; a
+                # genuine save failure must reach every joiner (code-review
+                # r5: the owner may win the delete race while both threads
+                # hold the same orbax exception)
+                if not getattr(ckptr, "_closed_by_joiner", False) \
+                        or getattr(ckptr, "_join_failed", False):
+                    raise failure
 
 
 def load_checkpoint(path: str, template: Any | None = None) -> Any:

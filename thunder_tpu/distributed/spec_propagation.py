@@ -331,6 +331,11 @@ def propagate_specs(trc, input_specs: dict, axis_sizes: dict | None = None) -> d
     ``input_specs`` maps Variable(input proxy) → SpecInfo (or PartitionSpec).
     ``axis_sizes`` maps mesh axis name → size; size-1 axes are stripped from
     every spec (degenerate meshes must propagate like unsharded programs).
+
+    The returned env additionally carries two PRIVATE string-keyed entries —
+    ``"__fuzzy_axes__"`` (axes whose exact tracking was lost) and
+    ``"__trivial_axes__"`` (size-1 axes) — consumed by the output-boundary
+    checks; consumers iterating the mapping must skip non-Variable keys.
     """
     from thunder_tpu.distributed.prims import DistPrimIDs
 

@@ -608,7 +608,15 @@ def interpolate_nearest(a, scale_factor: int):
 
 
 @opsymbol(id="nn.fused_linear_cross_entropy")
-def fused_linear_cross_entropy(h, w, target, *, chunk: int = 8192,
+def _default_ce_chunk(V: int) -> int:
+    """Fewer, larger matmuls pipeline better on the MXU (measured r5:
+    113.8 -> 99.7 ms fwd+bwd at N=16k, V=32k); big vocabs keep the smaller
+    chunk so live f32 logits stay ~0.5 GB at bench N. Forward and VJP must
+    agree (the VJP recomputes per chunk against the forward's lse)."""
+    return 16384 if V <= 65536 else 8192
+
+
+def fused_linear_cross_entropy(h, w, target, *, chunk: int | None = None,
                                ignore_index: int = -100):
     """Mean softmax-cross-entropy of ``h @ w.T`` computed one vocab chunk at
     a time — the (N, V) logits are NEVER materialized (live memory is
@@ -623,16 +631,22 @@ def fused_linear_cross_entropy(h, w, target, *, chunk: int = 8192,
     """
     N, D = h.shape
     V = w.shape[0]
+    if chunk is None:
+        chunk = _default_ce_chunk(V)
     tgt = ops.convert_element_type(target, dtypes.int32)
-    hf = ops.convert_element_type(h, dtypes.float32)
 
     m = ops.full((N,), float("-inf"), dtype=dtypes.float32)
     s = ops.full((N,), 0.0, dtype=dtypes.float32)
     picked = ops.full((N,), 0.0, dtype=dtypes.float32)
     for c0 in range(0, V, chunk):
         cw = min(chunk, V - c0)
-        wc = ops.convert_element_type(ops.narrow(w, 0, c0, cw), dtypes.float32)
-        lg = prims.dot_general(hf, wc, contract_dims=((1,), (1,)))  # (N, cw) f32
+        wc = ops.narrow(w, 0, c0, cw)
+        # operands stay in the MODEL dtype (bf16 in training — full MXU
+        # rate; f32 operands would halve v5e matmul throughput, measured
+        # r5 breakdown: the CE region sat at ~58% MFU), accumulation is
+        # f32 via preferred_element_type — the standard large-vocab recipe
+        lg = prims.dot_general(h, wc, contract_dims=((1,), (1,)),
+                               preferred_element_type=dtypes.float32)
         mc = ops.amax(lg, -1)
         m_new = ops.maximum(m, mc)
         alpha = ops.exp(ops.sub(m, m_new))
@@ -654,11 +668,13 @@ def fused_linear_cross_entropy(h, w, target, *, chunk: int = 8192,
 
 
 @register_vjp("nn.fused_linear_cross_entropy")
-def _flce_vjp(h, w, target, *, chunk: int = 8192, ignore_index: int = -100):
+def _flce_vjp(h, w, target, *, chunk: int | None = None, ignore_index: int = -100):
     loss, lse = fused_linear_cross_entropy(h, w, target, chunk=chunk,
                                            ignore_index=ignore_index)
     N, D = h.shape
     V = w.shape[0]
+    if chunk is None:
+        chunk = _default_ce_chunk(V)  # MUST mirror the forward (shared lse)
 
     def pullback(g):
         gl, glse = (g[0], g[1]) if isinstance(g, (tuple, list)) else (g, None)
@@ -684,14 +700,21 @@ def _flce_vjp(h, w, target, *, chunk: int = 8192, ignore_index: int = -100):
         dw_chunks = []
         for c0 in range(0, V, chunk):
             cw = min(chunk, V - c0)
-            wc = ops.convert_element_type(ops.narrow(w, 0, c0, cw), dtypes.float32)
-            lg = prims.dot_general(hf, wc, contract_dims=((1,), (1,)))
+            wc = ops.narrow(w, 0, c0, cw)
+            lg = prims.dot_general(h, wc, contract_dims=((1,), (1,)),
+                                   preferred_element_type=dtypes.float32)
             p = ops.exp(ops.sub(lg, ops.unsqueeze(lse, 1)))         # (N, cw) softmax
             ps = ops.mul(p, ops.unsqueeze(coef, 1))
+            # d(logits) cast to the model dtype before the grad matmuls
+            # (bf16 operands, f32 accumulation — same recipe as forward;
+            # the end results are cast to h/w dtype anyway)
+            psc = ops.convert_element_type(ps, w.dtype)
             # softmax part: dh += ps @ wc; dw_c = ps^T @ h_scaled? No —
             # dw_c = ps^T @ h (h unscaled: ps already carries the row scale)
-            dh = ops.add(dh, prims.dot_general(ps, wc, contract_dims=((1,), (0,))))
-            dw_c = prims.dot_general(ps, hf, contract_dims=((0,), (0,)))  # (cw, D)
+            dh = ops.add(dh, prims.dot_general(psc, wc, contract_dims=((1,), (0,)),
+                                               preferred_element_type=dtypes.float32))
+            dw_c = prims.dot_general(psc, h, contract_dims=((0,), (0,)),
+                                     preferred_element_type=dtypes.float32)  # (cw, D)
             # one-hot part: rows whose target lives in this chunk
             idx = ops.sub(tgt, c0)
             valid = ops.logical_and(ops.ge(idx, 0), ops.lt(idx, cw))
