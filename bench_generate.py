@@ -47,8 +47,13 @@ def main():
     max_len = t_prompt + n_decode
 
     rng = np.random.RandomState(0)
-    prompt = rng.randint(0, cfg.vocab_size, (batch, t_prompt)).astype(np.int32)
-    params = llama.init_params(cfg, seed=0, scale_layers=n_layers)
+    prompt = jax.device_put(rng.randint(0, cfg.vocab_size,
+                                        (batch, t_prompt)).astype(np.int32))
+    # params MUST live on device up front: feeding host numpy would re-ship
+    # ~1.3 GB through the (tunneled) transfer path on every step and the
+    # transfer, not the model, would be measured (same lesson as
+    # benchmarks/breakdown.py, r5)
+    params = jax.device_put(llama.init_params(cfg, seed=0, scale_layers=n_layers))
 
     def sync(x):
         leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "shape")]
@@ -87,18 +92,25 @@ def main():
 
     # fused loop: the whole decode as ONE lax.scan program (one dispatch
     # per generation — the TPU-native serving shape; generate_fused docstring)
-    llama.generate_fused(params, cfg, prompt, n_decode + 1,
-                         max_len=max_len + 1, n_layers=n_layers)  # compile
-    best_f = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        toks = llama.generate_fused(params, cfg, prompt, n_decode + 1,
-                                    max_len=max_len + 1, n_layers=n_layers)
-        np.asarray(toks)
-        best_f = min(best_f, time.perf_counter() - t0)
-    dec_fused = max(best_f - pre_ours, 1e-9) / n_decode
-    print(f"thunder_tpu fused-loop: decode {batch/dec_fused:.0f} tok/s "
-          f"(whole generation = one dispatch)", file=sys.stderr)
+    dec_fused = None
+    try:
+        llama.generate_fused(params, cfg, prompt, n_decode + 1,
+                             max_len=max_len + 1, n_layers=n_layers)  # compile
+        best_f = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            toks = llama.generate_fused(params, cfg, prompt, n_decode + 1,
+                                        max_len=max_len + 1, n_layers=n_layers)
+            np.asarray(toks)
+            best_f = min(best_f, time.perf_counter() - t0)
+        dec_fused = max(best_f - pre_ours, 1e-9) / n_decode
+        print(f"thunder_tpu fused-loop: decode {batch/dec_fused:.0f} tok/s "
+              f"(whole generation = one dispatch)", file=sys.stderr)
+    except Exception as e:  # the large scan program can exceed a tunneled
+        # compile service's limits (measured r5: broken pipe mid-compile);
+        # the per-step metrics above are the primary committed numbers
+        print(f"fused-loop decode skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
     # ---- hand-written jax.jit decode loop (independent impl) ---------------
     hd, n_rep = cfg.head_dim, cfg.n_heads // cfg.kv_heads
@@ -179,11 +191,12 @@ def main():
                   f"decode tokens/s",
         "value": round(batch / dec_ours, 1), "unit": "tokens/s",
         "vs_baseline": round(dec_ref / dec_ours, 4)}))
-    print(json.dumps({
-        "metric": f"{model.replace('-bench','')}-geometry({n_layers}L,b{batch}) "
-                  f"decode tokens/s (fused loop)",
-        "value": round(batch / dec_fused, 1), "unit": "tokens/s",
-        "vs_baseline": round(dec_ref / dec_fused, 4)}))
+    if dec_fused is not None:
+        print(json.dumps({
+            "metric": f"{model.replace('-bench','')}-geometry({n_layers}L,b{batch}) "
+                      f"decode tokens/s (fused loop)",
+            "value": round(batch / dec_fused, 1), "unit": "tokens/s",
+            "vs_baseline": round(dec_ref / dec_fused, 4)}))
 
 
 if __name__ == "__main__":

@@ -1319,6 +1319,86 @@ def _sweep_error_gen(opinfo, badtype: bool, shape: bool, dim_oob: bool):
     return gen
 
 
+# -- batch 8 (round 5): full-registry error coverage (VERDICT r4 #7) --------
+# Ops guarded with _tensor_like (or an equivalent named type check) this
+# round: badtype -> TypeError "expected".
+_BADTYPE_OPS += [
+    "movedim", "cumsum", "softmax", "log_softmax", "median", "glu",
+    "broadcast_to", "ravel", "unflatten", "tile", "tensor_split", "select",
+    "diagonal", "diag", "diag_vec", "hstack", "vstack", "dstack", "mv",
+    "inner", "tensordot", "nll_loss", "max_pool1d", "max_pool2d",
+    "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "adaptive_avg_pool2d", "instance_norm", "pixel_shuffle",
+    "interpolate_nearest", "atleast_1d", "atleast_2d", "atleast_3d",
+    "flatten", "full_like", "ones_like", "zeros_like", "permute",
+    "positive", "split", "chunk", "einsum_matmul", "scatter_add",
+    "polygamma", "cumprod", "scatter", "index_copy", "index_add", "unfold",
+    "min_with_indices", "max_with_indices", "conv1d", "conv2d", "conv3d",
+    "convolution", "layer_norm", "sdpa", "nan_to_num", "group_norm",
+    "batch_norm_eval", "batch_norm_train", "kthvalue_values",
+    "take_along_axis",
+]
+
+# ops whose dim is a POSITIONAL argument (index in sample args): 99 raises
+# the canonicalize IndexError
+_DIM_POS_OPS = {
+    "movedim": 1, "cumsum": 1, "softmax": 1, "log_softmax": 1, "median": 1,
+    "glu": 1, "unflatten": 1, "select": 1, "cumprod": 1, "scatter": 1,
+    "index_copy": 1, "index_add": 1, "unfold": 1, "min_with_indices": 1,
+    "max_with_indices": 1, "scatter_add": 1, "take_along_axis": 2,
+    "tensor_split": 2,
+}
+
+
+def _dim_pos_error_gen(opinfo, pos: int, inner=None):
+    def gen(rng):
+        out = list(inner(rng)) if inner is not None else []
+        s = opinfo.sample_generator(np.random.RandomState(5))[0]
+        if pos < len(s.args):
+            args = list(s.args)
+            args[pos] = 99
+            out.append(ErrorSample(tuple(args), IndexError, "out of range",
+                                   dict(s.kwargs)))
+        return out
+
+    return gen
+
+
+# contract-specific generators (probed r5: each pinned to the named check)
+def _mk(args_fn, exc, match, kwargs=None):
+    return lambda rng: [ErrorSample(args_fn(rng), exc, match, dict(kwargs or {}))]
+
+
+set_error_inputs("arange", _mk(lambda rng: (5,), RuntimeError, "nonzero",
+                               {"step": 0}))
+set_error_inputs("full_factory", _mk(lambda rng: ((-3, 4), 2.5),
+                                     RuntimeError, "nonnegative"))
+set_error_inputs("ones", _mk(lambda rng: (-2, 3), RuntimeError, "nonnegative"))
+set_error_inputs("zeros", _mk(lambda rng: (-2, 3), RuntimeError, "nonnegative"))
+set_error_inputs("to", _mk(lambda rng: (_t(rng, 3, 4), "notadtype"),
+                           TypeError, "not understood"))
+set_error_inputs("index_put", _mk(
+    lambda rng: (_t(rng, 5, 4), ("bad",), _t(rng, 2, 4)),
+    TypeError, "string indexing"))
+set_error_inputs("group_norm", _mk(lambda rng: (_t(rng, 2, 6, 4, 4), 5),
+                                   RuntimeError, "divisible"))
+set_error_inputs("batch_norm_eval", _mk(
+    lambda rng: (_t(rng, 4, 3, 5), _t(rng, 2), _t(rng, 3), _t(rng, 3), _t(rng, 3)),
+    RuntimeError, "running_mean"))
+set_error_inputs("kthvalue_values", _mk(lambda rng: (_t(rng, 4, 7), 99),
+                                        RuntimeError, "out of range", {"dim": 1}))
+set_error_inputs("tril_mask", _mk(lambda rng: (-4, 4),
+                                  RuntimeError, "nonnegative"))
+set_error_inputs("getitem_slice", _mk(lambda rng: ("not_a_tensor",), TypeError, ""))
+set_error_inputs("getitem_int", _mk(lambda rng: ("not_a_tensor",), TypeError, ""))
+set_error_inputs("getitem_none", _mk(lambda rng: ("not_a_tensor",), TypeError, ""))
+set_error_inputs("interpolate_nearest", _mk(
+    lambda rng: (_t(rng, 2, 3, 4, 4), 0), RuntimeError, "scale_factor"))
+set_error_inputs("pixel_shuffle", _mk(
+    lambda rng: (_t(rng, 2, 8, 4, 4), 99), RuntimeError, "divisible"))
+set_error_inputs("adaptive_avg_pool2d", _mk(
+    lambda rng: (_t(rng, 2, 3, 8, 8), 99), RuntimeError, "divisible"))
+
 for _o in opinfos:
     if _o.error_input_generator is not None:
         continue
@@ -1327,3 +1407,70 @@ for _o in opinfos:
     _do = _o.name in _DIM_OOB_OPS
     if _bt or _sh or _do:
         _o.error_input_generator = _sweep_error_gen(_o, _bt, _sh, _do)
+
+for _name, _pos in _DIM_POS_OPS.items():
+    for _o in opinfos:
+        if _o.name == _name:
+            _o.error_input_generator = _dim_pos_error_gen(
+                _o, _pos, inner=_o.error_input_generator)
+            break
+
+
+# -- batch 9 (round 5): advanced-indexing tail (VERDICT r4 #7) ---------------
+# mixed tensor+slice getitem, non-adjacent tensors (numpy front rule),
+# int+tensor joint broadcast, stepped/boolean/mixed setitem — reference
+# parity: thunder/clang/__init__.py:381 advanced indexing.
+register(OpInfo("getitem_adv_mixed",
+                lambda a, i: a[:, i, 1:6:2],
+                lambda a, i: jnp.asarray(a)[:, jnp.asarray(i), 1:6:2],
+                lambda rng: [SampleInput((_t(rng, 2, 5, 7),
+                                          np.array([0, 2, 4], np.int32)))]))
+register(OpInfo("getitem_adv_nonadjacent",
+                lambda a, i, j: a[i, :, j],
+                lambda a, i, j: jnp.asarray(a)[jnp.asarray(i), :, jnp.asarray(j)],
+                lambda rng: [SampleInput((_t(rng, 4, 5, 7),
+                                          np.array([0, 3, 2], np.int32),
+                                          np.array([1, 6, 5], np.int32)))]))
+register(OpInfo("getitem_adv_int_tensor",
+                lambda a, i, j: a[1, i, j],
+                lambda a, i, j: jnp.asarray(a)[1, jnp.asarray(i), jnp.asarray(j)],
+                lambda rng: [SampleInput((_t(rng, 4, 5, 7),
+                                          np.array([0, 3, 2], np.int32),
+                                          np.array([1, 6, 5], np.int32)))]))
+register(OpInfo("setitem_stepped",
+                lambda a, v: ops.setitem(a, (slice(1, 7, 2),), v),
+                lambda a, v: jnp.asarray(a).at[1:7:2].set(v),
+                lambda rng: [SampleInput((_t(rng, 8, 6), _t(rng, 3, 6)))]))
+register(OpInfo("setitem_bool_mask",
+                lambda a: ops.setitem(a, (ops.gt(a, 0.5),), 0.5),
+                lambda a: jnp.where(jnp.asarray(a) > 0.5, 0.5, jnp.asarray(a)),
+                lambda rng: [SampleInput((_t(rng, 6, 5),))]))
+register(OpInfo("setitem_adv_mixed",
+                lambda a, i, v: ops.setitem(a, (i, slice(2, 5)), v),
+                lambda a, i, v: jnp.asarray(a).at[jnp.asarray(i), 2:5].set(v),
+                lambda rng: [SampleInput((_t(rng, 6, 8), np.array([0, 2, 5], np.int32),
+                                          _t(rng, 3, 3)))]))
+register(OpInfo("setitem_adv_nonadjacent",
+                lambda a, i, j, v: ops.setitem(a, (i, slice(None), j), v),
+                lambda a, i, j, v: jnp.asarray(a).at[jnp.asarray(i), :, jnp.asarray(j)].set(v),
+                lambda rng: [SampleInput((_t(rng, 4, 5, 7), np.array([0, 3, 2], np.int32),
+                                          np.array([1, 6, 5], np.int32), _t(rng, 3, 5)))]))
+
+set_error_inputs("getitem_adv_mixed", lambda rng: [
+    ErrorSample(("not_a_tensor", np.array([0], np.int32)), TypeError, "")])
+set_error_inputs("setitem_stepped", lambda rng: [
+    ErrorSample((_t(rng, 8, 6), "not_a_tensor"), TypeError, "")])
+set_error_inputs("setitem_bool_mask", lambda rng: [
+    ErrorSample(("not_a_tensor",), TypeError, "")])
+set_error_inputs("setitem_adv_mixed", lambda rng: [
+    ErrorSample(("not_a_tensor", np.array([0], np.int32), _t(rng, 1, 3)),
+                TypeError, "expected")])
+set_error_inputs("setitem_adv_nonadjacent", lambda rng: [
+    ErrorSample(("not_a_tensor", np.array([0], np.int32),
+                 np.array([0], np.int32), _t(rng, 1, 5)), TypeError, "expected")])
+set_error_inputs("getitem_adv_nonadjacent", lambda rng: [
+    ErrorSample(("not_a_tensor", np.array([0], np.int32),
+                 np.array([0], np.int32)), TypeError, "")])
+set_error_inputs("getitem_adv_int_tensor", lambda rng: [
+    ErrorSample(("not_a_tensor", np.array([0], np.int32),
+                 np.array([0], np.int32)), TypeError, "")])

@@ -172,11 +172,15 @@ def _default_dtype_for(v) -> dtypes.dtype:
 
 
 def full(shape, fill_value, *, dtype=None, device=None):
+    shape = tuple(shape)
+    check(all(int(s) >= 0 for s in shape),
+          lambda: f"full: shape must be nonnegative, got {shape}")
     dtype = dtypes.to_dtype(dtype) if dtype is not None else _default_dtype_for(pyval(fill_value))
     return prims.full(tuple(shape), fill_value, dtype, device)
 
 
 def full_like(a, fill_value, *, dtype=None, device=None):
+    _tensor_like(a, "full_like")
     return full(a.shape, fill_value, dtype=dtype or a.dtype, device=device or a.device)
 
 
@@ -193,10 +197,12 @@ def ones(*shape, dtype=None, device=None):
 
 
 def zeros_like(a, *, dtype=None, device=None):
+    _tensor_like(a, "zeros_like")
     return full_like(a, 0, dtype=dtype, device=device)
 
 
 def ones_like(a, *, dtype=None, device=None):
+    _tensor_like(a, "ones_like")
     return full_like(a, 1, dtype=dtype, device=device)
 
 
@@ -204,6 +210,7 @@ def arange(start, end=None, step=1, *, dtype=None, device=None):
     if end is None:
         start, end = 0, start
     start, end, step = pyval(start), pyval(end), pyval(step)
+    check(step != 0, "arange: step must be nonzero")
     if dtype is None:
         dtype = dtypes.int32 if all(isinstance(x, int) for x in (start, end, step)) else dtypes.float32
     length = max(0, math.ceil((end - start) / step))
@@ -221,8 +228,18 @@ def _tensor_like(a, opname: str):
     return a
 
 
+def _tensor_seq(tensors, opname: str):
+    """Sequence-of-tensors contract shared by the stack family."""
+    check(hasattr(tensors, "__iter__") and not isinstance(tensors, str),
+          lambda: f"{opname}: expected a sequence of tensors, got "
+                  f"{type(tensors).__name__}", exc_type=TypeError)
+    return [_tensor_like(t, opname) for t in tensors]
+
+
 def tril_mask(rows: int, cols: int, diagonal: int = 0, *, device=None):
     """Boolean lower-triangular mask built from iota compares (fusible)."""
+    check(int(rows) >= 0 and int(cols) >= 0,
+          lambda: f"tril_mask: rows/cols must be nonnegative, got {rows}, {cols}")
     r = prims.iota(rows, dtype=dtypes.int32, device=device)
     c = prims.iota(cols, dtype=dtypes.int32, device=device)
     r2 = expand_to(reshape(r, (rows, 1)), (rows, cols))
@@ -357,6 +374,10 @@ ndtri = _make_unary("ndtri", prims.ndtri, float_promote=True)
 def polygamma(n, a):
     """torch.polygamma(n, input): n-th derivative of digamma. Reference:
     thunder/torch/__init__.py polygamma."""
+    check(isinstance(n, (int, NumberProxy)),
+          lambda: f"polygamma: order n must be an int, got {type(n).__name__}",
+          exc_type=TypeError)
+    _tensor_like(a, "polygamma")
     a = _float_promote(a)
     return prims.polygamma(a, int(pyval(n)))
 
@@ -500,6 +521,7 @@ def reshape(a, shape):
 
 
 def flatten(a, start_dim=0, end_dim=-1):
+    _tensor_like(a, "flatten")
     start_dim = canonicalize_dim(a.ndim, start_dim)
     end_dim = canonicalize_dim(a.ndim, end_dim)
     merged = math.prod(a.shape[start_dim:end_dim + 1])
@@ -507,6 +529,7 @@ def flatten(a, start_dim=0, end_dim=-1):
 
 
 def transpose(a, permutation):
+    _tensor_like(a, "transpose")
     perm = canonicalize_dims(a.ndim, tuple(permutation))
     if perm == tuple(range(a.ndim)):
         return a
@@ -517,6 +540,7 @@ permute = transpose
 
 
 def movedim(a, src, dst):
+    _tensor_like(a, "movedim")
     src = canonicalize_dims(a.ndim, src)
     dst = canonicalize_dims(a.ndim, dst)
     perm = [i for i in range(a.ndim) if i not in src]
@@ -576,6 +600,7 @@ def stack(tensors, dim=0):
 
 
 def split(a, split_size, dim=0):
+    _tensor_like(a, "split")
     dim = canonicalize_dim(a.ndim, dim)
     n = a.shape[dim]
     if isinstance(split_size, int):
@@ -595,6 +620,7 @@ def split(a, split_size, dim=0):
 
 
 def chunk(a, chunks, dim=0):
+    _tensor_like(a, "chunk")
     dim_ = canonicalize_dim(a.ndim, dim)
     n = a.shape[dim_]
     size = -(-n // chunks)
@@ -635,16 +661,20 @@ def gather(a, dim, index):
     return prims.take_along_axis(a, index, canonicalize_dim(a.ndim, dim))
 
 
-take_along_axis = lambda a, idx, dim: prims.take_along_axis(a, idx, canonicalize_dim(a.ndim, dim))
+def take_along_axis(a, idx, dim):
+    _tensor_like(a, "take_along_axis")
+    return prims.take_along_axis(a, idx, canonicalize_dim(a.ndim, dim))
 
 
 def scatter_add(a, dim, index, src):
+    _tensor_like(a, "scatter_add")
     return prims.scatter_add(a, index, src, canonicalize_dim(a.ndim, dim))
 
 
 def scatter(a, dim, index, src):
     """torch.scatter (replace semantics). ``src`` may be a python scalar
     (torch's ``value`` variant)."""
+    _tensor_like(a, "scatter")
     d = canonicalize_dim(a.ndim, dim)
     if isinstance(src, Number):
         src = full(index.shape, src, dtype=a.dtype, device=a.device)
@@ -655,6 +685,7 @@ def index_copy(a, dim, index, src):
     """torch.index_copy: rank-1 ``index`` selects slices of ``a`` along
     ``dim`` to be replaced by ``src``'s slices. Lowered to the SCATTER prim
     with the index broadcast along the slice dims."""
+    _tensor_like(a, "index_copy")
     d = canonicalize_dim(a.ndim, dim)
     shape = [1] * a.ndim
     shape[d] = int(index.shape[0])
@@ -665,6 +696,7 @@ def index_copy(a, dim, index, src):
 def index_add(a, dim, index, src, *, alpha=1):
     """torch.index_add: row-wise scatter-add (1 index per slice) — lowers to
     the INDEX_ADD prim, XLA's update_window_dims fast path."""
+    _tensor_like(a, "index_add")
     d = canonicalize_dim(a.ndim, dim)
     if not (isinstance(alpha, Number) and pyval(alpha) == 1):
         src = mul(src, alpha)
@@ -681,6 +713,7 @@ def setitem(a, idx, val):
     masks (``a[mask] = scalar``). Reference parity:
     /root/reference/thunder/clang/__init__.py:381 (advanced indexing) —
     lowered TPU-first (one XLA scatter / gather+select, no index loops)."""
+    _tensor_like(a, "setitem")
     if not isinstance(idx, tuple):
         idx = (idx,)
     idx = tuple(_lift_arrays(i) if _is_arraylike_idx(i) else i for i in idx)
@@ -988,6 +1021,7 @@ def getitem(a, idx):
     """Basic indexing (ints, slices, None, Ellipsis) + integer-tensor
     advanced indexing (single tensor anywhere; multiple contiguous tensors
     broadcast jointly). Decomposes to slice/squeeze/take prims."""
+    _tensor_like(a, "getitem")
     if not isinstance(idx, tuple):
         idx = (idx,)
     # concrete index arrays (np/jax constants) become trace constants
@@ -1220,6 +1254,7 @@ def argmin(a, dim=None, keepdim=False):
 
 @opsymbol
 def max_with_indices(a, dim, keepdim=False):
+    _tensor_like(a, "max_with_indices")
     d = canonicalize_dim(a.ndim, dim)
     values = amax(a, dim, keepdim=keepdim)
     indices = argmax(a, dim, keepdim=keepdim)
@@ -1228,6 +1263,7 @@ def max_with_indices(a, dim, keepdim=False):
 
 @opsymbol
 def min_with_indices(a, dim, keepdim=False):
+    _tensor_like(a, "min_with_indices")
     d = canonicalize_dim(a.ndim, dim)
     values = amin(a, dim, keepdim=keepdim)
     indices = argmin(a, dim, keepdim=keepdim)
@@ -1245,10 +1281,12 @@ def any_(a, dim=None, keepdim=False):
 
 
 def cumsum(a, dim):
+    _tensor_like(a, "cumsum")
     return prims.cumsum(a, canonicalize_dim(a.ndim, dim))
 
 
 def cumprod(a, dim):
+    _tensor_like(a, "cumprod")
     return prims.cumprod(a, canonicalize_dim(a.ndim, dim))
 
 
@@ -1377,6 +1415,12 @@ def outer(a, b):
 
 
 def einsum(equation, *operands):
+    check(isinstance(equation, str),
+          lambda: f"einsum: first argument must be the equation string, got "
+                  f"{type(equation).__name__}", exc_type=TypeError)
+    check(operands and all(not isinstance(o, str) for o in operands),
+          "einsum: expected tensor operands after the equation",
+          exc_type=TypeError)
     operands = tuple(maybe_autocast(*operands))
     return prims.einsum(equation, *operands)
 
@@ -1388,6 +1432,7 @@ def dot_general(a, b, contract_dims, batch_dims=((), ()), preferred_element_type
 
 @opsymbol
 def conv2d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    _tensor_like(a, "conv2d")
     a, w, bias = maybe_autocast(a, w, bias)
 
     def _pair(x):
@@ -1401,6 +1446,7 @@ def conv2d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
 
 @opsymbol
 def conv1d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    _tensor_like(a, "conv1d")
     s = (stride,) if isinstance(stride, int) else tuple(stride)
     d = (dilation,) if isinstance(dilation, int) else tuple(dilation)
     p = (padding,) if isinstance(padding, int) else tuple(padding)
@@ -1410,6 +1456,7 @@ def conv1d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
 
 @opsymbol
 def conv3d(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    _tensor_like(a, "conv3d")
     a, w, bias = maybe_autocast(a, w, bias)
 
     def _triple(x):
@@ -1425,6 +1472,7 @@ def convolution(a, w, bias=None, stride=1, padding=0, dilation=1, groups=1):
     """Generic N-d convolution over the CONVOLUTION prim (spatial rank
     inferred from the input, torch ``convolution``-style int-or-sequence
     args)."""
+    _tensor_like(a, "convolution")
     nd = a.ndim - 2
     check(nd >= 1, "convolution: input must have at least one spatial dim")
 
@@ -1479,6 +1527,7 @@ def leaky_relu(a, negative_slope=0.01):
 
 @opsymbol
 def softmax(a, dim=-1, dtype=None):
+    _tensor_like(a, "softmax")
     d = canonicalize_dim(a.ndim, dim)
     if dtype is not None:
         a = convert_element_type(a, dtype)
@@ -1490,6 +1539,7 @@ def softmax(a, dim=-1, dtype=None):
 
 @opsymbol
 def log_softmax(a, dim=-1, dtype=None):
+    _tensor_like(a, "log_softmax")
     d = canonicalize_dim(a.ndim, dim)
     if dtype is not None:
         a = convert_element_type(a, dtype)
@@ -1512,6 +1562,7 @@ def frac(a):
 def nan_to_num(a, nan=0.0, posinf=None, neginf=None):
     if isinstance(a, Number):
         return a
+    _tensor_like(a, "nan_to_num")
     fi = dtypes.finfo(a.dtype if a.dtype.is_inexact else dtypes.float32)
     posinf = float(fi.max) if posinf is None else posinf
     neginf = float(fi.min) if neginf is None else neginf
@@ -1583,6 +1634,7 @@ def square(a):
 
 
 def positive(a):
+    _tensor_like(a, "positive")
     return a
 
 
@@ -1646,6 +1698,7 @@ def norm(a, p=2, dim=None, keepdim=False):
 
 def median(a, dim=-1, keepdim=False):
     """Median along ``dim`` (torch convention: lower of two middles)."""
+    _tensor_like(a, "median")
     d = canonicalize_dim(a.ndim, dim)
     n = a.shape[d]
     vals = sort(a, dim=d)[0]
@@ -1714,6 +1767,7 @@ def log_sigmoid(a):
 
 
 def glu(a, dim=-1):
+    _tensor_like(a, "glu")
     d = canonicalize_dim(a.ndim, dim)
     check(a.shape[d] % 2 == 0, "glu: dimension size must be even")
     x, g = chunk(a, 2, dim=d)
@@ -1739,14 +1793,17 @@ def softmin(a, dim=-1, dtype=None):
 # -- additional shape ops ----------------------------------------------------
 
 def broadcast_to(a, shape):
+    _tensor_like(a, "broadcast_to")
     return expand(a, shape)
 
 
 def ravel(a):
+    _tensor_like(a, "ravel")
     return reshape(a, (-1,))
 
 
 def unflatten(a, dim, sizes):
+    _tensor_like(a, "unflatten")
     d = canonicalize_dim(a.ndim, dim)
     new_shape = tuple(a.shape[:d]) + tuple(sizes) + tuple(a.shape[d + 1:])
     return reshape(a, new_shape)
@@ -1754,6 +1811,7 @@ def unflatten(a, dim, sizes):
 
 def tile(a, dims):
     """numpy/torch tile: repeat the tensor dims[i] times along each axis."""
+    _tensor_like(a, "tile")
     dims = tuple(dims) if isinstance(dims, (tuple, list)) else (dims,)
     out = a
     lead = len(dims) - a.ndim
@@ -1773,6 +1831,7 @@ def tile(a, dims):
 
 
 def tensor_split(a, indices_or_sections, dim=0):
+    _tensor_like(a, "tensor_split")
     d = canonicalize_dim(a.ndim, dim)
     n = a.shape[d]
     if isinstance(indices_or_sections, int):
@@ -1794,35 +1853,42 @@ def tensor_split(a, indices_or_sections, dim=0):
 
 
 def atleast_1d(a):
+    _tensor_like(a, "atleast_1d")
     return a if a.ndim >= 1 else unsqueeze(a, 0)
 
 
 def atleast_2d(a):
+    _tensor_like(a, "atleast_2d")
     a = atleast_1d(a)
     return a if a.ndim >= 2 else unsqueeze(a, 0)
 
 
 def atleast_3d(a):
+    _tensor_like(a, "atleast_3d")
     a = atleast_2d(a)
     return a if a.ndim >= 3 else unsqueeze(a, -1)
 
 
 def hstack(tensors):
+    tensors = _tensor_seq(tensors, "hstack")
     tensors = [atleast_1d(t) for t in tensors]
     return cat(tensors, dim=0 if tensors[0].ndim == 1 else 1)
 
 
 def vstack(tensors):
+    tensors = _tensor_seq(tensors, "vstack")
     return cat([atleast_2d(t) for t in tensors], dim=0)
 
 
 def dstack(tensors):
+    tensors = _tensor_seq(tensors, "dstack")
     return cat([atleast_3d(t) for t in tensors], dim=2)
 
 
 def unfold(a, dim, size, step):
     """Tensor.unfold: sliding windows of ``size`` every ``step`` along
     ``dim``; the window axis becomes the LAST dim (torch semantics)."""
+    _tensor_like(a, "unfold")
     d = canonicalize_dim(a.ndim, dim)
     length = int(a.shape[d])
     size, step = int(pyval(size)), int(pyval(step))
@@ -1852,6 +1918,7 @@ def narrow(a, dim, start, length):
 
 
 def select(a, dim, index):
+    _tensor_like(a, "select")
     d = canonicalize_dim(a.ndim, dim)
     idx = [slice(None)] * a.ndim
     idx[d] = int(index)
@@ -1867,6 +1934,7 @@ def _eye_mask(n, m, dtype):
 def diagonal(a, offset=0, dim1=0, dim2=1):
     """Differentiable diagonal via an eye mask + sum over dim2 (static
     shapes; XLA folds the mask multiply into the reduce)."""
+    _tensor_like(a, "diagonal")
     d1 = canonicalize_dim(a.ndim, dim1)
     d2 = canonicalize_dim(a.ndim, dim2)
     n, m = a.shape[d1], a.shape[d2]
@@ -1891,6 +1959,7 @@ def diagonal(a, offset=0, dim1=0, dim2=1):
 
 
 def diag(a, diagonal_offset=0):
+    _tensor_like(a, "diag")
     if a.ndim == 1:
         n = a.shape[0] + builtins_abs(diagonal_offset)
         rows = unsqueeze(arange(0, n), 1)
@@ -1911,6 +1980,7 @@ def builtins_abs(x):
 # -- additional linalg -------------------------------------------------------
 
 def mv(a, v):
+    _tensor_like(a, "mv")
     return matmul(a, v)
 
 
@@ -1919,12 +1989,14 @@ def vdot(a, b):
 
 
 def inner(a, b):
+    _tensor_like(a, "inner")
     if a.ndim == 1 and b.ndim == 1:
         return vdot(a, b)
     return prims.dot_general(a, b, contract_dims=((a.ndim - 1,), (b.ndim - 1,)))
 
 
 def tensordot(a, b, dims=2):
+    _tensor_like(a, "tensordot")
     if isinstance(dims, int):
         ca = tuple(range(a.ndim - dims, a.ndim))
         cb = tuple(range(dims))
@@ -2024,6 +2096,7 @@ def bincount(a, weights=None, minlength=0):
 def kthvalue(a, k, dim=-1, keepdim=False):
     """k-th smallest value (and its index) along ``dim``; differentiable in
     ``a`` via gather-by-index (the sort itself carries no gradient)."""
+    _tensor_like(a, "kthvalue")
     d = canonicalize_dim(a.ndim, dim)
     k = int(pyval(k))
     check(1 <= k <= a.shape[d],

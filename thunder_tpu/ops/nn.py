@@ -16,7 +16,7 @@ from thunder_tpu.core import dtypes, prims
 from thunder_tpu.core.baseutils import check, canonicalize_dim
 from thunder_tpu.core.proxies import TensorProxy, pyval
 import thunder_tpu.ops as ops
-from thunder_tpu.ops import opsymbol
+from thunder_tpu.ops import _tensor_like, opsymbol
 
 
 @opsymbol(id="nn.embedding")
@@ -40,6 +40,7 @@ def one_hot(ids, num_classes: int):
 
 @opsymbol(id="nn.layer_norm")
 def layer_norm(a, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
+    _tensor_like(a, "layer_norm")
     nd = len(normalized_shape)
     check(tuple(a.shape[-nd:]) == tuple(normalized_shape),
           lambda: f"layer_norm: normalized_shape {normalized_shape} != trailing dims of {a.shape}")
@@ -237,6 +238,7 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0
     the Pallas flash-attention executor claims this symbol on TPU. Under an
     active context-parallel scope, lowers to ring attention over the mesh
     axis (sequence sharded; K/V rotate via ppermute)."""
+    _tensor_like(q, "scaled_dot_product_attention")
     from thunder_tpu.distributed import current_cp
 
     cp = current_cp()
@@ -458,6 +460,7 @@ def kl_div(input, target, reduction: str = "mean", log_target: bool = False):
 @opsymbol(id="nn.nll_loss")
 def nll_loss(logp, target, weight=None, ignore_index: int = -100,
              reduction: str = "mean"):
+    _tensor_like(logp, "nll_loss")
     check(weight is None, "nll_loss: class weights unsupported")
     tgt = ops.reshape(target, (-1,)) if target.ndim > 1 else target
     lp = ops.reshape(logp, (-1, logp.shape[-1])) if logp.ndim > 2 else logp
@@ -503,6 +506,7 @@ def _pool_windows(a, kernel_size, stride, padding, pad_value, nd=2):
 
 @opsymbol(id="nn.max_pool2d")
 def max_pool2d(a, kernel_size, stride=None, padding=0):
+    _tensor_like(a, "max_pool2d")
     windows, _ = _pool_windows(a, kernel_size, stride, padding, float("-inf"))
     out = windows[0]
     for w in windows[1:]:
@@ -512,6 +516,7 @@ def max_pool2d(a, kernel_size, stride=None, padding=0):
 
 @opsymbol(id="nn.avg_pool2d")
 def avg_pool2d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
+    _tensor_like(a, "avg_pool2d")
     check(count_include_pad or padding == 0, "avg_pool2d: count_include_pad=False unsupported")
     windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0)
     out = windows[0]
@@ -522,6 +527,7 @@ def avg_pool2d(a, kernel_size, stride=None, padding=0, count_include_pad: bool =
 
 @opsymbol(id="nn.max_pool1d")
 def max_pool1d(a, kernel_size, stride=None, padding=0):
+    _tensor_like(a, "max_pool1d")
     windows, _ = _pool_windows(a, kernel_size, stride, padding, float("-inf"), nd=1)
     out = windows[0]
     for w in windows[1:]:
@@ -531,6 +537,7 @@ def max_pool1d(a, kernel_size, stride=None, padding=0):
 
 @opsymbol(id="nn.max_pool3d")
 def max_pool3d(a, kernel_size, stride=None, padding=0):
+    _tensor_like(a, "max_pool3d")
     windows, _ = _pool_windows(a, kernel_size, stride, padding, float("-inf"), nd=3)
     out = windows[0]
     for w in windows[1:]:
@@ -540,6 +547,7 @@ def max_pool3d(a, kernel_size, stride=None, padding=0):
 
 @opsymbol(id="nn.avg_pool1d")
 def avg_pool1d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
+    _tensor_like(a, "avg_pool1d")
     check(count_include_pad or padding == 0, "avg_pool1d: count_include_pad=False unsupported")
     windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0, nd=1)
     out = windows[0]
@@ -550,6 +558,7 @@ def avg_pool1d(a, kernel_size, stride=None, padding=0, count_include_pad: bool =
 
 @opsymbol(id="nn.avg_pool3d")
 def avg_pool3d(a, kernel_size, stride=None, padding=0, count_include_pad: bool = True):
+    _tensor_like(a, "avg_pool3d")
     check(count_include_pad or padding == 0, "avg_pool3d: count_include_pad=False unsupported")
     windows, n = _pool_windows(a, kernel_size, stride, padding, 0.0, nd=3)
     out = windows[0]
@@ -560,6 +569,7 @@ def avg_pool3d(a, kernel_size, stride=None, padding=0, count_include_pad: bool =
 
 @opsymbol(id="nn.adaptive_avg_pool2d")
 def adaptive_avg_pool2d(a, output_size):
+    _tensor_like(a, "adaptive_avg_pool2d")
     oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
     H, W = a.shape[-2], a.shape[-1]
     check(H % oh == 0 and W % ow == 0,
@@ -570,6 +580,7 @@ def adaptive_avg_pool2d(a, output_size):
 
 @opsymbol(id="nn.instance_norm")
 def instance_norm(a, weight=None, bias=None, eps: float = 1e-5):
+    _tensor_like(a, "instance_norm")
     dims = tuple(range(2, a.ndim))
     var, mean = ops.var_mean(a, dim=dims, correction=0, keepdim=True)
     out = ops.true_divide(ops.sub(a, mean), ops.sqrt(ops.add(var, eps)))
@@ -583,6 +594,7 @@ def instance_norm(a, weight=None, bias=None, eps: float = 1e-5):
 
 @opsymbol(id="nn.pixel_shuffle")
 def pixel_shuffle(a, upscale_factor: int):
+    _tensor_like(a, "pixel_shuffle")
     r = upscale_factor
     B_dims = tuple(a.shape[:-3])
     C, H, W = a.shape[-3], a.shape[-2], a.shape[-1]
@@ -597,7 +609,9 @@ def pixel_shuffle(a, upscale_factor: int):
 @opsymbol(id="nn.interpolate_nearest")
 def interpolate_nearest(a, scale_factor: int):
     """Nearest-neighbor upsampling by an integer factor over the last two dims."""
+    _tensor_like(a, "interpolate_nearest")
     s = int(scale_factor)
+    check(s >= 1, lambda: f"interpolate_nearest: scale_factor must be >= 1, got {s}")
     out = a
     out = ops.movedim(out, -2, 0)
     out = ops.repeat_interleave_dim0(out, s)
@@ -607,7 +621,6 @@ def interpolate_nearest(a, scale_factor: int):
     return ops.movedim(out, 0, -1)
 
 
-@opsymbol(id="nn.fused_linear_cross_entropy")
 def _default_ce_chunk(V: int) -> int:
     """Fewer, larger matmuls pipeline better on the MXU (measured r5:
     113.8 -> 99.7 ms fwd+bwd at N=16k, V=32k); big vocabs keep the smaller
@@ -616,6 +629,7 @@ def _default_ce_chunk(V: int) -> int:
     return 16384 if V <= 65536 else 8192
 
 
+@opsymbol(id="nn.fused_linear_cross_entropy")
 def fused_linear_cross_entropy(h, w, target, *, chunk: int | None = None,
                                ignore_index: int = -100):
     """Mean softmax-cross-entropy of ``h @ w.T`` computed one vocab chunk at
@@ -738,6 +752,7 @@ def group_norm(a, num_groups: int, weight=None, bias=None, eps: float = 1e-5):
     """GroupNorm over (N, C, *spatial) — reference
     ``thunder/torch/__init__.py`` group_norm; first-class nn id so executors
     can claim a fused kernel for it."""
+    _tensor_like(a, "group_norm")
     n, c = a.shape[0], a.shape[1]
     check(c % num_groups == 0, "group_norm: channels not divisible by groups")
     grouped = ops.reshape(a, (n, num_groups, c // num_groups) + tuple(a.shape[2:]))
@@ -761,6 +776,14 @@ def batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
     provided, else None — running statistics are explicit state (no module
     mutation; the torch dialect's F.batch_norm adapter rebinds buffer
     wrappers from this return)."""
+    _tensor_like(a, "batch_norm")
+    C = int(a.shape[1]) if a.ndim > 1 else int(a.shape[0])
+    for nm, st in (("running_mean", running_mean), ("running_var", running_var),
+                   ("weight", weight), ("bias", bias)):
+        check(st is None or (getattr(st, "ndim", 1) == 1
+                             and int(st.shape[0]) == C),
+              lambda nm=nm, st=st: f"batch_norm: {nm} must be shape ({C},), "
+              f"got {tuple(getattr(st, 'shape', ()))}")
     dims = (0,) + tuple(range(2, a.ndim))
     if training or running_mean is None:
         var, mean = ops.var_mean(a, dim=dims, correction=0, keepdim=False)
