@@ -654,7 +654,17 @@ register(OpInfo("float_power", ops.float_power,
                 lambda a, b: jnp.float_power(a, b).astype(jnp.float32),
                 lambda rng: [SampleInput((_t(rng, 4, lo=0.2, hi=2.0), 2.0))], atol=1e-4))
 register(OpInfo("floor_divide", ops.floor_divide, jnp.floor_divide,
-                lambda rng: [SampleInput((_t(rng, 4, lo=1.0, hi=8.0), _t(rng, 4, lo=1.0, hi=3.0)))],
+                lambda rng: [SampleInput((_t(rng, 4, lo=1.0, hi=8.0), _t(rng, 4, lo=1.0, hi=3.0))),
+                             # int//int must stay integral with floor
+                             # semantics (r5 bug: true-divided to float),
+                             # incl. a python-int divisor and negatives
+                             SampleInput((_i(rng, 6, hi=20), _i(rng, 6, hi=4) + 1)),
+                             SampleInput((np.array([-7, -1, 7, 11], np.int32), 3)),
+                             # exactness past 2^24 (a float32 round-trip
+                             # would corrupt these quotients)
+                             SampleInput((np.array([16777217, 2147480011,
+                                                    -2147480011], np.int32), 1)),
+                             SampleInput((np.array([2147480011], np.int32), 7))],
                 supports_grad=False))
 register(OpInfo("full_like", ops.full_like, jnp.full_like,
                 lambda rng: [SampleInput((_t(rng, 3, 3), 2.5))], supports_grad=False))
@@ -1399,13 +1409,23 @@ set_error_inputs("pixel_shuffle", _mk(
 set_error_inputs("adaptive_avg_pool2d", _mk(
     lambda rng: (_t(rng, 2, 3, 8, 8), 99), RuntimeError, "divisible"))
 
+def _compose_error_gens(first, second):
+    return lambda rng: list(first(rng)) + list(second(rng))
+
+
 for _o in opinfos:
-    if _o.error_input_generator is not None:
-        continue
     _bt = _o.name in _BADTYPE_OPS
     _sh = _o.name in _SHAPE_OPS
     _do = _o.name in _DIM_OOB_OPS
-    if _bt or _sh or _do:
+    if not (_bt or _sh or _do):
+        continue
+    if _o.error_input_generator is not None:
+        # contract-specific generator already present: ADD the sweep's
+        # badtype/shape/dim samples instead of dropping them (code-review
+        # r5: six _BADTYPE_OPS silently lost badtype coverage)
+        _o.error_input_generator = _compose_error_gens(
+            _o.error_input_generator, _sweep_error_gen(_o, _bt, _sh, _do))
+    else:
         _o.error_input_generator = _sweep_error_gen(_o, _bt, _sh, _do)
 
 for _name, _pos in _DIM_POS_OPS.items():

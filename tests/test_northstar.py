@@ -131,3 +131,79 @@ class TestMixtral8x7BEp:
     def test_all_to_all_present(self, mixtral_ep):
         # dropless EP routes tokens with all-to-all over the ep axis
         assert mixtral_ep["overlap"]["all_to_all_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# ungated smoke tier (VERDICT r4 #8): the full evidence pipeline — abstract
+# build, AOT compile, memory/cost/HLO-collective analysis, roofline
+# projection — exercised on a TINY config against the hermetic 8-device CPU
+# mesh every suite run, so a regression in the pipeline itself (not just in
+# libtpu) fails fast.
+# ---------------------------------------------------------------------------
+
+def test_evidence_pipeline_smoke_cpu():
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu.core.devices import MeshSpec
+    from thunder_tpu.distributed import fsdp
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import AdamW
+
+    n_dev = 8
+    cfg = llama.CONFIGS["tiny"]
+    opt = AdamW(lr=1e-4)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_p, new_s = opt.update(params, grads, opt_state)
+        return loss, new_p, new_s
+
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+
+    jstep = fsdp(train_step, MeshSpec.make(fsdp=n_dev), zero=2)
+    entry = jstep.compile(params, opt.init(params), tokens, targets)
+    compiled = entry.jit_obj.lower(*entry.input_avals).compile()
+
+    n = ns.n_params_llama(cfg)
+    m = ns.analyze(compiled, n_dev=n_dev, global_tokens=8 * 16,
+                   analytic_flops=ns.analytic_train_flops(n, 8 * 16, cfg, 16))
+    # memory analysis produced real numbers
+    assert m["live_bytes_per_device"] > 0
+    # the HLO census found the FSDP collectives with denominators
+    hc = m["hlo_collectives"]
+    kinds = set(hc["per_kind"])
+    assert kinds & {"all-gather", "reduce-scatter", "all-reduce"}, kinds
+    assert hc["recv_bytes_per_device_total"] > 0
+    for k, e in hc["per_kind"].items():
+        assert 0 <= e["async_count"] <= e["count"]
+        assert e["recv_bytes_per_dev"] > 0
+    # roofline projection composes with the comm term
+    comm = ns.comm_bytes_per_device(jstep)
+    recv = max(hc["recv_bytes_per_device_total"], ns._recv_bytes(comm, n_dev))
+    proj = ns.project(m, {"total_in_bytes": recv})
+    assert 0 < proj["mfu_projected_serial"] <= proj["mfu_projected_overlapped"] <= 1.0
+
+
+def test_hlo_collectives_parser_pinned():
+    """The census parses sync ops, async start tuples, and applies the ring
+    cost model per kind (bytes are hand-computed for this snippet)."""
+    hlo = """
+  %ar = f32[1024,8]{1,0} all-reduce(f32[1024,8]{1,0} %p0), replica_groups={}
+  %ag = (bf16[128,8]{1,0}, bf16[1024,8]{1,0}) all-gather-start(bf16[128,8]{1,0} %p1), dimensions={0}
+  %rs = f32[128,8]{1,0} reduce-scatter(f32[1024,8]{1,0} %p2), dimensions={0}
+  %cp = bf16[64]{0} collective-permute(bf16[64]{0} %p3), source_target_pairs={{0,1}}
+"""
+    c = ns.hlo_collectives(hlo, n_dev=8)
+    pk = c["per_kind"]
+    assert pk["all-reduce"]["count"] == 1 and pk["all-reduce"]["async_count"] == 0
+    assert pk["all-reduce"]["recv_bytes_per_dev"] == 2 * 1024 * 8 * 4 * 7 // 8
+    assert pk["all-gather"]["count"] == 1 and pk["all-gather"]["async_count"] == 1
+    assert pk["all-gather"]["recv_bytes_per_dev"] == 1024 * 8 * 2 * 7 // 8
+    assert pk["reduce-scatter"]["recv_bytes_per_dev"] == 128 * 8 * 4 * 7
+    assert pk["collective-permute"]["recv_bytes_per_dev"] == 64 * 2
+    assert c["async_fraction"]["all-gather"] == 1.0

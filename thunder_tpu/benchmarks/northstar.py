@@ -86,6 +86,8 @@ def abstract_llama_step(cfg_name: str, *, batch: int, seq: int, n_dev: int,
 
 def abstract_mixtral_ep_step(*, batch: int, seq: int, n_dev: int,
                              remat: bool = True):
+    import dataclasses
+
     import jax
 
     import thunder_tpu as tt
@@ -95,7 +97,14 @@ def abstract_mixtral_ep_step(*, batch: int, seq: int, n_dev: int,
     from thunder_tpu.models import mixtral
     from thunder_tpu.optim import AdamW
 
-    cfg = mixtral.CONFIGS["mixtral-8x7b"]
+    # capacity_factor 1.25 (was the 2.0 default): the r4 verdict flagged the
+    # EP config's flop pad — at cf the per-expert capacity executes
+    # cf x the analytic top-k flops; 1.25 keeps the measured worst-layer
+    # assignment drop at 7.2% on an UNTRAINED router (MIXTRAL_EP.md sweep;
+    # the aux load-balancing loss drives it toward 0 in training) and takes
+    # xla_flops/analytic from 2.07x to ~1.35x at tiny scale (r5 measured)
+    cfg = dataclasses.replace(mixtral.CONFIGS["mixtral-8x7b"],
+                              capacity_factor=1.25)
     # the 8x7B memory recipe: all-bf16 AdamW moments (12.9B params/8 chips
     # leave no room for f32 v; the v-freeze tradeoff is documented in
     # optim.AdamW), per-block remat, chunked-vocab fused loss. Without
@@ -154,6 +163,86 @@ def analytic_train_flops(n_params: int, global_tokens: int, cfg=None,
     return flops
 
 
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+_COLLECTIVE_RE = None
+
+
+def hlo_collectives(hlo: str, n_dev: int) -> dict:
+    """Per-kind collective census from OPTIMIZED HLO text: instruction
+    counts, output bytes, ring-model bytes RECEIVED per device per step,
+    and the async fraction (VERDICT r4 #3: comm accounting must come from
+    what XLA actually emits, with denominators, not substring counts).
+
+    Ring cost model per instruction (bytes received by one device):
+      all-gather      out_bytes * (n-1)/n
+      reduce-scatter  out_bytes * (n-1)      (n-1 partial shards pass by)
+      all-reduce      2 * out_bytes * (n-1)/n (reduce-scatter + all-gather)
+      all-to-all      out_bytes * (n-1)/n
+      collective-permute out_bytes
+    """
+    import re
+
+    global _COLLECTIVE_RE
+    if _COLLECTIVE_RE is None:
+        _COLLECTIVE_RE = re.compile(
+            r"=\s+((?:\()?[a-z0-9]+\[[0-9,]*\][^=]*?)\s"
+            r"(all-reduce-start|all-reduce|all-gather-start|all-gather|"
+            r"reduce-scatter-start|reduce-scatter|all-to-all-start|all-to-all|"
+            r"collective-permute-start|collective-permute)\(")
+    shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+    out: dict = {}
+    for m in _COLLECTIVE_RE.finditer(hlo):
+        shapes, op = shape_re.findall(m.group(1)), m.group(2)
+        if not shapes:
+            continue
+        base = op.replace("-start", "")
+        is_async = op.endswith("-start")
+
+        def _nbytes(shape):
+            dt, dims = shape
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            return elems * _DTYPE_BYTES.get(dt, 4)
+
+        # async starts carry a tuple ((operands), (outputs), aux scalars):
+        # pick the DESTINATION by semantics — all-gather's output is its
+        # largest array, reduce-scatter's its smallest non-scalar, the rest
+        # are shape-preserving
+        sizes = sorted(_nbytes(s) for s in shapes)
+        nonscalar = [b for b in sizes if b > 16] or sizes
+        if base == "all-gather":
+            nbytes = nonscalar[-1]
+        elif base == "reduce-scatter":
+            nbytes = nonscalar[0]
+        else:
+            nbytes = nonscalar[-1]
+        e = out.setdefault(base, {"count": 0, "async_count": 0,
+                                  "out_bytes": 0, "recv_bytes_per_dev": 0})
+        e["count"] += 1
+        if is_async:
+            e["async_count"] += 1
+        e["out_bytes"] += nbytes
+        if base == "all-gather":
+            recv = nbytes * (n_dev - 1) // n_dev
+        elif base == "reduce-scatter":
+            recv = nbytes * (n_dev - 1)
+        elif base == "all-reduce":
+            recv = 2 * nbytes * (n_dev - 1) // n_dev
+        else:
+            recv = nbytes * (n_dev - 1) // n_dev if base == "all-to-all" else nbytes
+        e["recv_bytes_per_dev"] += recv
+    total = sum(e["recv_bytes_per_dev"] for e in out.values())
+    frac = {k: (e["async_count"] / e["count"] if e["count"] else 0.0)
+            for k, e in out.items()}
+    return {"per_kind": out, "recv_bytes_per_device_total": total,
+            "async_fraction": frac}
+
+
 def analyze(compiled, *, n_dev: int, global_tokens: int,
             analytic_flops: float, spec=V5P) -> dict:
     """Memory + cost + roofline-projected MFU from a compiled executable."""
@@ -176,6 +265,10 @@ def analyze(compiled, *, n_dev: int, global_tokens: int,
     hbm_bytes = float(ca.get("bytes accessed", 0.0))
 
     hlo = compiled.as_text()
+    hlo_comm = hlo_collectives(hlo, n_dev)
+    # legacy substring census kept for continuity with r4 artifacts; the
+    # authoritative numbers (instruction counts, bytes, async fractions
+    # WITH denominators) are in hlo_comm (VERDICT r4 #3)
     overlap = {
         "async_all_gather": hlo.count('async_collective_name="all-gather-start'),
         "async_reduce_scatter": hlo.count('async_collective_name="reduce-scatter'),
@@ -201,6 +294,7 @@ def analyze(compiled, *, n_dev: int, global_tokens: int,
         "analytic_flops_per_device": flops_dev,
         "hbm_bytes_accessed": hbm_bytes,
         "overlap": overlap,
+        "hlo_collectives": hlo_comm,
         "t_math_s": t_math,
         "t_hbm_s": t_hbm,
         "step_time_overlapped_s": t_overlapped,
@@ -278,10 +372,17 @@ def run_config(name: str, builder, topo_name: str, n_dev: int,
     m = analyze(compiled, n_dev=n_dev, global_tokens=global_tokens,
                 analytic_flops=analytic_flops)
     comm = comm_bytes_per_device(jstep)
-    recv = _recv_bytes(comm, n_dev)
+    recv_trace = _recv_bytes(comm, n_dev)
+    # t_ici from the OPTIMIZED HLO's own collectives (r4 verdict #3: the
+    # trace-level figure understates when XLA rewrites reduce-scatters into
+    # all-reduces); trace-level kept alongside as the cross-check
+    recv_hlo = m["hlo_collectives"]["recv_bytes_per_device_total"]
+    recv = max(recv_hlo, recv_trace)
     proj = project(m, {"total_in_bytes": recv})
     m.update(proj)
     m["comm"] = comm
+    m["recv_bytes_per_device_trace"] = recv_trace
+    m["recv_bytes_per_device_hlo"] = recv_hlo
     m["recv_bytes_per_device"] = recv
     m["compile_seconds"] = compile_s
     m["n_params"] = n_params

@@ -73,7 +73,7 @@ class PrimIDs(Enum):
     COPYSIGN = auto(); DIV = auto(); EQ = auto(); FMOD = auto(); GE = auto(); GT = auto(); LE = auto()
     LT = auto(); MAXIMUM = auto(); MINIMUM = auto(); MUL = auto(); NE = auto(); POW = auto()
     REMAINDER = auto(); SHIFT_LEFT = auto(); SHIFT_RIGHT = auto(); SUB = auto()
-    ZETA = auto(); NEXTAFTER = auto()
+    ZETA = auto(); NEXTAFTER = auto(); FLOOR_DIV = auto()
     # ternary
     WHERE = auto()
     # reductions
@@ -592,7 +592,22 @@ bitwise_and = _make_ew_binary(PrimIDs.BITWISE_AND, "bitwise_and")
 bitwise_or = _make_ew_binary(PrimIDs.BITWISE_OR, "bitwise_or")
 bitwise_xor = _make_ew_binary(PrimIDs.BITWISE_XOR, "bitwise_xor")
 copysign = _make_ew_binary(PrimIDs.COPYSIGN, "copysign")
-div = _make_ew_binary(PrimIDs.DIV, "div")
+def _div_meta(a, b):
+    # DIV is TRUE division (lowered to jnp.true_divide): integer operands
+    # produce a FLOAT result — the meta must say so or downstream
+    # convert_element_type calls get skipped as no-ops against a dtype the
+    # runtime never produces (r5: floor_divide(int32, int) returned floats
+    # stamped i32)
+    ts = _tensor_args((a, b))
+    check(len(ts) >= 1, "div: at least one operand must be a tensor")
+    shape = _same_shape(*ts)
+    dtype = _result_dtype(a, b)
+    if not dtypes.to_dtype(dtype).is_inexact:
+        dtype = dtypes.float32
+    return TensorProxy(shape=shape, dtype=dtype, device=ts[0].device)
+
+
+div = make_prim(PrimIDs.DIV, "div", _div_meta, tags=(OpTags.ELEMENTWISE_OP,))
 eq = _make_ew_binary(PrimIDs.EQ, "eq", bool_out=True)
 fmod = _make_ew_binary(PrimIDs.FMOD, "fmod")
 ge = _make_ew_binary(PrimIDs.GE, "ge", bool_out=True)
@@ -605,6 +620,9 @@ mul = _make_ew_binary(PrimIDs.MUL, "mul")
 ne = _make_ew_binary(PrimIDs.NE, "ne", bool_out=True)
 pow = _make_ew_binary(PrimIDs.POW, "pow")
 remainder = _make_ew_binary(PrimIDs.REMAINDER, "remainder")
+# exact floor division (jnp.floor_divide): ints stay ints with python floor
+# semantics — the float-round-trip alternative silently corrupts |q| >= 2^24
+floor_div = _make_ew_binary(PrimIDs.FLOOR_DIV, "floor_div")
 shift_left = _make_ew_binary(PrimIDs.SHIFT_LEFT, "shift_left")
 shift_right = _make_ew_binary(PrimIDs.SHIFT_RIGHT, "shift_right")
 sub = _make_ew_binary(PrimIDs.SUB, "sub")

@@ -119,7 +119,7 @@ _NONDIFF = {
     PrimIDs.ARGMAX, PrimIDs.ARGMIN, PrimIDs.ARGSORT, PrimIDs.IOTA, PrimIDs.FULL,
     PrimIDs.RNG_KEY, PrimIDs.RNG_SPLIT, PrimIDs.UNIFORM, PrimIDs.NORMAL,
     PrimIDs.RANDOM_BITS, PrimIDs.ITEM, PrimIDs.SHIFT_LEFT, PrimIDs.SHIFT_RIGHT,
-    PrimIDs.FMOD, PrimIDs.REMAINDER, PrimIDs.COPYSIGN,
+    PrimIDs.FMOD, PrimIDs.REMAINDER, PrimIDs.FLOOR_DIV, PrimIDs.COPYSIGN,
     PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
     PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE, PrimIDs.UNPACK_TRIVIAL,
     PrimIDs.PYTHON_PRINT, PrimIDs.COMMENT, PrimIDs.SINK, PrimIDs.DEVICE_PUT,

@@ -296,7 +296,8 @@ _EW = {
     PrimIDs.FMOD: jnp.fmod, PrimIDs.GE: jnp.greater_equal, PrimIDs.GT: jnp.greater,
     PrimIDs.LE: jnp.less_equal, PrimIDs.LT: jnp.less, PrimIDs.MAXIMUM: jnp.maximum,
     PrimIDs.MINIMUM: jnp.minimum, PrimIDs.MUL: jnp.multiply, PrimIDs.NE: jnp.not_equal,
-    PrimIDs.POW: jnp.power, PrimIDs.REMAINDER: jnp.remainder, PrimIDs.SHIFT_LEFT: jnp.left_shift,
+    PrimIDs.POW: jnp.power, PrimIDs.REMAINDER: jnp.remainder,
+    PrimIDs.FLOOR_DIV: jnp.floor_divide, PrimIDs.SHIFT_LEFT: jnp.left_shift,
     PrimIDs.SHIFT_RIGHT: jnp.right_shift, PrimIDs.SUB: jnp.subtract,
     PrimIDs.ZETA: jax.scipy.special.zeta, PrimIDs.NEXTAFTER: jnp.nextafter,
     PrimIDs.WHERE: jnp.where,

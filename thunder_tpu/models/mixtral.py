@@ -12,6 +12,7 @@ expert-parallel scope the (E, C, d) slot tensor is exchanged with
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 
 from thunder_tpu import ops
@@ -122,22 +123,24 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
     topv, topi = ops.topk(probs, k, -1)  # (S, k)
     topv = ops.true_divide(topv, ops.sum(topv, -1, keepdim=True))
 
-    # GShard capacity-based dispatch: position of each token in its expert's
-    # slot queue via cumsum; tokens beyond capacity C are dropped
+    # Capacity-based dispatch by INDEX, not one-hot einsum (r5, VERDICT r4
+    # #5): the position of each token in its expert's slot queue comes from
+    # the same GShard cumsum, but tokens move via ONE gather into (E, C)
+    # slots and ONE gather back — the old (S, E, C) dispatch/combine
+    # einsums spent 8·S·E·C·D matmul flops (fwd+bwd) moving data the MXU
+    # never needed to touch. Routing (which tokens go where) is identical.
     counts = ops.zeros((E,), dtype=dtypes.float32)
-    dispatch = None  # (S, E, C)
-    combine = None
+    flat_pos = []   # per assignment j: token s -> slot e*C+pos, sentinel E*C
     for j in range(k):
         m = ops.convert_element_type(ops.one_hot(topi[:, j], E), dtypes.float32)  # (S, E)
         pos = ops.add(ops.sub(ops.cumsum(m, 0), m), ops.expand_to(counts, m.shape))
         keep = ops.mul(m, ops.convert_element_type(ops.lt(pos, float(C)), dtypes.float32))
         counts = ops.add(counts, ops.sum(keep, 0))
-        pos_oh = ops.convert_element_type(
-            ops.one_hot(ops.convert_element_type(pos, dtypes.int32), C), dtypes.float32)  # (S, E, C)
-        disp_j = ops.mul(ops.unsqueeze(keep, -1), pos_oh)
-        comb_j = ops.mul(disp_j, ops.expand_to(ops.reshape(topv[:, j], (S, 1, 1)), disp_j.shape))
-        dispatch = disp_j if dispatch is None else ops.add(dispatch, disp_j)
-        combine = comb_j if combine is None else ops.add(combine, comb_j)
+        pos_j = ops.sum(ops.mul(pos, m), -1)                       # (S,) queue slot
+        kept_j = ops.gt(ops.sum(keep, -1), 0.0)                    # (S,) bool
+        e_j = ops.convert_element_type(topi[:, j], dtypes.int32)
+        fp = ops.add(ops.mul(e_j, C), ops.convert_element_type(pos_j, dtypes.int32))
+        flat_pos.append(ops.where(kept_j, fp, ops.full((S,), E * C, dtype=dtypes.int32)))
 
     # load-balancing auxiliary loss (Switch/Mixtral style). Under expert
     # parallelism the batch is sharded, and the loss is NONLINEAR in the
@@ -157,9 +160,46 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
     aux = ops.mul(ops.sum(ops.mul(frac_tokens, frac_probs)), float(E) * cfg.router_aux_coef)
 
     xf = ops.convert_element_type(x, dtypes.float32)
-    expert_in = prims.dot_general(dispatch, xf, contract_dims=((0,), (0,)))  # (E, C, D)
+    # MIXTRAL_FORCE_EINSUM=1: debug/bench knob to run the EP einsum dispatch
+    # single-device (used by the r5 flop A/B in MIXTRAL_EP.md)
+    _force_einsum = os.environ.get("MIXTRAL_FORCE_EINSUM") == "1"
+    if ep is None and not _force_einsum:
+        # scatter token ids into the slot table (slots are unique by
+        # construction — the cumsum assigns each (expert, position) once;
+        # only the sentinel overflow bin sees duplicate writes and is never
+        # read), then ONE row gather builds the expert inputs. Dropped
+        # slots read the zero pad row.
+        slot_tokens = ops.full((E * C + 1,), S, dtype=dtypes.int32)
+        token_ids = ops.arange(S, dtype=dtypes.int32)
+        for fp in flat_pos:
+            slot_tokens = ops.index_put(slot_tokens, (fp,), token_ids)
+        x_padded = ops.cat([xf, ops.zeros((1, D), dtype=dtypes.float32)], 0)
+        expert_in = ops.reshape(
+            prims.take(x_padded, ops.narrow(slot_tokens, 0, 0, E * C), 0), (E, C, D))
+    else:
+        # expert parallelism: the sharding-spec model cannot express a
+        # data-dependent cross-rank permutation (scatter with ep-sharded
+        # indices), so the EP path keeps the one-hot dispatch einsum whose
+        # contraction over the sharded token dim propagates cleanly; the
+        # flop lever there is capacity_factor (MIXTRAL_EP.md sweep)
+        dispatch = None  # (S, E, C)
+        combine = None
+        for j, fp in enumerate(flat_pos):
+            kept = ops.convert_element_type(ops.lt(fp, E * C), dtypes.float32)
+            pos_oh = ops.convert_element_type(ops.one_hot(
+                ops.remainder(fp, C), C), dtypes.float32)           # (S, C)
+            e_oh = ops.convert_element_type(ops.one_hot(
+                ops.convert_element_type(ops.floor_divide(fp, C), dtypes.int32),
+                E), dtypes.float32)                                  # (S, E)
+            disp_j = ops.mul(ops.mul(ops.unsqueeze(e_oh, -1),
+                                     ops.unsqueeze(pos_oh, 1)),
+                             ops.reshape(kept, (S, 1, 1)))
+            comb_j = ops.mul(disp_j, ops.expand_to(
+                ops.reshape(topv[:, j], (S, 1, 1)), disp_j.shape))
+            dispatch = disp_j if dispatch is None else ops.add(dispatch, disp_j)
+            combine = comb_j if combine is None else ops.add(combine, comb_j)
+        expert_in = prims.dot_general(dispatch, xf, contract_dims=((0,), (0,)))  # (E, C, D)
 
-    ep = current_ep()
     if ep is not None:
         axis, n = ep
         # rank-local slots for all experts -> all slots for local experts
@@ -178,7 +218,18 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
         axis, n = ep
         expert_out = dist_prims.wait(dist_prims.all_to_all(expert_out, axis, 1, 0, n))  # (E, C, D)
 
-    out = prims.dot_general(combine, expert_out, contract_dims=(((1, 2)), ((0, 1))))  # (S, D)
+    if ep is None and not _force_einsum:
+        # combine: each token gathers its k slots back, weighted by its gate
+        eo_flat = ops.cat([ops.reshape(expert_out, (E * C, D)),
+                           ops.zeros((1, D), dtype=dtypes.float32)], 0)
+        out = None
+        for j in range(k):
+            contrib = ops.mul(prims.take(eo_flat, flat_pos[j], 0),
+                              ops.unsqueeze(topv[:, j], -1))        # (S, D)
+            out = contrib if out is None else ops.add(out, contrib)
+    else:
+        out = prims.dot_general(combine, expert_out,
+                                contract_dims=(((1, 2)), ((0, 1))))  # (S, D)
     out = ops.convert_element_type(out, x.dtype)
     if return_metrics:
         total_assignments = float(S * k)

@@ -451,9 +451,11 @@ def _floor_div_impl(a, b):
     ts = [t for t in (a, b) if isinstance(t, TensorProxy)]
     if any(t.dtype.is_float for t in ts):
         return prims.floor(prims.div(a, b))
-    # integer floor division: python semantics via remainder
-    q = prims.div(a, b)
-    return q
+    # integer floor division, python semantics, EXACT: the dedicated prim
+    # lowers to jnp.floor_divide (integer arithmetic all the way) — a
+    # float round-trip would silently corrupt quotients past 2^24
+    # (r5 code-review; the original bug true-divided to float outright)
+    return prims.floor_div(a, b)
 
 
 def logical_and(a, b):

@@ -780,7 +780,7 @@ def batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
     C = int(a.shape[1]) if a.ndim > 1 else int(a.shape[0])
     for nm, st in (("running_mean", running_mean), ("running_var", running_var),
                    ("weight", weight), ("bias", bias)):
-        check(st is None or (getattr(st, "ndim", 1) == 1
+        check(st is None or (getattr(st, "ndim", 0) == 1
                              and int(st.shape[0]) == C),
               lambda nm=nm, st=st: f"batch_norm: {nm} must be shape ({C},), "
               f"got {tuple(getattr(st, 'shape', ()))}")
