@@ -174,10 +174,14 @@ def test_evidence_pipeline_smoke_cpu():
                    analytic_flops=ns.analytic_train_flops(n, 8 * 16, cfg, 16))
     # memory analysis produced real numbers
     assert m["live_bytes_per_device"] > 0
-    # the HLO census found the FSDP collectives with denominators
+    # the HLO census found the FSDP collectives with denominators. The
+    # zero-2 grad reduction MUST survive as reduce-scatter on the CPU
+    # path (NORTHSTAR.md: the TPU AOT pipeline rewrites it to all-reduce
+    # — this assert is the negative control proving the framework emits
+    # the cheaper collective and the rewrite is XLA's)
     hc = m["hlo_collectives"]
     kinds = set(hc["per_kind"])
-    assert kinds & {"all-gather", "reduce-scatter", "all-reduce"}, kinds
+    assert "reduce-scatter" in kinds and "all-gather" in kinds, kinds
     assert hc["recv_bytes_per_device_total"] > 0
     for k, e in hc["per_kind"].items():
         assert 0 <= e["async_count"] <= e["count"]

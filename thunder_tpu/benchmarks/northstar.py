@@ -236,8 +236,17 @@ def hlo_collectives(hlo: str, n_dev: int) -> dict:
         else:
             recv = nbytes * (n_dev - 1) // n_dev if base == "all-to-all" else nbytes
         e["recv_bytes_per_dev"] += recv
+    # the TPU backend marks async scheduling two ways: explicit `-start`
+    # instructions (counted above per instruction) and an
+    # `async_collective_name="<op>-start"` backend-config attribute on
+    # wrapped collectives — count the attribute form per kind too, and the
+    # fraction uses whichever mechanism the backend chose
+    for base in list(out):
+        attr = hlo.count(f'async_collective_name="{base}-start')
+        out[base]["async_attr_count"] = attr
+        out[base]["async_count"] = max(out[base]["async_count"], attr)
     total = sum(e["recv_bytes_per_dev"] for e in out.values())
-    frac = {k: (e["async_count"] / e["count"] if e["count"] else 0.0)
+    frac = {k: (min(1.0, e["async_count"] / e["count"]) if e["count"] else 0.0)
             for k, e in out.items()}
     return {"per_kind": out, "recv_bytes_per_device_total": total,
             "async_fraction": frac}
@@ -280,12 +289,19 @@ def analyze(compiled, *, n_dev: int, global_tokens: int,
     }
 
     # roofline projection, per device (comm term added by the caller once
-    # trace-level collective bytes are known — see project())
+    # collective bytes are known — see project()). Step TIME is bounded by
+    # the flops XLA actually EXECUTES (xla_flops — e.g. the MoE capacity
+    # pad); MFU's numerator stays the analytic useful flops (r5: the old
+    # t_math-for-both gave the padded Mixtral config a fictitious 1.0).
     flops_dev = analytic_flops / n_dev
     t_math = flops_dev / spec["peak_bf16_flops"]
+    # executed-flop time exactly as XLA counts it (0.91x analytic for the
+    # causal-halved dense configs, 1.68x for the padded MoE); fall back to
+    # analytic only when the backend reports no flops (CPU smoke)
+    t_exec = (xla_flops / spec["peak_bf16_flops"]) if xla_flops > 0 else t_math
     t_hbm = hbm_bytes / spec["hbm_bw"]            # cost model is per-device
-    t_overlapped = max(t_math, t_hbm)
-    t_serial = t_math + t_hbm
+    t_overlapped = max(t_exec, t_hbm)
+    t_serial = t_exec + t_hbm
     return {
         "memory": mem,
         "live_bytes_per_device": live,
@@ -296,6 +312,7 @@ def analyze(compiled, *, n_dev: int, global_tokens: int,
         "overlap": overlap,
         "hlo_collectives": hlo_comm,
         "t_math_s": t_math,
+        "t_exec_s": t_exec,
         "t_hbm_s": t_hbm,
         "step_time_overlapped_s": t_overlapped,
         "step_time_serial_s": t_serial,
@@ -324,23 +341,36 @@ def project(metrics: dict, comm: dict, *, ici_axes_used: int = 1,
             spec=V5P) -> dict:
     """Fold the ICI term into the roofline: t_ici = received bytes / the
     ICI bandwidth actually usable (one torus axis by default — conservative;
-    XLA can stripe a 16-chip all-gather over more). MFU projections:
+    XLA stripes large collectives over more on a v5p 3D torus, reported as
+    the _2axis variants). Step time uses EXECUTED flop time (t_exec_s);
+    MFU's numerator is the analytic useful flops, capped at 1. Projections:
 
     - overlapped: collectives and HBM fully hidden behind the MXU
       (what the async markers show the scheduler arranging)
     - serial: nothing overlaps (hard floor)
     """
     t_math = metrics["t_math_s"]
+    t_exec = metrics.get("t_exec_s", t_math)
     t_hbm = metrics["t_hbm_s"]
     t_ici = comm["total_in_bytes"] / (spec["ici_bw_axis"] * ici_axes_used)
-    t_over = max(t_math, t_hbm, t_ici)
-    t_serial = t_math + t_hbm + t_ici
+    # absolute axis-count variants (independent of ici_axes_used, so a
+    # caller passing 2 cannot silently double-discount)
+    t_ici_2 = comm["total_in_bytes"] / (spec["ici_bw_axis"] * 2)
+    t_over = max(t_exec, t_hbm, t_ici)
+    t_serial = t_exec + t_hbm + t_ici
+    t_over2 = max(t_exec, t_hbm, t_ici_2)
+    t_serial2 = t_exec + t_hbm + t_ici_2
     return {
         "t_ici_s": t_ici,
+        "t_ici_2axis_s": t_ici_2,
         "step_time_overlapped_s": t_over,
         "step_time_serial_s": t_serial,
-        "mfu_projected_overlapped": t_math / t_over,
-        "mfu_projected_serial": t_math / t_serial,
+        "step_time_overlapped_2axis_s": t_over2,
+        "step_time_serial_2axis_s": t_serial2,
+        "mfu_projected_overlapped": min(1.0, t_math / t_over),
+        "mfu_projected_serial": min(1.0, t_math / t_serial),
+        "mfu_projected_overlapped_2axis": min(1.0, t_math / t_over2),
+        "mfu_projected_serial_2axis": min(1.0, t_math / t_serial2),
     }
 
 
@@ -380,6 +410,12 @@ def run_config(name: str, builder, topo_name: str, n_dev: int,
     recv = max(recv_hlo, recv_trace)
     proj = project(m, {"total_in_bytes": recv})
     m.update(proj)
+    # throughput must reflect the post-ICI step time (code-review r5: the
+    # pre-ICI figure from analyze() silently survived regeneration)
+    m["tokens_per_s_per_chip_projected"] = (
+        global_tokens / n_dev / proj["step_time_overlapped_s"])
+    m["tokens_per_s_per_chip_projected_2axis"] = (
+        global_tokens / n_dev / proj["step_time_overlapped_2axis_s"])
     m["comm"] = comm
     m["recv_bytes_per_device_trace"] = recv_trace
     m["recv_bytes_per_device_hlo"] = recv_hlo
