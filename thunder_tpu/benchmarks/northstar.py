@@ -244,7 +244,10 @@ def hlo_collectives(hlo: str, n_dev: int) -> dict:
     for base in list(out):
         attr = hlo.count(f'async_collective_name="{base}-start')
         out[base]["async_attr_count"] = attr
-        out[base]["async_count"] = max(out[base]["async_count"], attr)
+        # the attribute can appear on both halves of a wrapped pair: clamp
+        # to the instruction count so async_count/count stays a fraction
+        out[base]["async_count"] = min(out[base]["count"],
+                                       max(out[base]["async_count"], attr))
     total = sum(e["recv_bytes_per_dev"] for e in out.values())
     frac = {k: (min(1.0, e["async_count"] / e["count"]) if e["count"] else 0.0)
             for k, e in out.items()}
@@ -318,8 +321,6 @@ def analyze(compiled, *, n_dev: int, global_tokens: int,
         "step_time_serial_s": t_serial,
         "mfu_projected_overlapped": t_math / t_overlapped,
         "mfu_projected_serial": t_math / t_serial,
-        "tokens_per_s_per_chip_projected":
-            global_tokens / n_dev / t_overlapped,
     }
 
 
