@@ -170,7 +170,7 @@ def test_evidence_pipeline_smoke_cpu():
     compiled = entry.jit_obj.lower(*entry.input_avals).compile()
 
     n = ns.n_params_llama(cfg)
-    m = ns.analyze(compiled, n_dev=n_dev, global_tokens=8 * 16,
+    m = ns.analyze(compiled, n_dev=n_dev,
                    analytic_flops=ns.analytic_train_flops(n, 8 * 16, cfg, 16))
     # memory analysis produced real numbers
     assert m["live_bytes_per_device"] > 0

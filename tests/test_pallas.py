@@ -254,3 +254,39 @@ def test_sdpa_streamed_grid_matches_xla_longer_seq():
     got = np.asarray(tt.jit(f, executors=["pallas", "xla"])(q, k, v))
     want = np.asarray(tt.jit(f, executors=["xla"])(q, k, v))
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_sdpa_combined_causal_bwd_matches_autodiff():
+    """The r5 combined dq+dk+dv resident kernel (gated on T % 256 == 0 and
+    T == S) matches jax autodiff — the T=32 default above never reaches it."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(9)
+    B, H, T, hd = 1, 2, 256, 32
+    q = (rng.randn(B, H, T, hd) * 0.2).astype(np.float32)
+    k = (rng.randn(B, H, T, hd) * 0.2).astype(np.float32)
+    v = (rng.randn(B, H, T, hd) * 0.2).astype(np.float32)
+    g = (rng.randn(B, H, T, hd) * 0.2).astype(np.float32)
+
+    from thunder_tpu.executors import pallasex as px
+
+    o, lse = px.pallas_sdpa_fwd(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                is_causal=True)
+    dq, dk, dv = px.pallas_sdpa_bwd(jnp.asarray(g), jnp.asarray(q),
+                                    jnp.asarray(k), jnp.asarray(v), o, lse,
+                                    is_causal=True)
+
+    def ref(q, k, v):
+        s = (q @ k.swapaxes(-1, -2)) / math.sqrt(hd)
+        mask = np.tril(np.ones((T, T), bool))
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), -1)
+        return jnp.sum((p @ v) * g)
+
+    rdq, rdk, rdv = jax.grad(ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), atol=2e-4)
