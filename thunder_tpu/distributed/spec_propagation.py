@@ -325,6 +325,24 @@ def _reshape_spec(in_shape, out_shape, spec: SpecInfo, opname: str) -> SpecInfo:
     return SpecInfo(dims, spec.partial, spec.varying)
 
 
+def _degrade_to_varying(tas, out_ndim, fuzzy):
+    """Shared degrade for rank-local scatters whose layout the per-dim model
+    cannot express (data-dependent permutations, MoE index dispatch): sharded
+    dims and device-varying state collapse into VARYING + fuzzy (rescuable —
+    collectives clear it, key-path correspondence rescues outputs). PARTIAL
+    sums are preserved AS partial and NOT marked fuzzy: an unreduced sum
+    scattered into a table is still an unreduced sum, and folding it into the
+    rescuable state would stitch divergent per-rank values past the output
+    boundary without the missing all_reduce (code-review r5)."""
+    varying: set = set()
+    partial: set = set()
+    for a, s in tas:
+        varying |= s.sharded_axes() | set(s.varying)
+        partial |= set(s.partial)
+    fuzzy.update(varying - partial)
+    return SpecInfo((None,) * out_ndim, frozenset(partial), frozenset(varying))
+
+
 def propagate_specs(trc, input_specs: dict, axis_sizes: dict | None = None) -> dict:
     """Walk ``trc`` and return {Variable: SpecInfo} for every traced value.
 
@@ -562,7 +580,12 @@ def propagate_specs(trc, input_specs: dict, axis_sizes: dict | None = None) -> d
                 # rank scatters its local tokens' grads, then reduce)
                 (qd, sd) = tas[0]
                 if sd.sharded_axes() or sd.varying:
-                    raise SpecPropagationError(f"{name}: sharded scatter destination")
+                    # sharded/varying destination: per-rank accumulation
+                    # into per-rank state — no per-dim claim survives
+                    # (reached by grad paths of the MoE index dispatch, r5)
+                    _bind_out(env, bsym, _degrade_to_varying(
+                        tas, len(outs[0].shape), fuzzy))
+                    continue
                 partial = set(sd.partial)
                 varying: frozenset = frozenset()
                 for a, s in tas[1:]:
@@ -572,10 +595,18 @@ def propagate_specs(trc, input_specs: dict, axis_sizes: dict | None = None) -> d
                 continue
             if sid in (PrimIDs.SCATTER, PrimIDs.INDEX_PUT):
                 # overwrite semantics: rank-local writes are not a partial
-                # sum; require replicated operands
-                for a, s in tas:
-                    if not s.is_replicated():
-                        raise SpecPropagationError(f"{name}: sharded operand in overwrite scatter")
+                # sum. Replicated operands -> replicated result; sharded or
+                # varying indices/values make the result per-device
+                # DIFFERENT with no per-dim layout claim (a data-dependent
+                # permutation — the MoE index dispatch): mark device-varying
+                # over the involved axes, fuzzily tracked so downstream
+                # collectives clear it and the output boundary's key-path
+                # rescue applies (r5, enables gather dispatch under EP)
+                if any(s.sharded_axes() or s.varying or s.partial
+                       for _, s in tas):
+                    _bind_out(env, bsym, _degrade_to_varying(
+                        tas, len(outs[0].shape), fuzzy))
+                    continue
                 _bind_out(env, bsym, replicated(len(outs[0].shape)))
                 continue
             # -- distributed prims --------------------------------------------

@@ -163,7 +163,7 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
     # MIXTRAL_FORCE_EINSUM=1: debug/bench knob to run the EP einsum dispatch
     # single-device (used by the r5 flop A/B in MIXTRAL_EP.md)
     _force_einsum = os.environ.get("MIXTRAL_FORCE_EINSUM") == "1"
-    if ep is None and not _force_einsum:
+    if not _force_einsum:
         # scatter token ids into the slot table (slots are unique by
         # construction — the cumsum assigns each (expert, position) once;
         # only the sentinel overflow bin sees duplicate writes and is never
@@ -177,11 +177,10 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
         expert_in = ops.reshape(
             prims.take(x_padded, ops.narrow(slot_tokens, 0, 0, E * C), 0), (E, C, D))
     else:
-        # expert parallelism: the sharding-spec model cannot express a
-        # data-dependent cross-rank permutation (scatter with ep-sharded
-        # indices), so the EP path keeps the one-hot dispatch einsum whose
-        # contraction over the sharded token dim propagates cleanly; the
-        # flop lever there is capacity_factor (MIXTRAL_EP.md sweep)
+        # one-hot dispatch einsum, kept ONLY as the MIXTRAL_FORCE_EINSUM=1
+        # A/B control (MIXTRAL_EP.md): since r5 the spec rules express the
+        # index dispatch's data-dependent permutation as device-varying
+        # fuzzy state, so the gather path above runs under EP too
         dispatch = None  # (S, E, C)
         combine = None
         for j, fp in enumerate(flat_pos):
@@ -218,7 +217,7 @@ def moe_ffn(x, router_w, we_gate, we_up, we_down, cfg: MixtralConfig,
         axis, n = ep
         expert_out = dist_prims.wait(dist_prims.all_to_all(expert_out, axis, 1, 0, n))  # (E, C, D)
 
-    if ep is None and not _force_einsum:
+    if not _force_einsum:
         # combine: each token gathers its k slots back, weighted by its gate
         eo_flat = ops.cat([ops.reshape(expert_out, (E * C, D)),
                            ops.zeros((1, D), dtype=dtypes.float32)], 0)
