@@ -441,9 +441,11 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
     lse3 = lse.reshape(bh, T, 1)
 
     blk = 512 if T % 512 == 0 else (256 if T % 256 == 0 else 0)
-    # VMEM budget ~16MB: 9 resident (T, hd) bf16 blocks + (T, hd) f32 + 
-    # (T, 1) f32 scratch must fit with headroom — gate on T*hd, not T
-    if is_causal and T == S and T * hd <= 4096 * 128 and blk:
+    # scoped-VMEM budget 16MB: 9 resident (T, hd) bf16 blocks + (T, hd) f32
+    # + (T, 1) f32 scratch. T*hd = 4096*128 measures 17.63M on v5e (chip
+    # error, r5) — the combined kernel caps at 2048*128 and longer
+    # sequences stream through the two-kernel path below
+    if is_causal and T == S and T * hd <= 2048 * 128 and blk:
         dq, dk, dv = pl.pallas_call(
             functools.partial(_sdpa_bwd_kernel_causal_resident, scale=scale_v,
                               blk=blk, nb=T // blk),
