@@ -158,6 +158,29 @@ def main():
                                    fstate0 if use_fp8 else None)
     print(f"thunder_tpu: {t_ours*1e3:.1f} ms/step loss={loss_ours:.3f}", file=sys.stderr)
 
+    # fusion health: region count (fewer = fewer kernel-boundary HBM
+    # round-trips), horizontal/epilogue merge counts, and how long the
+    # trace-transform pipeline itself took — regressions in any of these
+    # show up here long before they show up as throughput noise
+    from thunder_tpu.core import cost_model
+
+    exec_trc = tt.last_execution_trace(jstep)
+    exec_src = exec_trc.python()
+    regions = [b for b in exec_trc.bound_symbols if str(b.sym.id).startswith("xla.fusion")]
+    fused_region_count = len(regions)
+    # roofline classification per region: a memory-bound region is one whose
+    # boundary traffic, not its FLOPs, sets its runtime — those are the
+    # regions further fusion work should target
+    mem_bound_regions = sum(
+        1 for b in regions if cost_model.is_memory_bound(*cost_model.region_cost(b.subsymbols)))
+    qkv_merges = exec_src.count("horizontal-fusion")
+    epilogue_fusions = exec_src.count("epilogue-fusion")
+    stats = tt.compile_stats(jstep)
+    trace_pass_ms = stats.last_transform_ns / 1e6
+    print(f"fused_region_count={fused_region_count} (memory_bound={mem_bound_regions}) "
+          f"horizontal_merges={qkv_merges} epilogue_fusions={epilogue_fusions} "
+          f"trace_pass_ms={trace_pass_ms:.1f}", file=sys.stderr)
+
     # ---- pure jax.jit baseline (independent implementation) ----------------
     def jax_rope(x, theta):
         B, H, T, hd = x.shape
@@ -278,6 +301,10 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(t_ref / t_ours, 4),
+        "fused_region_count": fused_region_count,
+        "horizontal_merges": qkv_merges,
+        "epilogue_fusions": epilogue_fusions,
+        "trace_pass_ms": round(trace_pass_ms, 1),
     }))
 
 

@@ -1,0 +1,414 @@
+"""Fusion 2.0 tests: horizontal GEMM merging, epilogue fusion, cost model.
+
+Fast trace-shape regression tests (JAX_PLATFORMS=cpu, no TPU needed): the
+merged/fused symbols must actually appear in the executable trace, and the
+numeric-parity grids pin the fused kernels to the unfused eager-JAX path.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import ops
+from thunder_tpu.core import cost_model
+from thunder_tpu.models import llama
+
+
+@pytest.fixture(autouse=True)
+def pallas_interpret(monkeypatch):
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+
+
+def _symbol_names(trc):
+    names = set()
+
+    def walk(bsyms):
+        for b in bsyms:
+            names.add(b.sym.codegen_name())
+            walk(b.subsymbols)
+
+    walk(trc.bound_symbols)
+    return names
+
+
+def _count_symbols(trc, name):
+    n = 0
+
+    def walk(bsyms):
+        nonlocal n
+        for b in bsyms:
+            if b.sym.name == name:
+                n += 1
+            walk(b.subsymbols)
+
+    walk(trc.bound_symbols)
+    return n
+
+
+def _fused_region_count(trc):
+    return sum(1 for b in trc.bound_symbols if str(b.sym.id).startswith("xla.fusion"))
+
+
+# ---------------------------------------------------------------------------
+# horizontal QKV merge
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("np_dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+def test_qkv_merge_numeric_parity(np_dtype):
+    """Merged projections match the unfused eager-JAX path, forward + grad."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32), dtype=np_dtype)
+    wq = jnp.asarray(rng.randn(16, 8).astype(np.float32) * 0.2, dtype=np_dtype)
+    wk = jnp.asarray(rng.randn(6, 8).astype(np.float32) * 0.2, dtype=np_dtype)
+    wv = jnp.asarray(rng.randn(6, 8).astype(np.float32) * 0.2, dtype=np_dtype)
+
+    def f(x, wq, wk, wv):
+        def loss(x, wq, wk, wv):
+            q = ops.linear(x, wq)
+            k = ops.linear(x, wk)
+            v = ops.linear(x, wv)
+            return ops.add(ops.sum(ops.mul(q, q)), ops.sum(ops.mul(k, v)))
+        return tt.value_and_grad(loss, argnums=(0, 1, 2, 3))(x, wq, wk, wv)
+
+    jf = tt.jit(f, horizontal_fusion=True)
+    loss, grads = jf(x, wq, wk, wv)
+
+    def jloss(x, wq, wk, wv):
+        q, k, v = x @ wq.T, x @ wk.T, x @ wv.T
+        return (q * q).sum() + (k * v).sum()
+
+    jl, jg = jax.value_and_grad(jloss, argnums=(0, 1, 2, 3))(x, wq, wk, wv)
+    tol = dict(atol=1e-4, rtol=1e-4) if np_dtype == np.float32 else dict(atol=5e-2, rtol=5e-2)
+    np.testing.assert_allclose(np.asarray(loss, np.float32), np.asarray(jl, np.float32), **tol)
+    for g, jgi in zip(grads, jg):
+        np.testing.assert_allclose(np.asarray(g, np.float32), np.asarray(jgi, np.float32), **tol)
+
+
+def test_qkv_merge_appears_in_trace():
+    """The three Q/K/V dot_generals compile as ONE merged matmul — asserted
+    on the executable trace (the merged symbol carries the pass marker)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8).astype(np.float32)
+    ws = [rng.randn(8, 8).astype(np.float32) for _ in range(3)]
+
+    def f(x, wq, wk, wv):
+        return ops.linear(x, wq), ops.linear(x, wk), ops.linear(x, wv)
+
+    merged = tt.jit(f, horizontal_fusion=True)
+    merged(x, *ws)
+    trc = tt.last_execution_trace(merged)
+    assert "horizontal-fusion" in trc.python()
+    assert _count_symbols(trc, "dot_general") == 1, trc.python()
+
+    unmerged = tt.jit(f, horizontal_fusion=False)
+    unmerged(x, *ws)
+    assert _count_symbols(tt.last_execution_trace(unmerged), "dot_general") == 3
+    np.testing.assert_allclose(np.asarray(merged(x, *ws)[0]),
+                               np.asarray(unmerged(x, *ws)[0]), atol=1e-6)
+
+
+def test_horizontal_merge_skips_unavailable_operands():
+    """A sibling whose weight is computed AFTER the first member must not
+    merge (the merged op would consume an undefined value)."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 8).astype(np.float32)
+    w1 = rng.randn(8, 8).astype(np.float32)
+
+    def f(x, w1):
+        a = ops.linear(x, w1)
+        w2 = ops.mul(ops.transpose(a, (1, 0)) @ a, 0.01)  # depends on a
+        b = ops.linear(x, w2)
+        return ops.add(a, b)
+
+    jf = tt.jit(f, horizontal_fusion=True)
+    got = np.asarray(jf(x, w1))
+    a = x @ w1.T
+    b = x @ ((a.T @ a) * 0.01).T
+    np.testing.assert_allclose(got, a + b, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# epilogue fusion: rms_norm + residual
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("np_dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+@pytest.mark.parametrize("with_weight", [True, False], ids=["weight", "noweight"])
+def test_rms_norm_residual_parity(np_dtype, with_weight):
+    rng = np.random.RandomState(3)
+    r = jnp.asarray(rng.randn(8, 32).astype(np.float32), dtype=np_dtype)
+    x = jnp.asarray(rng.randn(8, 32).astype(np.float32), dtype=np_dtype)
+    w = jnp.asarray(rng.randn(32).astype(np.float32), dtype=np_dtype) if with_weight else None
+
+    def f(r, x, w=None):
+        h = ops.add(r, x)
+        return h, ops.rms_norm(h, w, eps=1e-5)
+
+    args = (r, x) if w is None else (r, x, w)
+    jf = tt.jit(f, executors=["pallas", "xla"])
+    h, normed = jf(*args)
+    names = _symbol_names(tt.last_execution_trace(jf))
+    assert "pallas_rms_norm_residual" in names
+
+    hr = (r.astype(jnp.float32) + x.astype(jnp.float32)).astype(r.dtype)
+    ms = jnp.mean(hr.astype(jnp.float32) ** 2, -1, keepdims=True)
+    want = (hr.astype(jnp.float32) / jnp.sqrt(ms + 1e-5)).astype(r.dtype)
+    if w is not None:
+        want = want * w
+    tol = dict(atol=1e-5) if np_dtype == np.float32 else dict(atol=5e-2)
+    np.testing.assert_allclose(np.asarray(h, np.float32), np.asarray(hr, np.float32), **tol)
+    np.testing.assert_allclose(np.asarray(normed, np.float32), np.asarray(want, np.float32), **tol)
+
+
+def test_rms_norm_residual_skipped_when_intermediate_consumed_between():
+    """A consumer of the residual stream BETWEEN the add and the rms_norm
+    must block the rewrite: the fused op lands at the rms_norm's position,
+    so that consumer would otherwise read h before it is defined."""
+    rng = np.random.RandomState(11)
+    r = rng.randn(8, 32).astype(np.float32)
+    x = rng.randn(8, 32).astype(np.float32)
+    w = rng.randn(32).astype(np.float32)
+
+    def f(r, x, w):
+        h = ops.add(r, x)
+        s = ops.mul(h, 2.0)           # consumes h between add and rms_norm
+        y = ops.rms_norm(h, w, eps=1e-5)
+        return s, y
+
+    jf = tt.jit(f, executors=["pallas", "xla"])
+    s, y = jf(r, x, w)                # must not raise use-before-def
+    assert "pallas_rms_norm_residual" not in _symbol_names(tt.last_execution_trace(jf))
+    h = r + x
+    np.testing.assert_allclose(np.asarray(s), h * 2.0, atol=1e-5)
+    ms = np.mean(h * h, -1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(y), h / np.sqrt(ms + 1e-5) * w, atol=1e-5)
+
+
+def test_rms_norm_vjp_matches_jax():
+    """The nn.rms_norm grad rule (which keeps the composite claimable in
+    training traces) matches jax autodiff of the same function."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(6, 16).astype(np.float32)
+    w = rng.randn(16).astype(np.float32)
+
+    def f(x, w):
+        return tt.grad(lambda x, w: ops.sum(ops.mul(ops.rms_norm(x, w, eps=1e-5),
+                                                    ops.rms_norm(x, w, eps=1e-5))),
+                       argnums=(0, 1))(x, w)
+
+    gx, gw = tt.jit(f)(x, w)
+
+    def jf(x, w):
+        y = x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-5) * w
+        return (y * y).sum()
+
+    jgx, jgw = jax.grad(jf, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(jgx), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(jgw), atol=1e-4, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# epilogue fusion: linear + bias + activation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("np_dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+@pytest.mark.parametrize("with_bias", [True, False], ids=["bias", "nobias"])
+@pytest.mark.parametrize("act", ["relu", "silu", "gelu"])
+def test_linear_act_parity(np_dtype, with_bias, act):
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32), dtype=np_dtype)
+    w = jnp.asarray(rng.randn(24, 16).astype(np.float32) * 0.3, dtype=np_dtype)
+    b = jnp.asarray(rng.randn(24).astype(np.float32), dtype=np_dtype) if with_bias else None
+
+    act_op = {"relu": ops.relu, "silu": ops.silu, "gelu": ops.gelu}[act]
+
+    def f(x, w, b=None):
+        return act_op(ops.linear(x, w, b))
+
+    args = (x, w) if b is None else (x, w, b)
+    jf = tt.jit(f, executors=["pallas", "xla"])
+    got = jf(*args)
+    names = _symbol_names(tt.last_execution_trace(jf))
+    assert "pallas_linear_act" in names, names
+
+    jact = {"relu": jax.nn.relu, "silu": jax.nn.silu,
+            "gelu": lambda y: jax.nn.gelu(y, approximate=False)}[act]
+    want = x @ w.T
+    if b is not None:
+        want = want + b
+    want = jact(want.astype(jnp.float32))
+    tol = dict(atol=1e-5) if np_dtype == np.float32 else dict(atol=8e-2, rtol=8e-2)
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want, np.float32), **tol)
+
+
+def test_mixed_dtype_claims_fall_back_to_decomposition():
+    """bf16 activations with f32 weight/bias promote the unfused output to
+    f32; the pallas kernels emit the activation dtype, so their checkers
+    must REJECT mixed-dtype combos and keep the decomposition's numerics."""
+    rng = np.random.RandomState(10)
+    xb = jnp.asarray(rng.randn(8, 32).astype(np.float32), jnp.bfloat16)
+    rb = jnp.asarray(rng.randn(8, 32).astype(np.float32), jnp.bfloat16)
+    wf32 = rng.randn(32).astype(np.float32)
+
+    jf = tt.jit(lambda r, x, w: ops.rms_norm(ops.add(r, x), w), executors=["pallas", "xla"])
+    out = jf(rb, xb, wf32)
+    names = _symbol_names(tt.last_execution_trace(jf))
+    assert "pallas_rms_norm_residual" not in names and "pallas_rms_norm" not in names
+    assert jnp.asarray(out).dtype == jnp.float32  # promoted, not narrowed
+
+    wb = jnp.asarray(rng.randn(16, 32).astype(np.float32) * 0.3, jnp.bfloat16)
+    bf32 = rng.randn(16).astype(np.float32)
+    jl = tt.jit(lambda x, w, b: ops.relu(ops.linear(x, w, b)), executors=["pallas", "xla"])
+    out2 = jl(xb, wb, bf32)
+    assert "pallas_linear_act" not in _symbol_names(tt.last_execution_trace(jl))
+    assert jnp.asarray(out2).dtype == jnp.float32
+
+
+def test_linear_act_not_fused_when_intermediate_escapes():
+    """If the pre-activation value is used elsewhere, the chain must stay
+    unfused (the fused kernel would not produce it)."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 8).astype(np.float32)
+
+    def f(x, w):
+        y = ops.linear(x, w)
+        return ops.add(ops.relu(y), y)  # y escapes
+
+    jf = tt.jit(f, executors=["pallas", "xla"])
+    got = np.asarray(jf(x, w))
+    assert "pallas_linear_act" not in _symbol_names(tt.last_execution_trace(jf))
+    y = x @ w.T
+    np.testing.assert_allclose(got, np.maximum(y, 0) + y, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# whole-model trace shape regression (the fast no-TPU fusion canary)
+# ---------------------------------------------------------------------------
+
+def test_llama_train_step_fusion_shape():
+    """Tiny-llama train step: QKV + gate/up merge, at least one epilogue is
+    absorbed into a Pallas kernel, numerics match the unfused path, and the
+    fused_region_count is strictly lower than without absorption."""
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=7, scale_layers=2)
+    from thunder_tpu.optim import SGD
+
+    opt = SGD(lr=1e-2)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        return loss, *opt.update(params, grads, opt_state)
+
+    rng = np.random.RandomState(7)
+    tokens = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    opt_state = opt.init(params)
+
+    old = tt.jit(train_step, executors=["pallas", "xla"], xla_absorb_claimed=False,
+                 epilogue_fusion=False, horizontal_fusion=False)
+    new = tt.jit(train_step, executors=["pallas", "xla"], horizontal_fusion=True)
+    l_old, p_old, _ = old(params, opt_state, tokens, targets)
+    l_new, p_new, _ = new(params, opt_state, tokens, targets)
+    np.testing.assert_allclose(np.asarray(l_old), np.asarray(l_new), atol=1e-5)
+
+    new_trc = tt.last_execution_trace(new)
+    src = new_trc.python()
+    assert "horizontal-fusion" in src        # QKV / gate-up merged
+    assert "pallas_rms_norm_residual" in _symbol_names(new_trc)  # epilogue absorbed
+    n_new = _fused_region_count(new_trc)
+    n_old = _fused_region_count(tt.last_execution_trace(old))
+    assert n_new < n_old, (n_new, n_old)
+
+
+def test_bench_geometry_qkv_merges_in_trace():
+    """Trace-only compile of one bench-geometry layer (dim 4096, B=8,
+    T=2048 tokens): at those shapes the cost model itself — no override —
+    must merge Q/K/V into one GEMM. Inputs are ShapeDtypeStructs, so
+    nothing executes; this runs in seconds on CPU."""
+    import thunder_tpu.core.dtypes as dtypes
+
+    cfg = llama.CONFIGS["llama2-7b-bench"]
+    B, T = 8, 2048  # the actual bench shape: M=16384 tokens clears the threshold
+
+    def qkv(x, wq, wk, wv):
+        q = ops.linear(x, wq)
+        k = ops.linear(x, wk)
+        v = ops.linear(x, wv)
+        return q, k, v
+
+    jd = cfg.dtype.jax
+    x = jax.ShapeDtypeStruct((B, T, cfg.dim), jd)
+    wq = jax.ShapeDtypeStruct((cfg.dim, cfg.dim), jd)
+    kvd = cfg.kv_heads * cfg.head_dim
+    wk = jax.ShapeDtypeStruct((kvd, cfg.dim), jd)
+    wv = jax.ShapeDtypeStruct((kvd, cfg.dim), jd)
+
+    jf = tt.jit(qkv)
+    entry = jf._compile([x, wq, wk, wv],
+                        jax.tree_util.tree_structure(((0, 0, 0, 0), {})),
+                        (x, wq, wk, wv), {})
+    trc = entry.traces[-1]
+    assert "horizontal-fusion" in trc.python()
+    assert _count_symbols(trc, "dot_general") == 1
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+def test_cost_model_merge_profitability():
+    # bench shapes: M = 8*2048 tokens, GQA QKV widths 4096+512+512 -> merge
+    assert cost_model.horizontal_merge_profitable(16384, [4096, 512, 512])
+    # 7B QKV without GQA (widths 3*4096) at the bench token count -> merge
+    assert cost_model.horizontal_merge_profitable(16384, [4096, 4096, 4096])
+    # tiny trace: 32 tokens, 3 wide projections -> concat write dominates
+    assert not cost_model.horizontal_merge_profitable(32, [176, 176, 176])
+    # single GEMM: nothing to merge
+    assert not cost_model.horizontal_merge_profitable(16384, [4096])
+
+
+def test_cost_model_dot_general_flops():
+    from thunder_tpu.core import prims
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.core.trace import TraceCtx, tracectx
+
+    trc = TraceCtx("t")
+    with tracectx(trc):
+        a = TensorProxy("a", shape=(128, 256), dtype=dtypes.bfloat16)
+        b = TensorProxy("b", shape=(512, 256), dtype=dtypes.bfloat16)
+        out = prims.dot_general(a, b, contract_dims=((1,), (1,)))
+        big_a = TensorProxy("ba", shape=(2048, 2048), dtype=dtypes.bfloat16)
+        big_b = TensorProxy("bb", shape=(2048, 2048), dtype=dtypes.bfloat16)
+        big = prims.dot_general(big_a, big_b, contract_dims=((1,), (1,)))
+    small_bsym, big_bsym = trc.bound_symbols[-2], trc.bound_symbols[-1]
+    flops, nbytes = cost_model.bsym_cost(small_bsym)
+    assert flops == 2 * 128 * 512 * 256
+    assert nbytes == (128 * 256 + 512 * 256 + 128 * 512) * 2
+    # a (128×512)·(512×256)-class GEMM sits BELOW the v5e ridge (≈73 f/B);
+    # a 2048³ GEMM sits above it (≈341 f/B)
+    assert cost_model.is_memory_bound(flops, nbytes)
+    assert not cost_model.is_memory_bound(*cost_model.bsym_cost(big_bsym))
+
+
+def test_cost_model_region_cost_boundary_bytes():
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.core.trace import TraceCtx, tracectx
+
+    trc = TraceCtx("t")
+    with tracectx(trc):
+        a = TensorProxy("a", shape=(64, 64), dtype=dtypes.float32)
+        b = ops.mul(a, a)
+        c = ops.add(b, 1.0)
+        d = ops.exp(c)
+    bsyms = trc.bound_symbols
+    flops, nbytes = cost_model.region_cost(bsyms)
+    # interior values (b, c) don't count toward region boundary input bytes
+    assert flops > 0
+    assert cost_model.is_memory_bound(flops, nbytes)

@@ -79,6 +79,11 @@ _COMPOSITE_GRAD_EXEMPT = {
 _COMPOSITE_GRAD_EXEMPT_REASONED = {
     "nn.ce_fwd": "internal fwd half of the CE fwd/bwd executor pair; the public "
                  "nn.cross_entropy composite has its own VJP rule",
+    "nn.rms_norm_residual": "built POST-autodiff by the epilogue fusion pass "
+                            "(core/fusion_passes.py) — autodiff never sees it; the "
+                            "source ops (add + rms_norm) carry the grad story",
+    "nn.linear_act": "built POST-autodiff by the epilogue fusion pass — autodiff "
+                     "never sees it; linear and the activations carry the grad story",
     "nn.sdpa_fwd": "internal fwd half of SDPA; nn.scaled_dot_product_attention has a rule",
     "nn.sdpa_bwd": "backward half; differentiating it is second-order autodiff",
     "ops.fmod": "prim classified non-differentiable (matches reference: grads stop)",

@@ -68,6 +68,15 @@ def enable_compilation_cache(directory: str, *, min_compile_secs: float = 1.0) -
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(directory))
+    # jax initializes its persistent cache object once per process and then
+    # ignores jax_compilation_cache_dir updates; reset so the new directory
+    # takes effect even after earlier compiles in this process
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
     for opt in ("jax_persistent_cache_min_compile_time_secs",
                 "jax_compilation_cache_min_compile_time_secs"):  # older spelling
         try:

@@ -20,6 +20,8 @@ Consumed by ``tests/test_northstar.py`` (regressions fail) and by
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 # v5p chip datasheet numbers (public: jax-ml.github.io/scaling-book — the
@@ -38,6 +40,12 @@ TOPO_V5P_16 = "v5p:2x2x2"   # 8 chips = v5p-16
 
 
 def get_topology(name: str):
+    # honor an explicit platform restriction: with JAX_PLATFORMS=cpu a
+    # present-but-chipless libtpu must not be initialized — PJRT topology
+    # setup blocks on the runtime socket instead of raising
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats and "tpu" not in plats.split(","):
+        return None
     try:
         from jax.experimental import topologies
 

@@ -1,0 +1,164 @@
+"""Region cost model: FLOP / byte estimation over bound symbols.
+
+The fusion layer used to make every decision greedily: any checker-approved
+Pallas claim won, every claimed kernel split the surrounding XLA region, and
+horizontal merges didn't exist. This module provides the small analytical
+model those decisions now consult:
+
+- ``bsym_cost(bsym)`` — (flops, bytes moved) for one bound symbol, recursing
+  into composite decompositions. Matmul-class prims (``OpTags.MATMUL_OP``)
+  count 2·M·N·K FLOPs; everything else is modeled as bandwidth-bound
+  (bytes = inputs + outputs, flops = output elements).
+- ``region_cost(bsyms)`` — cost of a fused region: FLOPs add up, but bytes
+  count only the region *boundary* (inputs read + outputs written) — fusion's
+  entire point is that interior values never touch HBM.
+- ``arithmetic_intensity`` / ``is_memory_bound`` — position relative to the
+  TPU ridge point (v5e ≈ 197 TFLOP/s bf16 over ~819 GB/s HBM ≈ 240
+  FLOP/byte).
+- ``horizontal_merge_profitable`` — the byte model for merging k sibling
+  GEMMs over a shared input into one wide GEMM (the QKV pattern).
+- ``claim_worthwhile`` — whether a standalone custom-kernel claim of a
+  memory-bound op beats leaving it inside an XLA fusion region.
+
+Estimates are deliberately coarse (no layout/padding modeling): they only
+need to rank alternatives, not predict runtimes.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.utils import consumed_vars, produced_vars
+
+# v5e bf16 peak over HBM bandwidth; the ridge point of the roofline.
+TPU_RIDGE_FLOPS_PER_BYTE = 240.0
+
+# Below this many bytes of traffic a dedicated kernel launch can't amortize
+# its dispatch + pipeline-fill overhead against XLA's fused code (~1 MiB is
+# roughly 1.2 us of HBM time on v5e, the same order as kernel launch).
+MIN_CLAIM_BYTES = 1 << 20
+
+_ZERO_COST_IDS = {
+    PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL,
+    PrimIDs.PYTHON_PRINT, PrimIDs.SINK, PrimIDs.UNPACK_TRIVIAL,
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LITERAL_LIKE, PrimIDs.CHECK_NUMBER_TYPE,
+}
+
+
+def tensor_bytes(p) -> int:
+    """Bytes of one tensor proxy (0 for non-tensors)."""
+    if not isinstance(p, TensorProxy):
+        return 0
+    n = 1
+    for s in p.shape:
+        n *= int(s)
+    return n * p.dtype.bytes
+
+
+def _io_bytes(bsym: BoundSymbol) -> int:
+    return (sum(tensor_bytes(p) for p in bsym.flat_proxy_args())
+            + sum(tensor_bytes(p) for p in bsym.flat_proxy_outs()))
+
+
+def _matmul_flops(bsym: BoundSymbol) -> int:
+    """2·(batch·M·N)·K for dot_general; conservative fallbacks for the other
+    MATMUL_OP prims (einsum/convolution) via output-elements × contracted
+    extent when recoverable, else output elements."""
+    out_elems = 0
+    for p in bsym.flat_proxy_outs():
+        if isinstance(p, TensorProxy):
+            n = 1
+            for s in p.shape:
+                n *= int(s)
+            out_elems += n
+    if bsym.sym.id is PrimIDs.DOT_GENERAL:
+        a = bsym.args[0]
+        contract_dims = bsym.kwargs.get("contract_dims")
+        if contract_dims is None and len(bsym.args) > 2:
+            contract_dims = bsym.args[2]
+        k = 1
+        if contract_dims and isinstance(a, TensorProxy):
+            for d in contract_dims[0]:
+                k *= int(a.shape[d])
+        return 2 * out_elems * max(k, 1)
+    if bsym.sym.id is PrimIDs.CONVOLUTION and isinstance(bsym.args[1], TensorProxy):
+        w = bsym.args[1]
+        k = 1
+        for s in w.shape[1:]:  # Cin/groups × kernel window
+            k *= int(s)
+        return 2 * out_elems * max(k, 1)
+    # einsum / convolution_backward: assume a square-ish contraction
+    return 2 * out_elems * 128
+
+
+def bsym_cost(bsym: BoundSymbol) -> tuple[int, int]:
+    """(flops, bytes) of one bound symbol. Composites recurse into their
+    decomposition (flops add; bytes are the composite's own boundary — the
+    decomposition is assumed to fuse)."""
+    if bsym.sym.id in _ZERO_COST_IDS:
+        return 0, 0
+    if OpTags.MATMUL_OP in bsym.sym.tags:
+        return _matmul_flops(bsym), _io_bytes(bsym)
+    if bsym.subsymbols:
+        flops = sum(bsym_cost(s)[0] for s in bsym.subsymbols)
+        return flops, _io_bytes(bsym)
+    out_elems = sum(tensor_bytes(p) // max(p.dtype.bytes, 1)
+                    for p in bsym.flat_proxy_outs() if isinstance(p, TensorProxy))
+    return out_elems, _io_bytes(bsym)
+
+
+def region_cost(bsyms) -> tuple[int, int]:
+    """(flops, boundary bytes) of a fused region: interior traffic is free."""
+    flops = sum(bsym_cost(b)[0] for b in bsyms)
+    produced = set()
+    counted = set()  # each boundary input is read once, however many members consume it
+    in_bytes = 0
+    for b in bsyms:
+        for v in consumed_vars(b):
+            if v not in produced and v not in counted:
+                counted.add(v)
+                in_bytes += tensor_bytes(v.proxy)
+        produced |= produced_vars(b)
+    # boundary outputs are unknowable without liveness; upper-bound with all
+    # produced top-level outputs
+    out_bytes = sum(tensor_bytes(p) for b in bsyms for p in b.flat_proxy_outs())
+    return flops, in_bytes + out_bytes
+
+
+def arithmetic_intensity(flops: int, nbytes: int) -> float:
+    return flops / nbytes if nbytes else float("inf")
+
+
+def is_memory_bound(flops: int, nbytes: int) -> bool:
+    return arithmetic_intensity(flops, nbytes) < TPU_RIDGE_FLOPS_PER_BYTE
+
+
+def claim_worthwhile(bsym: BoundSymbol) -> bool:
+    """Should a standalone custom-kernel claim of this op beat leaving it to
+    XLA fusion? Compute-bound ops (attention, big GEMM epilogues): always —
+    the hand kernel wins on FLOP scheduling. Memory-bound ops: only when the
+    working set is large enough to amortize a separate kernel launch."""
+    flops, nbytes = bsym_cost(bsym)
+    if not is_memory_bound(flops, nbytes):
+        return True
+    return nbytes >= MIN_CLAIM_BYTES
+
+
+def horizontal_merge_profitable(m_tokens: int, out_features) -> bool:
+    """Merge k sibling GEMMs (M×K)·(K×Nᵢ) into one (M×K)·(K×ΣNᵢ)?
+
+    Split traffic:  k reads of the M×K activation + ΣNᵢ·K weights.
+    Merged traffic: one M×K read + ΣNᵢ·K weights + a ΣNᵢ·K concat write
+    (the merged weight is materialized per step — weights are trace inputs).
+
+    Net win when (k-1)·M·K > ΣNᵢ·K, i.e. M·(k-1) > ΣNᵢ — the K and
+    element-size terms cancel, so only the token count and output widths
+    matter. Large-batch training merges (bench: M=16384, ΣNᵢ=12288 for 7B
+    QKV), tiny traces don't (pass ``horizontal_fusion=True`` to force).
+    """
+    outs = list(out_features)
+    if len(outs) < 2:
+        return False
+    return m_tokens * (len(outs) - 1) > sum(outs)

@@ -1,0 +1,415 @@
+"""Fusion 2.0 trace passes: horizontal GEMM merging + epilogue fusion.
+
+Two trace-to-trace rewrites that run at the top of
+``transform_for_execution`` (see ``thunder_tpu/executors/passes.py``),
+before executor claiming:
+
+**Horizontal fusion** (``horizontal_fusion_pass``): sibling ``dot_general``
+bound symbols that share one operand and the same contraction — the Q/K/V
+projections (shared activation, per-head weights) and parallel MLP gate/up
+projections — are rewritten into ONE concatenated GEMM plus per-sibling
+slices. The MXU then sees a single large matmul instead of k small ones:
+k-1 fewer reads of the shared operand, one kernel's worth of tiling
+overhead, and full 128-lane utilization even when an individual sibling's
+output width is sub-tile. Profitability comes from
+``core.cost_model.horizontal_merge_profitable`` (the concat write of the
+merged weight must be cheaper than the saved activation reads), overridable
+with the ``horizontal_fusion`` compile option (True = always, False =
+never).
+
+The pass matches at *prim* level (``PrimIDs.DOT_GENERAL``) because the
+autodiff replay decomposes ``nn.linear`` composites before this pass runs —
+matching prims catches the QKV pattern in training traces, not just
+inference ones.
+
+**Epilogue fusion** (``epilogue_fusion_pass``): declarative
+``core.patterns`` rewrites that roll elementwise producer chains into
+executor-claimable fused composites:
+
+- ``add(residual, x) → nn.rms_norm`` becomes ``nn.rms_norm_residual``
+  (both the residual stream and the normed value are produced by the fused
+  op — the escaping-intermediate form of ``patterns.rewrite``), claimed by
+  the Pallas executor as one kernel: the residual stream is read and
+  written once instead of round-tripping HBM between two kernels.
+- ``nn.linear → activation`` becomes ``nn.linear_act`` (GEMM epilogue:
+  bias + activation applied to the accumulator tile in VMEM).
+
+A match is only rewritten when some executor in the stack actually claims
+the fused composite (checker-approved); otherwise the original ops are
+kept, so an XLA-only stack compiles byte-identical traces.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core import cost_model
+from thunder_tpu.core.compile_data import get_compile_option
+from thunder_tpu.core.patterns import Pattern, rewrite
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
+
+HORIZONTAL_MARKER = "horizontal-fusion"
+EPILOGUE_MARKER = "epilogue-fusion"
+
+
+# ---------------------------------------------------------------------------
+# horizontal GEMM merging
+# ---------------------------------------------------------------------------
+
+def _dot_general_facts(bsym: BoundSymbol):
+    """(a, b, contract_dims, pet) for a mergeable GEMM bound symbol, or None.
+
+    Matches the raw ``DOT_GENERAL`` prim (training traces: the autodiff
+    replay works at prim level) AND the plain ``nn.linear`` composite
+    (inference traces) — but only a linear whose decomposition is exactly
+    one dot_general: a bias add, tensor-parallel collective, or fp8 path
+    adds subsymbols and such linears must not be silently rewritten to a
+    plain GEMM."""
+    if bsym.sym.id == "nn.linear":
+        if len(bsym.subsymbols) != 1:
+            return None
+        bsym = bsym.subsymbols[0]
+    if bsym.sym.id is not PrimIDs.DOT_GENERAL or len(bsym.args) < 2:
+        return None
+    a, b = bsym.args[0], bsym.args[1]
+    if not (isinstance(a, TensorProxy) and isinstance(b, TensorProxy)):
+        return None
+    contract = bsym.kwargs.get("contract_dims")
+    if contract is None and len(bsym.args) > 2:
+        contract = bsym.args[2]
+    batch = bsym.kwargs.get("batch_dims", ((), ()))
+    if contract is None or tuple(batch[0]) or tuple(batch[1]):
+        return None
+    pet = bsym.kwargs.get("preferred_element_type")
+    return a, b, (tuple(contract[0]), tuple(contract[1])), pet
+
+
+def _single_free_dim(t: TensorProxy, contracted: tuple[int, ...]) -> int | None:
+    free = [d for d in range(t.ndim) if d not in contracted]
+    return free[0] if len(free) == 1 else None
+
+
+def _dist_annotated(p) -> bool:
+    """Does this proxy carry distributed-parallel metadata? Merging such
+    operands is unsound: concatenating a sharded weight with a replicated
+    one produces a tensor whose sharding the spec propagation cannot
+    express, and the out_specs inferred for downstream grads go wrong."""
+    from thunder_tpu.core.proxies import DistParallelType
+
+    if getattr(p, "distparallel_type", DistParallelType.NONE) is not DistParallelType.NONE:
+        return True
+    return getattr(p, "dist_shard_axis", None) is not None
+
+
+def _merge_group(trc: TraceCtx, members: list[tuple[int, BoundSymbol, tuple]],
+                 shared_pos: int, free_dim: int) -> list[BoundSymbol]:
+    """Build the replacement bsyms for one sibling group: concat the varying
+    operands along their free dim, one merged dot_general, slices binding
+    the ORIGINAL output proxies (so downstream consumers are untouched)."""
+    from thunder_tpu import ops
+    from thunder_tpu.core import prims
+
+    varying_pos = 1 - shared_pos
+    _, _, facts0 = members[0]
+    shared = facts0[shared_pos]
+    contract, pet = facts0[2], facts0[3]
+    varying = [f[varying_pos] for _, _, f in members]
+    widths = [int(v.shape[free_dim]) for v in varying]
+
+    tmp = TraceCtx("horizontal_fusion")
+    tmp._names = trc._names  # share the name registry: no collisions
+    tmp._counters = trc._counters
+    with tracectx(tmp):
+        w_cat = ops.cat(list(varying), free_dim)
+        operands = (shared, w_cat) if shared_pos == 0 else (w_cat, shared)
+        kwargs = dict(contract_dims=contract)
+        if pet is not None:
+            kwargs["preferred_element_type"] = pet
+        merged = prims.dot_general(*operands, **kwargs)
+        # merged output: [a_free..., b_free] — the varying free dim is last
+        # when it comes from operand 1, first when from operand 0
+        slice_axis = merged.ndim - 1 if varying_pos == 1 else 0
+        offset = 0
+        parts = []
+        for w in widths:
+            parts.append(ops.narrow(merged, slice_axis, offset, w))
+            offset += w
+    # rebind the slice outputs to the original member outputs
+    swap = {}
+    for (_, m, _f), part in zip(members, parts):
+        old = m.flat_proxy_outs()[0]
+        new = part if isinstance(part, Proxy) else None
+        if new is not None and new.name != old.name:
+            swap[Variable(new)] = old
+    out = [b.from_bsym_swap_proxies(swap) for b in tmp.bound_symbols]
+    for b in out:
+        if b.sym.id is PrimIDs.DOT_GENERAL:
+            b.header = (f"{HORIZONTAL_MARKER}: merged {len(members)} sibling "
+                        f"dot_generals (widths {'+'.join(map(str, widths))})")
+    return out
+
+
+def horizontal_fusion_pass(trc: TraceCtx) -> TraceCtx:
+    """Merge sibling same-shape GEMMs over a shared operand (QKV pattern)."""
+    enabled = get_compile_option(
+        "horizontal_fusion",
+        "merge sibling dot_generals sharing an operand (QKV / MLP gate+up) into one "
+        "concatenated GEMM: True = always, False = never, unset = cost-model decision",
+        None)
+    if enabled is False:
+        return trc
+    bsyms = trc.bound_symbols
+
+    defined_at: dict[str, int] = {}
+    for p in trc.args:
+        if isinstance(p, Proxy):
+            defined_at[p.name] = -1
+    for i, b in enumerate(bsyms):
+        for o in b.flat_proxy_outs():
+            defined_at.setdefault(o.name, i)
+
+    # candidate groups: same shared operand (by name and position), same
+    # contraction spec, compatible varying operands (one free dim, same
+    # dtype); keyed so only genuinely mergeable siblings collide
+    groups: dict[tuple, list] = {}
+    for i, b in enumerate(bsyms):
+        facts = _dot_general_facts(b)
+        if facts is None:
+            continue
+        contract, pet = facts[2], facts[3]
+        outs = b.flat_proxy_outs()
+        if len(outs) != 1:
+            continue
+        if _dist_annotated(facts[0]) or _dist_annotated(facts[1]):
+            continue
+        for shared_pos in (0, 1):
+            shared = facts[shared_pos]
+            varying = facts[1 - shared_pos]
+            vc = contract[1 - shared_pos]
+            free_dim = _single_free_dim(varying, vc)
+            if free_dim is None:
+                continue
+            key = (shared.name, shared_pos, contract, str(pet),
+                   varying.dtype.name, varying.ndim, free_dim,
+                   outs[0].dtype.name)
+            groups.setdefault(key, []).append((i, b, facts))
+
+    merged_ids: set[int] = set()
+    replacements: dict[int, list[BoundSymbol]] = {}  # first-member index -> bsyms
+    dropped: set[int] = set()
+    n_merged = 0
+    for key, members in groups.items():
+        shared_pos, free_dim = key[1], key[6]
+        varying_pos = 1 - shared_pos
+        members = [m for m in members if id(m[1]) not in merged_ids]
+        if len(members) < 2:
+            continue
+        members.sort(key=lambda t: t[0])
+        first_idx = members[0][0]
+        # every varying operand must already be defined where the merged op
+        # lands (the first member's position) — trace args and upstream
+        # values qualify, results of later bsyms don't
+        members = [m for m in members
+                   if defined_at.get(m[2][varying_pos].name, m[0]) < first_idx]
+        if len(members) < 2:
+            continue
+        shared = members[0][2][shared_pos]
+        contract = key[2]
+        sc = contract[shared_pos]
+        m_tokens = 1
+        for d in range(shared.ndim):
+            if d not in sc:
+                m_tokens *= int(shared.shape[d])
+        widths = [int(m[2][varying_pos].shape[free_dim]) for m in members]
+        if enabled is not True and not cost_model.horizontal_merge_profitable(
+                m_tokens, widths):
+            continue
+        replacements[first_idx] = _merge_group(trc, members, shared_pos, free_dim)
+        dropped.update(m[0] for m in members[1:])
+        merged_ids.update(id(m[1]) for m in members)
+        n_merged += 1
+
+    if not replacements:
+        return trc
+    new = from_trace(trc)
+    out: list[BoundSymbol] = []
+    for i, b in enumerate(bsyms):
+        if i in replacements:
+            out.extend(replacements[i])
+        elif i not in dropped:
+            out.append(b)
+    new.bound_symbols = out
+    new.set_provenance(f"Horizontal fusion ({n_merged} sibling GEMM groups merged)")
+    return new
+
+
+# ---------------------------------------------------------------------------
+# epilogue fusion (pattern rewrites to claimable fused composites)
+# ---------------------------------------------------------------------------
+
+def _some_executor_claims(executors, op_id: str, args, kwargs, outs) -> bool:
+    """Would some executor actually claim the fused composite? Probes BOTH
+    the legality checker and the cost-model ``profitable`` gate (with a
+    throwaway bound symbol carrying the real arg/output proxies) so the
+    rewrite never builds a composite the claim walk then rejects and
+    decomposes right back."""
+    for ex in executors:
+        impl = ex.implmap.get(op_id)
+        if impl is None or impl.symbol is None:
+            continue
+        try:
+            if impl.checker is not None and not impl.checker(*args, **kwargs):
+                continue
+            if impl.profitable is not None:
+                probe = impl.symbol.bind(*args, output=tuple(outs), **kwargs)
+                if not impl.profitable(probe):
+                    continue
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def _build_composite(trc: TraceCtx, op, args, kwargs, old_outs) -> list[BoundSymbol] | None:
+    """Trace ``op(*args, **kwargs)`` into fresh bsyms and rebind its outputs
+    to ``old_outs`` (the proxies downstream consumers already reference)."""
+    from thunder_tpu.core.pytree import tree_flatten
+
+    tmp = TraceCtx("epilogue_fusion")
+    tmp._names = trc._names
+    tmp._counters = trc._counters
+    with tracectx(tmp):
+        out = op(*args, **kwargs)
+    new_flat = [o for o in tree_flatten(out)[0] if isinstance(o, Proxy)]
+    if len(new_flat) != len(old_outs):
+        return None
+    # metadata parity: the retrace runs OUTSIDE the original trace-affecting
+    # contexts (autocast), so a chain whose recorded output dtype/shape came
+    # from such a context rebuilds differently — rebinding would make the
+    # trace metadata lie about the runtime values; keep the original ops
+    for n, o in zip(new_flat, old_outs):
+        if (getattr(n, "dtype", None) != getattr(o, "dtype", None)
+                or tuple(getattr(n, "shape", ())) != tuple(getattr(o, "shape", ()))):
+            return None
+    swap = {Variable(n): o for n, o in zip(new_flat, old_outs) if n.name != o.name}
+    return [b.from_bsym_swap_proxies(swap) for b in tmp.bound_symbols]
+
+
+def _rms_residual_pattern(executors) -> tuple[Pattern, callable]:
+    def is_residual_add(b, env):
+        # prim-level in training traces (autodiff replay), composite-level in
+        # inference traces
+        if b.sym.id not in (PrimIDs.ADD, "ops.add"):
+            return False
+        if len(b.args) != 2:
+            return False
+        r, x = b.args
+        if not (isinstance(r, TensorProxy) and isinstance(x, TensorProxy)):
+            return False
+        if tuple(r.shape) != tuple(x.shape) or r.dtype != x.dtype:
+            return False
+        env["add_out"] = b.flat_proxy_outs()[0]
+        return True
+
+    def is_trailing_rms(b, env):
+        if b.sym.id != "nn.rms_norm":
+            return False
+        a = b.args[0] if b.args else None
+        if not isinstance(a, Proxy) or a.name != env["add_out"].name:
+            return False
+        dim = b.kwargs.get("dim", b.args[3] if len(b.args) > 3 else -1)
+        return dim in (-1, a.ndim - 1)
+
+    p = Pattern("rms_norm_residual").step(is_residual_add).step(is_trailing_rms)
+
+    def build(trc, matched, env):
+        from thunder_tpu.ops import nn as tnn
+
+        add_b, rms_b = matched
+        res, x = add_b.args
+        h = add_b.flat_proxy_outs()[0]
+        normed = rms_b.flat_proxy_outs()[0]
+        weight = rms_b.args[1] if len(rms_b.args) > 1 else rms_b.kwargs.get("weight")
+        eps = rms_b.kwargs.get("eps", rms_b.args[2] if len(rms_b.args) > 2 else 1e-5)
+        if not _some_executor_claims(executors, "nn.rms_norm_residual",
+                                     (res, x, weight), {"eps": eps}, (h, normed)):
+            return None
+        repl = _build_composite(trc, tnn.rms_norm_residual, (res, x, weight),
+                                {"eps": eps}, [h, normed])
+        if repl:
+            repl[-1].header = f"{EPILOGUE_MARKER}: residual add absorbed into rms_norm"
+        return repl
+
+    return p, build
+
+
+_ACT_IDS = {"ops.relu": "relu", "ops.silu": "silu", "ops.gelu": "gelu"}
+
+
+def _linear_act_pattern(executors) -> tuple[Pattern, callable]:
+    def is_linear(b, env):
+        if b.sym.id != "nn.linear":
+            return False
+        # a TP-annotated linear embeds collectives in its decomposition
+        # (synchronize_tp_input/output); claiming the fused composite would
+        # run a plain local GEMM and silently drop the reduction
+        if any(_dist_annotated(p) for p in b.flat_proxy_args()):
+            return False
+        env["lin_out"] = b.flat_proxy_outs()[0]
+        return True
+
+    def is_act(b, env):
+        act = _ACT_IDS.get(b.sym.id)
+        if act is None:
+            return False
+        a = b.args[0] if b.args else None
+        if not isinstance(a, Proxy) or a.name != env["lin_out"].name:
+            return False
+        if act == "gelu":
+            approx = b.kwargs.get("approximate",
+                                  b.args[1] if len(b.args) > 1 else "none")
+            act = "gelu_tanh" if approx == "tanh" else "gelu"
+        env["act"] = act
+        return True
+
+    p = Pattern("linear_act").step(is_linear).step(is_act)
+
+    def build(trc, matched, env):
+        from thunder_tpu.ops import nn as tnn
+
+        lin_b, act_b = matched
+        a, w = lin_b.args[0], lin_b.args[1]
+        bias = lin_b.args[2] if len(lin_b.args) > 2 else lin_b.kwargs.get("bias")
+        out = act_b.flat_proxy_outs()[0]
+        act = env["act"]
+        if not _some_executor_claims(executors, "nn.linear_act",
+                                     (a, w, bias), {"act": act}, (out,)):
+            return None
+        repl = _build_composite(trc, tnn.linear_act, (a, w, bias), {"act": act}, [out])
+        if repl:
+            repl[-1].header = f"{EPILOGUE_MARKER}: {act} epilogue fused into linear"
+        return repl
+
+    return p, build
+
+
+def epilogue_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
+    """Rewrite elementwise-epilogue chains into claimable fused composites."""
+    if not get_compile_option(
+            "epilogue_fusion",
+            "rewrite residual+rms_norm and linear+activation chains into fused "
+            "composites (nn.rms_norm_residual / nn.linear_act) when an executor "
+            "in the stack claims them", True):
+        return trc
+    # cheap anchor scan first: this pass runs on EVERY compile, and each
+    # pattern's trailing step needs a specific composite id — when none is
+    # present (most traces), skip matching entirely
+    ids = {b.sym.id for b in trc.bound_symbols}
+    if "nn.rms_norm" in ids:
+        p, build = _rms_residual_pattern(executors)
+        trc = rewrite(trc, p, build, allow_escaping_intermediates=True)
+    if "nn.linear" in ids and not ids.isdisjoint(_ACT_IDS):
+        p, build = _linear_act_pattern(executors)
+        trc = rewrite(trc, p, build)
+    return trc

@@ -46,23 +46,30 @@ class Pattern:
         return self.step(lambda b, env, _id=op_id: b.sym.id == _id)
 
     # -- matching ----------------------------------------------------------
-    def find(self, trc: TraceCtx) -> list[tuple[list[int], dict]]:
-        """All non-overlapping matches, each as (bsym indices, env)."""
+    def find(self, trc: TraceCtx,
+             consumers: dict[Variable, list[int]] | None = None) -> list[tuple[list[int], dict]]:
+        """All non-overlapping matches, each as (bsym indices, env).
+
+        ``consumers`` (var -> ascending consumer bsym indices) may be passed
+        in when the caller already built one (``rewrite`` shares its map);
+        otherwise it is built here, ONCE. The successor search in _try walks
+        consumers of a step's outputs directly (typically 1-2 bsyms) instead
+        of rescanning every later bsym — this pass runs on every compile,
+        and the linear rescan made matching quadratic on deep backward
+        traces."""
         bsyms = trc.bound_symbols
         n = len(bsyms)
         taken: set[int] = set()
         matches: list[tuple[list[int], dict]] = []
 
-        producers: dict[Variable, int] = {}
-        for i, b in enumerate(bsyms):
-            for o in b.flat_proxy_outs():
-                producers[Variable(o)] = i
+        if consumers is None:
+            consumers = _consumer_index(bsyms)
 
         for start in range(n):
             if start in taken:
                 continue
             env: dict = {}
-            if not self._try(bsyms, start, 0, env_chain := [start], env, taken):
+            if not self._try(bsyms, start, 0, env_chain := [start], env, taken, consumers):
                 continue
             idxs = env_chain
             if any(i in taken for i in idxs):
@@ -71,7 +78,8 @@ class Pattern:
             taken.update(idxs)
         return matches
 
-    def _try(self, bsyms, idx: int, step: int, chain: list[int], env: dict, taken) -> bool:
+    def _try(self, bsyms, idx: int, step: int, chain: list[int], env: dict, taken,
+             consumers) -> bool:
         b = bsyms[idx]
         if b.sym.id in (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL):
             return False
@@ -85,36 +93,46 @@ class Pattern:
             del chain[step + 1:]
             return True
         # successor: a later bsym consuming one of this bsym's outputs
-        out_vars = {Variable(o) for o in b.flat_proxy_outs()}
-        for j in range(idx + 1, len(bsyms)):
+        cand: set[int] = set()
+        for o in b.flat_proxy_outs():
+            cand.update(j for j in consumers.get(Variable(o), ()) if j > idx)
+        for j in sorted(cand):
             if j in taken:
                 continue
-            nxt = bsyms[j]
-            if any(Variable(a) in out_vars for a in nxt.flat_proxy_args()):
-                chain[step + 1:] = [j]
-                saved = dict(env)
-                if self._try(bsyms, j, step + 1, chain, env, taken):
-                    return True
-                env.clear()
-                env.update(saved)
+            chain[step + 1:] = [j]
+            saved = dict(env)
+            if self._try(bsyms, j, step + 1, chain, env, taken, consumers):
+                return True
+            env.clear()
+            env.update(saved)
         return False
 
 
-def _escapees(bsyms: list[BoundSymbol], idxs: list[int], trc: TraceCtx) -> set[Variable]:
-    """Vars produced inside the match and consumed outside it (or returned)."""
+def _consumer_index(bsyms) -> dict[Variable, list[int]]:
+    """var -> ascending indices of the bsyms consuming it as an argument."""
+    consumers: dict[Variable, list[int]] = {}
+    for i, b in enumerate(bsyms):
+        for a in b.flat_proxy_args():
+            consumers.setdefault(Variable(a), []).append(i)
+    return consumers
+
+
+def _escapees(bsyms: list[BoundSymbol], idxs: list[int], trc: TraceCtx,
+              consumers: dict[Variable, list[int]]) -> set[Variable]:
+    """Vars produced inside the match and consumed outside it (or returned).
+
+    ``consumers`` is the var -> consumer-indices map built once per
+    ``rewrite`` call, so each match costs O(its own outputs), not a rescan
+    of the whole trace."""
     inside = set(idxs)
     produced: set[Variable] = set()
     for i in idxs:
         for o in bsyms[i].flat_proxy_outs():
             produced.add(Variable(o))
     escaped: set[Variable] = set()
-    for j, b in enumerate(bsyms):
-        if j in inside:
-            continue
-        for a in b.flat_proxy_args():
-            v = Variable(a)
-            if v in produced:
-                escaped.add(v)
+    for v in produced:
+        if any(j not in inside for j in consumers.get(v, ())):
+            escaped.add(v)
     from thunder_tpu.core.pytree import tree_flatten
 
     for o in tree_flatten(trc.output)[0]:
@@ -125,30 +143,53 @@ def _escapees(bsyms: list[BoundSymbol], idxs: list[int], trc: TraceCtx) -> set[V
 
 def rewrite(trc: TraceCtx, pattern: Pattern,
             builder: Callable[[TraceCtx, list[BoundSymbol], dict], list[BoundSymbol]],
-            allow_escaping_last: bool = True) -> TraceCtx:
+            allow_escaping_last: bool = True,
+            allow_escaping_intermediates: bool = False) -> TraceCtx:
     """Replace each match with ``builder(trc, matched_bsyms, env)``'s bsyms.
 
     A match is rewritten only if no *intermediate* value escapes the chain —
     the final step's outputs may escape (``allow_escaping_last``); the
     builder's replacement must produce those same output proxies.
+
+    ``allow_escaping_intermediates=True`` relaxes this for multi-output
+    fusions (e.g. residual-add + norm, where the residual stream AND the
+    normed value both live on): a match with escaping intermediates is still
+    rewritten, but only when the builder's replacement bsyms produce every
+    escaping proxy — validated here, so an incomplete replacement silently
+    skips the match instead of corrupting the trace.
     """
-    matches = pattern.find(trc)
+    bsyms = list(trc.bound_symbols)
+    consumers = _consumer_index(bsyms)
+    matches = pattern.find(trc, consumers)
     if not matches:
         return trc
-    bsyms = list(trc.bound_symbols)
     to_replace: dict[int, list[BoundSymbol]] = {}
     dropped: set[int] = set()
     for idxs, env in matches:
         last = idxs[-1]
-        esc = _escapees(bsyms, idxs, trc)
+        esc = _escapees(bsyms, idxs, trc, consumers)
         last_outs = {Variable(o) for o in bsyms[last].flat_proxy_outs()}
         inner_escapes = esc - (last_outs if allow_escaping_last else set())
-        if inner_escapes:
+        if inner_escapes and not allow_escaping_intermediates:
             continue  # intermediates used elsewhere: unsafe to fuse
+        if inner_escapes:
+            # the replacement lands at the LAST matched index; a consumer of
+            # an escaping intermediate sitting BETWEEN the matched bsyms
+            # would then read the value before the fused op defines it
+            from thunder_tpu.core.utils import consumed_vars
+
+            inside = set(idxs)
+            if any(j not in inside and inner_escapes & consumed_vars(bsyms[j])
+                   for j in range(idxs[0] + 1, last)):
+                continue
         matched = [bsyms[i] for i in idxs]
         replacement = builder(trc, matched, env)
         if replacement is None:
             continue
+        if inner_escapes:
+            produced = {Variable(o) for b in replacement for o in b.flat_proxy_outs()}
+            if not inner_escapes <= produced:
+                continue  # replacement drops a live value: keep the original
         to_replace[last] = replacement
         dropped.update(i for i in idxs if i != last)
     if not to_replace:

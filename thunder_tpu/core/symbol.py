@@ -156,7 +156,14 @@ class Symbol:
 
 
 class BoundSymbol:
-    __slots__ = ("sym", "args", "kwargs", "output", "subsymbols", "_call_ctx", "header")
+    # _consumed_cache/_produced_cache memoize core.utils.consumed_vars /
+    # produced_vars (recomputed by every pass — DCE, CSE, remat, partitioner,
+    # comm_reorder — making trace transforms super-linear on deep models).
+    # Safe because bound symbols are dataflow-immutable after construction:
+    # every rewrite (from_bsym, from_bsym_swap_proxies, executor claiming)
+    # builds a NEW BoundSymbol rather than mutating args/output/subsymbols.
+    __slots__ = ("sym", "args", "kwargs", "output", "subsymbols", "_call_ctx", "header",
+                 "_consumed_cache", "_produced_cache")
 
     def __init__(self, sym: Symbol, args: Sequence, kwargs: dict, output: Any, subsymbols: list):
         self.sym = sym
@@ -166,6 +173,8 @@ class BoundSymbol:
         self.subsymbols = subsymbols
         self._call_ctx: dict[str, Any] | None = None  # extra ctx (fusion callables)
         self.header: str | None = None
+        self._consumed_cache: frozenset | None = None
+        self._produced_cache: frozenset | None = None
 
     # -- dataflow ----------------------------------------------------------
     def flat_args(self) -> list:

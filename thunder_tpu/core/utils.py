@@ -13,15 +13,33 @@ from thunder_tpu.core.proxies import Proxy, Variable
 from thunder_tpu.core.symbol import BoundSymbol
 
 
-def produced_vars(bsym: BoundSymbol) -> set[Variable]:
+def produced_vars(bsym: BoundSymbol) -> frozenset[Variable]:
+    """All variables produced by a bound symbol (recursing into subsymbols).
+
+    Memoized per BoundSymbol (``_produced_cache``): every pass — DCE, CSE,
+    remat, the partitioner, comm_reorder — recomputes this for the same
+    bsyms, and the recursive tree-flatten walk made trace transforms
+    super-linear on deep models. Bound symbols are dataflow-immutable after
+    construction (rewrites build new objects), so the cache never goes stale.
+    Returns a frozenset; callers must not mutate the result.
+    """
+    cached = bsym._produced_cache
+    if cached is not None:
+        return cached
     out = {Variable(p) for p in bsym.flat_proxy_outs()}
     for sub in bsym.subsymbols:
         out |= produced_vars(sub)
-    return out
+    result = frozenset(out)
+    bsym._produced_cache = result
+    return result
 
 
-def consumed_vars(bsym: BoundSymbol) -> set[Variable]:
-    """Free proxy inputs of a bound symbol (recursing into subsymbols)."""
+def consumed_vars(bsym: BoundSymbol) -> frozenset[Variable]:
+    """Free proxy inputs of a bound symbol (recursing into subsymbols).
+    Memoized like ``produced_vars``; returns a frozenset."""
+    cached = bsym._consumed_cache
+    if cached is not None:
+        return cached
     produced: set[Variable] = set()
     consumed: set[Variable] = set()
 
@@ -38,7 +56,9 @@ def consumed_vars(bsym: BoundSymbol) -> set[Variable]:
             produced.add(Variable(p))
 
     walk(bsym)
-    return consumed
+    result = frozenset(consumed)
+    bsym._consumed_cache = result
+    return result
 
 
 def producers(bsyms) -> dict[Variable, BoundSymbol]:

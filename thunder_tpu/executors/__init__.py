@@ -19,19 +19,33 @@ from thunder_tpu.core.symbol import BoundSymbol, Symbol
 
 
 class ImplInfo:
-    """How an executor implements one symbol id."""
+    """How an executor implements one symbol id.
 
-    __slots__ = ("symbol", "checker", "execution_transform", "grad_transform")
+    ``checker`` answers *can* this executor run the bsym (shape/dtype/tiling
+    legality); ``profitable`` answers *should* it (cost-model gate: a legal
+    claim may still lose to leaving the op inside an XLA fusion region).
+    Both default to yes."""
+
+    __slots__ = ("symbol", "checker", "execution_transform", "grad_transform", "profitable")
 
     def __init__(self, symbol: Symbol | None = None, checker: Callable | None = None,
-                 execution_transform: Callable | None = None, grad_transform: Callable | None = None):
+                 execution_transform: Callable | None = None, grad_transform: Callable | None = None,
+                 profitable: Callable | None = None):
         self.symbol = symbol
         self.checker = checker
         self.execution_transform = execution_transform
         self.grad_transform = grad_transform
+        self.profitable = profitable
 
 
 class Executor:
+    # executors that opt in allow the XLA fusion pass to ABSORB their claimed
+    # bound symbols into jit regions (the claimed python_impl must be
+    # jax-traceable, e.g. a pallas_call): elementwise producers/consumers
+    # then fuse around the custom kernel inside one XLA program instead of
+    # the claim splitting the region at both kernel boundaries
+    fusible_into_regions = False
+
     def __init__(self, name: str, version: str = "0.1"):
         self.name = name
         self.version = version
@@ -70,11 +84,13 @@ class OperatorExecutor(Executor):
     def register_implementation(self, id_or_sym, op: Symbol | None = None, *,
                                 checker: Callable | None = None,
                                 execution_transform: Callable | None = None,
-                                grad_transform: Callable | None = None) -> None:
+                                grad_transform: Callable | None = None,
+                                profitable: Callable | None = None) -> None:
         sym_id = id_or_sym.id if isinstance(id_or_sym, Symbol) else id_or_sym
         self.implmap[sym_id] = ImplInfo(symbol=op, checker=checker,
                                         execution_transform=execution_transform,
-                                        grad_transform=grad_transform)
+                                        grad_transform=grad_transform,
+                                        profitable=profitable)
 
 
 class FusionExecutor(Executor):

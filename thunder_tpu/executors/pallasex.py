@@ -634,6 +634,10 @@ def _rms_checker(a, weight=None, eps=1e-5, dim=-1):
         return False
     if weight is not None and weight.ndim != 1:
         return False
+    # a wider weight dtype promotes the composite's output (normed·w); the
+    # kernel emits a.dtype — reject rather than silently narrow
+    if weight is not None and weight.dtype != a.dtype:
+        return False
     if _interpret():
         return True
     D = a.shape[-1]
@@ -646,10 +650,196 @@ def _rms_checker(a, weight=None, eps=1e-5, dim=-1):
 
 
 # ---------------------------------------------------------------------------
+# fused rms_norm + residual (epilogue fusion: the residual stream is read
+# and written ONCE instead of round-tripping HBM between an add kernel and
+# the norm kernel; claimed from the nn.rms_norm_residual composite built by
+# core.fusion_passes.epilogue_fusion_pass)
+# ---------------------------------------------------------------------------
+
+def _rms_res_kernel(r_ref, x_ref, w_ref, h_ref, o_ref, *, eps: float, cast):
+    h = r_ref[...] + x_ref[...]     # input dtype: matches the unfused add
+    h_ref[...] = h.astype(h_ref.dtype)
+    x32 = h.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = (x32 * jax.lax.rsqrt(ms + eps)).astype(cast)
+    if w_ref is not None:
+        y = y * w_ref[...]
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def pallas_rms_norm_residual(residual, a, weight=None, eps=1e-5):
+    orig_shape = a.shape
+    D = a.shape[-1]
+    N = a.size // D
+    r2 = residual.reshape(N, D)
+    x2 = a.reshape(N, D)
+    # 2 input + 2 output row streams double-buffer per grid step — half the
+    # single-tensor rms_norm budget so the combined VMEM footprint matches
+    bn = _pick_block(N, max(8, min(128, (1024 * 1024) // (D * 4))))
+    extra = _grid_params("parallel")
+    out_shapes = [jax.ShapeDtypeStruct((N, D), a.dtype),
+                  jax.ShapeDtypeStruct((N, D), a.dtype)]
+    row_spec = pl.BlockSpec((bn, D), lambda i: (i, 0))
+    if weight is None:
+        def kernel_nw(r_ref, x_ref, h_ref, o_ref):
+            _rms_res_kernel(r_ref, x_ref, None, h_ref, o_ref, eps=eps, cast=a.dtype)
+
+        h, out = pl.pallas_call(
+            kernel_nw, grid=(N // bn,),
+            in_specs=[row_spec, row_spec],
+            out_specs=[row_spec, row_spec],
+            out_shape=out_shapes, interpret=_interpret(), **extra,
+        )(r2, x2)
+    else:
+        h, out = pl.pallas_call(
+            functools.partial(_rms_res_kernel, eps=eps, cast=a.dtype),
+            grid=(N // bn,),
+            in_specs=[row_spec, row_spec, pl.BlockSpec((D,), lambda i: (0,))],
+            out_specs=[row_spec, row_spec],
+            out_shape=out_shapes, interpret=_interpret(), **extra,
+        )(r2, x2, weight)
+    return h.reshape(orig_shape), out.reshape(orig_shape)
+
+
+def _rms_res_checker(residual, a, weight=None, eps=1e-5):
+    if tuple(residual.shape) != tuple(a.shape) or residual.dtype != a.dtype:
+        return False
+    # the kernel computes row statistics in f32; claiming an f64 composite
+    # (x64 mode) would silently narrow — reject, keep the f64 decomposition
+    if a.dtype.bytes > 4:
+        return False
+    if not _rms_checker(a, weight, eps):  # includes the weight-dtype match
+        return False
+    if _interpret():
+        return True
+    # the fused kernel stages 2 input + 2 output tiles per grid step —
+    # twice pallas_rms_norm's footprint, so halve its admitted D range
+    return 2 * 8 * int(a.shape[-1]) * 8 <= 3 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# fused linear + bias + activation (GEMM epilogue: the activation runs on
+# the f32 accumulator tile while it is still in VMEM; claimed from the
+# nn.linear_act composite built by the epilogue fusion pass)
+# ---------------------------------------------------------------------------
+
+_ACT_IMPLS = {
+    "relu": lambda y: jnp.maximum(y, 0.0),
+    "silu": lambda y: y * jax.nn.sigmoid(y),
+    "gelu": lambda y: jax.nn.gelu(y, approximate=False),
+    "gelu_tanh": lambda y: jax.nn.gelu(y, approximate=True),
+}
+
+
+def _linear_act_kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, act: str, nk: int):
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # (bm, bk) x (bn, bk)^T with f32 accumulation — torch weight layout
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        y = acc_ref[...]
+        if b_ref is not None:
+            y = y + b_ref[...].astype(jnp.float32)
+        y = _ACT_IMPLS[act](y)
+        o_ref[...] = y.astype(o_ref.dtype)
+
+
+def pallas_linear_act(a, w, bias=None, act: str = "relu"):
+    orig_shape = a.shape
+    K = a.shape[-1]
+    M = a.size // K
+    Nf = w.shape[0]
+    x2 = a.reshape(M, K)
+    bm = _pick_block(M, 256)
+    bn = _pick_block(Nf, 256)
+    bk = _pick_block(K, 512)
+    grid = (M // bm, Nf // bn, K // bk)
+    x_spec = pl.BlockSpec((bm, bk), lambda i, j, k: (i, k))
+    w_spec = pl.BlockSpec((bn, bk), lambda i, j, k: (j, k))
+    o_spec = pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))
+    out_shape = jax.ShapeDtypeStruct((M, Nf), a.dtype)
+    scratch = [pltpu.VMEM((bm, bn), jnp.float32)]
+    if bias is None:
+        def kernel_nb(x_ref, w_ref, o_ref, acc_ref):
+            _linear_act_kernel(x_ref, w_ref, None, o_ref, acc_ref, act=act, nk=grid[2])
+
+        out = pl.pallas_call(
+            kernel_nb, grid=grid, in_specs=[x_spec, w_spec], out_specs=o_spec,
+            out_shape=out_shape, scratch_shapes=scratch, interpret=_interpret(),
+        )(x2, w)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_linear_act_kernel, act=act, nk=grid[2]),
+            grid=grid,
+            in_specs=[x_spec, w_spec, pl.BlockSpec((1, bn), lambda i, j, k: (0, j))],
+            out_specs=o_spec, out_shape=out_shape, scratch_shapes=scratch,
+            interpret=_interpret(),
+        )(x2, w, bias.reshape(1, Nf))
+    return out.reshape(orig_shape[:-1] + (Nf,))
+
+
+def _linear_act_checker(a, w, bias=None, act: str = "relu"):
+    if not _enabled() or act not in _ACT_IMPLS:
+        return False
+    if a.ndim < 2 or w.ndim != 2 or a.shape[-1] != w.shape[1]:
+        return False
+    if a.dtype != w.dtype or not a.dtype.is_float:
+        return False
+    # accumulation is f32 (preferred_element_type); claiming an f64 GEMM
+    # (x64 mode) would silently narrow — reject, keep the f64 decomposition
+    if a.dtype.bytes > 4:
+        return False
+    # a wider bias dtype promotes the composite's output through the bias
+    # add; the kernel emits a.dtype — reject rather than silently narrow
+    if bias is not None and (bias.ndim != 1 or bias.shape[0] != w.shape[0]
+                             or bias.dtype != a.dtype):
+        return False
+    if _interpret():
+        return True
+    K, Nf = a.shape[-1], w.shape[0]
+    M = 1
+    for d in a.shape[:-1]:
+        M *= int(d)
+    return K % 128 == 0 and Nf % 128 == 0 and M % 8 == 0
+
+
+def _pallas_claim_profitable(bsym):
+    """Cost-model claim gate (``ImplInfo.profitable``): on real TPU a
+    memory-bound claim with a tiny working set loses to leaving the op
+    inside an XLA fusion region (kernel launch + pipeline fill dominate);
+    in interpret mode cost ratios are meaningless, so always claim — the
+    CPU test suite exercises kernels that way."""
+    if _interpret():
+        return True
+    from thunder_tpu.core.compile_data import get_compile_option
+
+    if not get_compile_option(
+            "fusion_cost_model",
+            "gate memory-bound Pallas claims on the roofline cost model "
+            "(claims moving under ~1 MiB stay inside XLA fusion regions)", True):
+        return True
+    from thunder_tpu.core.cost_model import claim_worthwhile
+
+    return claim_worthwhile(bsym)
+
+
+# ---------------------------------------------------------------------------
 # registration: claim the nn composite symbols
 # ---------------------------------------------------------------------------
 
 if PALLAS_AVAILABLE:
+    # pallas_call impls are jax-traceable: the XLA fusion pass may absorb
+    # claimed kernels INTO its jit regions (see XLAFusionExecutor.can_absorb)
+    ex.fusible_into_regions = True
+
     _sdpa_sym = get_op("nn.sdpa_fwd")
     _sdpa_bwd_sym = get_op("nn.sdpa_bwd")
     _ce_sym = get_op("nn.ce_fwd")
@@ -662,8 +852,23 @@ if PALLAS_AVAILABLE:
 
     ex.register_implementation("nn.sdpa_fwd", sdpa_fwd_op, checker=_sdpa_checker)
     ex.register_implementation("nn.sdpa_bwd", sdpa_bwd_op, checker=_sdpa_bwd_checker)
-    ex.register_implementation("nn.ce_fwd", ce_fwd_op, checker=_ce_checker)
-    ex.register_implementation("nn.rms_norm", rms_norm_op, checker=_rms_checker)
+    ex.register_implementation("nn.ce_fwd", ce_fwd_op, checker=_ce_checker,
+                               profitable=_pallas_claim_profitable)
+    ex.register_implementation("nn.rms_norm", rms_norm_op, checker=_rms_checker,
+                               profitable=_pallas_claim_profitable)
+
+    _rms_res_sym = get_op("nn.rms_norm_residual")
+    _linear_act_sym = get_op("nn.linear_act")
+    rms_norm_residual_op = ex.register_operator(
+        "rms_norm_residual", meta=_rms_res_sym.meta, fn=pallas_rms_norm_residual)
+    linear_act_op = ex.register_operator(
+        "linear_act", meta=_linear_act_sym.meta, fn=pallas_linear_act)
+    ex.register_implementation("nn.rms_norm_residual", rms_norm_residual_op,
+                               checker=_rms_res_checker,
+                               profitable=_pallas_claim_profitable)
+    ex.register_implementation("nn.linear_act", linear_act_op,
+                               checker=_linear_act_checker,
+                               profitable=_pallas_claim_profitable)
 
     # inference-path SDPA (no lse output needed)
     def pallas_sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):

@@ -51,13 +51,26 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
         impl = ex.get_impl(bsym)
         if impl is None or not ex.can_execute(bsym):
             continue
+        # cost-model gate: a legal claim may still lose to leaving the op
+        # inside an XLA fusion region (memory-bound op, tiny working set).
+        # Exceptions fail CLOSED (no claim), mirroring the checker path —
+        # a broken cost model must not silently disable the gate
+        if impl.profitable is not None:
+            try:
+                profitable = bool(impl.profitable(bsym))
+            except Exception:
+                profitable = False
+            if not profitable:
+                continue
         if not getattr(ex, "get_fuel", lambda *_: True)():
             continue
         if impl.execution_transform is not None:
             return _run_execution_transform(impl.execution_transform, bsym, trc)
         if impl.symbol is not None:
-            return [impl.symbol.bind(*bsym.args, output=bsym.output,
-                                     subsymbols=bsym.subsymbols, **bsym.kwargs)]
+            claimed = impl.symbol.bind(*bsym.args, output=bsym.output,
+                                       subsymbols=bsym.subsymbols, **bsym.kwargs)
+            claimed.header = bsym.header  # keep pass annotations (fusion markers)
+            return [claimed]
     from thunder_tpu.executors.eagerjax import get_eager_impl
 
     if bsym.sym.is_prim:
@@ -80,7 +93,15 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
 
 
 def transform_for_execution(trc: TraceCtx, executors) -> TraceCtx:
-    """Claim pass + fusion passes + DCE (reference ``passes.py:136``)."""
+    """Fusion-prep passes + claim pass + fusion passes + DCE (reference
+    ``passes.py:136``, extended with the Fusion 2.0 rewrites)."""
+    from thunder_tpu.core.fusion_passes import epilogue_fusion_pass, horizontal_fusion_pass
+
+    # run BEFORE claiming: horizontal merging works on unclaimed dot_generals,
+    # and the epilogue rewrite builds composites for the claim walk to offer
+    trc = horizontal_fusion_pass(trc)
+    trc = epilogue_fusion_pass(trc, executors)
+
     ex_bsyms: list[BoundSymbol] = []
     for bsym in trc.bound_symbols:
         ex_bsyms.extend(claim_bsym(bsym, executors, trc))

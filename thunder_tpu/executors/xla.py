@@ -87,6 +87,7 @@ class XLAFusionExecutor(FusionExecutor):
         # ops claimed by another executor (e.g. Pallas kernels) stay out of
         # fusion regions, exactly like cudnn-claimed ops stay outside nvFuser
         # regions in the reference (thunder/executors/passes.py:136 ordering)
+        # — unless the claiming executor opts into absorption (can_absorb)
         if bsym.sym.executor is not None and bsym.sym.executor is not self:
             return False
         if bsym.sym.python_impl is not None:
@@ -94,6 +95,24 @@ class XLAFusionExecutor(FusionExecutor):
         from thunder_tpu.executors.eagerjax import get_eager_impl
 
         return get_eager_impl(bsym.sym) is not None
+
+    def can_absorb(self, bsym: BoundSymbol) -> bool:
+        """Can this claimed-by-another-executor bsym be ABSORBED into an XLA
+        fusion region? Yes when the claiming executor opted in
+        (``fusible_into_regions`` — its impls are jax-traceable, e.g.
+        pallas_calls): the custom kernel then runs *inside* the region's
+        jax.jit, so XLA fuses elementwise producers/consumers around it
+        instead of the claim splitting the region at both kernel boundaries
+        (an HBM round-trip per boundary). Sync/collective ops never absorb."""
+        if bsym.sym.executor is None or bsym.sym.executor is self:
+            return False
+        if bsym.sym.id in _NOFUSE_IDS:
+            return False
+        if OpTags.DEVICE_SYNC_OP in bsym.sym.tags or OpTags.COLLECTIVE_OP in bsym.sym.tags:
+            return False
+        if not getattr(bsym.sym.executor, "fusible_into_regions", False):
+            return False
+        return bsym.sym.python_impl is not None
 
     def fusion_pass(self, trc: TraceCtx) -> TraceCtx:
         from thunder_tpu.core.compile_data import get_compile_option
@@ -112,11 +131,17 @@ class XLAFusionExecutor(FusionExecutor):
             "maximal regions under the dataflow graph, reference "
             "data_dependent_partition.py) or 'contiguous' (greedy program-order runs)",
             "dataflow")
+        absorb_claimed = get_compile_option(
+            "xla_absorb_claimed",
+            "absorb claimed custom kernels (pallas) INTO XLA fusion regions instead of "
+            "splitting regions around them — elementwise epilogues then fuse with the "
+            "kernel's inputs/outputs inside one XLA program", True)
         # outputs of the whole trace stay live
         live_out = {Variable(o) for o in tree_flatten(trc.output)[0] if isinstance(o, Proxy)}
 
         def fusible(bsym: BoundSymbol) -> bool:
-            return self.can_fuse(bsym) and self.get_fuel()
+            return (self.can_fuse(bsym)
+                    or (absorb_claimed and self.can_absorb(bsym))) and self.get_fuel()
 
         # fuel consumption must be deterministic per bsym: memoize once and
         # use the same answers for grouping AND emission (a fuel-denied bsym
@@ -188,6 +213,17 @@ class XLAFusionExecutor(FusionExecutor):
         sym = Symbol(f"fusion{idx}", None, id=f"xla.fusion{idx}", is_prim=True,
                      executor=self, python_impl=jitted)
         bsym = sym.bind(*inputs, output=tuple(outputs), subsymbols=list(gbsyms))
+        notes = []
+        absorbed = [b.sym.codegen_name() for b in gbsyms
+                    if b.sym.executor is not None and b.sym.executor is not self]
+        if absorbed:
+            notes.append("absorbs " + ", ".join(absorbed))
+        # surface member annotations (horizontal-fusion / epilogue-fusion
+        # markers) on the region: the generated program is the only trace
+        # most users read, and the members are hidden in subsymbols
+        notes.extend(b.header for b in gbsyms if b.header)
+        if notes:
+            bsym.header = "\n".join(notes)
         return bsym
 
 
