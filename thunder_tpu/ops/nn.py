@@ -69,6 +69,46 @@ def rms_norm(a, weight=None, eps: float = 1e-5, dim: int = -1):
     return out
 
 
+@opsymbol(id="nn.rms_norm_residual")
+def rms_norm_residual(residual, a, weight=None, eps: float = 1e-5):
+    """Fused residual-add + RMS norm: ``h = residual + a`` followed by
+    ``rms_norm(h, weight)``; returns ``(h, normed)``.
+
+    Both values escape in a transformer block — ``h`` is the residual
+    stream, ``normed`` feeds the next projection — so the epilogue fusion
+    pass rewrites ``add → rms_norm`` chains into this composite (which the
+    Pallas executor claims as one kernel, saving an HBM round-trip of the
+    residual stream per block). Unclaimed, this decomposition is exactly the
+    unfused ops, so numerics are identical either way.
+    """
+    _tensor_like(a, "rms_norm_residual")
+    check(tuple(residual.shape) == tuple(a.shape),
+          lambda: f"rms_norm_residual: residual shape {tuple(residual.shape)} "
+                  f"!= input shape {tuple(a.shape)}")
+    h = ops.add(residual, a)
+    return h, rms_norm(h, weight, eps=eps)
+
+
+_LINEAR_ACT_FNS = {
+    "relu": lambda y: ops.relu(y),
+    "silu": lambda y: ops.silu(y),
+    "gelu": lambda y: ops.gelu(y),
+    "gelu_tanh": lambda y: ops.gelu(y, approximate="tanh"),
+}
+
+
+@opsymbol(id="nn.linear_act")
+def linear_act(a, w, bias=None, act: str = "relu"):
+    """Fused ``act(a @ w.T + bias)`` — the GEMM-epilogue composite the
+    pattern pass builds from ``nn.linear → activation`` chains, claimable by
+    the Pallas executor as a single kernel (activation applied to the f32
+    accumulator tile while it is still in VMEM). ``act`` is one of
+    ``relu | silu | gelu | gelu_tanh``."""
+    check(act in _LINEAR_ACT_FNS,
+          lambda: f"linear_act: unknown activation {act!r}; known: {sorted(_LINEAR_ACT_FNS)}")
+    return _LINEAR_ACT_FNS[act](ops.linear(a, w, bias))
+
+
 @opsymbol(id="nn.dropout")
 def dropout(a, p: float = 0.5, training: bool = True):
     p = float(pyval(p))
@@ -296,6 +336,44 @@ def _sdpa_vjp(q, k, v, attn_mask=None, dropout_p: float = 0.0, is_causal: bool =
     def pullback(g):
         dq, dk, dv = sdpa_bwd(g, q, k, v, out, lse, is_causal, scale)
         return [(q, dq), (k, dk), (v, dv)]
+
+    return out, pullback
+
+
+@register_vjp("nn.rms_norm")
+def _rms_norm_vjp(a, weight=None, eps: float = 1e-5, dim: int = -1):
+    """Keep ``nn.rms_norm`` a composite in training traces (the autodiff
+    replay otherwise decomposes it to prims, which hides it from both the
+    Pallas claim and the epilogue fusion pattern). Saves only (a, weight) —
+    the backward recomputes the row statistics, like the flash-attention
+    rules recompute the softmax."""
+    if dim not in (-1, a.ndim - 1):
+        return NotImplemented
+    out = rms_norm(a, weight, eps=eps, dim=dim)
+
+    def pullback(g):
+        # same dtype policy as the forward composite: widen to f32 only for
+        # half precision — f32 stays f32, and f64 (x64 mode) keeps full
+        # precision instead of silently narrowing
+        wide = dtypes.float32 if a.dtype in (dtypes.float16, dtypes.bfloat16) else a.dtype
+        x = ops.convert_element_type(a, wide)
+        g32 = ops.convert_element_type(g, wide)
+        ms = ops.mean(ops.mul(x, x), -1, keepdim=True)
+        r = ops.rsqrt(ops.add(ms, eps))
+        xhat = ops.mul(x, r)
+        if weight is not None:
+            gxhat = ops.mul(g32, ops.convert_element_type(weight, wide))
+        else:
+            gxhat = g32
+        # d/dx of x·(mean(x²)+eps)^(-1/2): r·(ĝ − x̂·mean(ĝ·x̂))
+        proj = ops.mean(ops.mul(gxhat, xhat), -1, keepdim=True)
+        da = ops.mul(r, ops.sub(gxhat, ops.mul(xhat, proj)))
+        pairs = [(a, ops.convert_element_type(da, a.dtype))]
+        if weight is not None and isinstance(weight, TensorProxy):
+            lead = tuple(range(a.ndim - 1))
+            dw = ops.mul(g32, xhat) if not lead else ops.sum(ops.mul(g32, xhat), lead)
+            pairs.append((weight, ops.convert_element_type(dw, weight.dtype)))
+        return pairs
 
     return out, pullback
 
