@@ -20,6 +20,23 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # register the tier-boundary marker so `-m 'not slow'` selection never
+    # silently no-ops because of a typo'd/unknown marker
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 budget "
+                            "(run explicitly or in the full suite)")
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    # machine-readable summary for the tier-1 driver: counting progress dots
+    # breaks when a test prints mid-line; this line is grep-able and exact.
+    # (Emitted even when the run is interrupted part-way.)
+    passed = len(terminalreporter.stats.get("passed", []))
+    failed = len(terminalreporter.stats.get("failed", []))
+    errors = len(terminalreporter.stats.get("error", []))
+    terminalreporter.write_line(f"PASSED={passed} FAILED={failed} ERRORS={errors}")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -1,0 +1,329 @@
+"""thunder_tpu.observe: registry semantics, compile spans + decision log,
+runtime step metrics, exporters (JSONL / Chrome trace / Prometheus), and the
+explain report. All CPU-only and inside the tier-1 budget."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import observe, ops
+from thunder_tpu.observe import registry as obs_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test starts disabled with an empty registry and leaves it so."""
+    observe.disable()
+    observe.reset()
+    yield
+    observe.disable()
+    observe.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_disabled_recording_is_a_noop():
+    observe.inc("x")
+    observe.set_gauge("g", 5.0)
+    observe.observe_value("h", 1.0)
+    observe.event("e", detail=1)
+    snap = observe.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+    assert snap["histograms"] == {} and snap["events"] == []
+    # the span fast path hands back a shared no-op (no allocation per call)
+    assert observe.span("a") is observe.span("b")
+
+
+def test_enabled_counters_gauges_histograms_events():
+    observe.enable(clear=True)
+    observe.inc("c")
+    observe.inc("c", 2.0)
+    observe.set_gauge("g", 7.5)
+    for v in (0.2, 3.0, 40.0):
+        observe.observe_value("h", v)
+    observe.event("e", detail="d")
+    with observe.span("work", cat="test"):
+        pass
+    snap = observe.snapshot()
+    assert snap["counters"]["c"] == 3.0
+    assert snap["gauges"]["g"] == 7.5
+    h = snap["histograms"]["h"]
+    assert h["count"] == 3 and abs(h["sum"] - 43.2) < 1e-9
+    assert h["min"] == 0.2 and h["max"] == 40.0
+    assert snap["events"][0]["kind"] == "e" and snap["events"][0]["detail"] == "d"
+    spans = [s for s in snap["spans"] if s["name"] == "work"]
+    assert spans and spans[0]["dur_us"] >= 0 and spans[0]["cat"] == "test"
+
+
+def test_enable_clear_resets():
+    observe.enable(clear=True)
+    observe.inc("c")
+    observe.enable(clear=True)
+    assert observe.snapshot()["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# compile pipeline instrumentation
+# ---------------------------------------------------------------------------
+
+def test_compile_spans_and_cache_events():
+    observe.enable(clear=True)
+    jf = tt.jit(lambda a, b: ops.tanh(a @ b).sum())
+    x = np.ones((4, 5), np.float32)
+    w = np.ones((5, 3), np.float32)
+    jf(x, w)
+    jf(x, w)
+    snap = observe.snapshot()
+    assert snap["counters"]["cache.misses"] == 1
+    assert snap["counters"]["cache.hits"] == 1
+    assert snap["counters"]["compile.count"] == 1
+    assert snap["gauges"]["compile.transform_ms"] > 0
+    names = {s["name"] for s in snap["spans"]}
+    for expected in ("compile", "trace", "transform_for_execution", "claim",
+                     "codegen", "fusion_pass:xla"):
+        assert expected in names, (expected, names)
+
+
+def test_pass_times_collected_without_enable():
+    """Per-pass walltimes and the decision log land in CompileStats even when
+    the process-wide registry is off (explain works cold)."""
+    jf = tt.jit(lambda a: ops.mul(ops.sin(a), 2.0))
+    jf(np.ones((8,), np.float32))
+    stats = tt.compile_stats(jf)
+    assert stats.last_pass_times.get("trace", 0) > 0
+    assert stats.last_pass_times.get("transform_for_execution", 0) > 0
+    assert any(d["kind"] == "claim" for d in stats.last_decisions)
+    assert observe.snapshot()["spans"] == []  # nothing leaked into the registry
+
+
+def test_compile_stats_surfaces_interpret_and_transform_times():
+    jf = tt.jit(lambda a: ops.add(a, 1.0))
+    jf(np.zeros((4,), np.float32))
+    stats = tt.compile_stats(jf)
+    assert stats.last_interpreted_ns > 0 and stats.last_transform_ns > 0
+    assert stats.last_interpreted_ms == stats.last_interpreted_ns / 1e6
+    text = stats.summary()
+    assert "tracing (interpretation)" in text and "transforms + dispatch" in text
+    assert repr(stats).startswith("<CompileStats")
+
+
+# ---------------------------------------------------------------------------
+# runtime step metrics
+# ---------------------------------------------------------------------------
+
+def test_step_metrics_recorded_per_call():
+    observe.enable(clear=True)
+    jf = tt.jit(lambda a: ops.mul(a, 3.0).sum())
+    x = np.ones((64, 64), np.float32)
+    for _ in range(3):
+        jf(x)
+    snap = observe.snapshot()
+    assert snap["counters"]["step.count"] == 3
+    # the first call pays lazy XLA compile and is kept OUT of the steady-state
+    # walltime histogram (recorded as step.first_call_ms instead)
+    assert snap["histograms"]["step.walltime_ms"]["count"] == 2
+    assert snap["histograms"]["step.first_call_ms"]["count"] == 1
+    assert snap["gauges"]["step.est_live_bytes"] > 0
+    step_spans = [s for s in snap["spans"] if s["cat"] == "step"]
+    assert len(step_spans) == 3
+    assert step_spans[0]["args"]["first_call"] is True
+    assert step_spans[1]["args"]["first_call"] is False
+    assert step_spans[0]["args"]["est_live_bytes"] > 0
+
+
+def test_step_metrics_off_when_disabled():
+    jf = tt.jit(lambda a: ops.mul(a, 3.0).sum())
+    x = np.ones((8,), np.float32)
+    jf(x)
+    observe.enable()  # enable AFTER compile: the wrapper reads the live flag
+    jf(x)
+    snap = observe.snapshot()
+    assert snap["counters"].get("step.count", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# decision log + explain (acceptance: tiny-llama train step)
+# ---------------------------------------------------------------------------
+
+def _tiny_llama_step():
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import SGD
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=7, scale_layers=2)
+    opt = SGD(lr=1e-2)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        return loss, *opt.update(params, grads, opt_state)
+
+    rng = np.random.RandomState(7)
+    tokens = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    return train_step, params, opt.init(params), tokens, targets
+
+
+_compiled_step_cache: list = []
+
+
+def _compiled_tiny_llama_step():
+    """One shared pallas+xla tiny-llama compile for the explain/decision
+    tests (compiling it is the expensive part of this module — tier-1 budget)."""
+    if not _compiled_step_cache:
+        train_step, params, opt_state, tokens, targets = _tiny_llama_step()
+        jstep = tt.jit(train_step, executors=["pallas", "xla"])
+        jstep(params, opt_state, tokens, targets)
+        _compiled_step_cache.append(jstep)
+    return _compiled_step_cache[0]
+
+
+def test_explain_tiny_llama_train_step():
+    """Acceptance: explain() names the executor for every bound symbol of the
+    execution trace and lists >= 1 fusion decision with cost-model inputs."""
+    from thunder_tpu.core.prims import PrimIDs
+
+    jstep = _compiled_tiny_llama_step()
+    report = observe.explain(jstep)
+    exec_trc = tt.last_execution_trace(jstep)
+    skip = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL)
+    named = 0
+    for bsym in exec_trc.bound_symbols:
+        if bsym.sym.id in skip:
+            continue
+        ex = bsym.sym.executor.name if bsym.sym.executor is not None else "eagerjax"
+        assert f"{bsym.sym.name} [{ex}]" in report, bsym.sym.name
+        named += 1
+    assert named >= 1
+
+    decisions = tt.compile_stats(jstep).last_decisions
+    fusion = [d for d in decisions if d["kind"] == "fusion"]
+    assert len(fusion) >= 1
+    with_cost = [d for d in fusion if d.get("cost")]
+    assert with_cost, fusion
+    # the horizontal-merge verdicts carry the actual byte-model inputs
+    hm = [d for d in fusion if d["op"] == "horizontal_merge"]
+    assert hm and {"m_tokens", "widths", "siblings"} <= set(hm[0]["cost"])
+    # ... and the textual report shows them
+    assert "horizontal_merge" in report and "m_tokens" in report
+    assert "== claim decisions" in report and "eagerjax" in report
+
+
+def test_explain_before_compile_is_graceful():
+    jf = tt.jit(lambda a: ops.add(a, 1.0))
+    assert "no compilation has run yet" in observe.explain(jf)
+
+
+def test_claim_rejection_reasons_logged():
+    """A pallas-claimable op that the cost model keeps inside XLA regions
+    shows up as a rejected claim with the cost numbers."""
+    jstep = _compiled_tiny_llama_step()
+    decisions = tt.compile_stats(jstep).last_decisions
+    rejected = [d for d in decisions
+                if d["kind"] == "claim" and d["decision"] == "rejected"]
+    assert rejected
+    assert any(d.get("cost") or "checker" in d.get("reason", "")
+               for d in rejected)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _compile_and_step_3x():
+    jf = tt.jit(lambda a, b: ops.tanh(a @ b).sum())
+    x = np.ones((16, 8), np.float32)
+    w = np.ones((8, 4), np.float32)
+    for _ in range(3):
+        jf(x, w)
+    return jf
+
+
+def test_chrome_trace_export_loads_structurally(tmp_path):
+    """Acceptance: the Perfetto export of a compile+3-step run is a valid
+    Chrome Trace Event Format object (what chrome://tracing loads)."""
+    observe.enable(clear=True)
+    _compile_and_step_3x()
+    path = str(tmp_path / "trace.json")
+    n = observe.export_chrome_trace(path)
+    assert n > 0
+    with open(path) as f:
+        trace = json.load(f)
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    complete = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    for e in complete:
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    names = {e["name"] for e in complete}
+    assert "compile" in names                      # compile span present
+    assert sum(1 for e in complete
+               if e["name"].startswith("step:")) >= 3  # the 3 steps
+    # metadata rows give the timeline its labels
+    assert any(e.get("ph") == "M" for e in trace["traceEvents"])
+
+
+def test_jsonl_export_roundtrips(tmp_path):
+    observe.enable(clear=True)
+    _compile_and_step_3x()
+    path = str(tmp_path / "events.jsonl")
+    n = observe.export_jsonl(path)
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert len(recs) == n
+    types = {r["type"] for r in recs}
+    assert {"counter", "gauge", "histogram", "span"} <= types
+    counters = {r["name"]: r["value"] for r in recs if r["type"] == "counter"}
+    assert counters["cache.misses"] == 1 and counters["step.count"] == 3
+
+
+def test_prometheus_export_format(tmp_path):
+    observe.enable(clear=True)
+    _compile_and_step_3x()
+    path = str(tmp_path / "metrics.prom")
+    text = observe.export_prometheus(path)
+    assert os.path.exists(path)
+    assert "# TYPE thunder_tpu_cache_misses counter" in text
+    assert "thunder_tpu_cache_misses 1" in text
+    assert "# TYPE thunder_tpu_step_walltime_ms histogram" in text
+    assert 'thunder_tpu_step_walltime_ms_bucket{le="+Inf"} 2' in text
+    assert "thunder_tpu_step_walltime_ms_count 2" in text
+    # every non-comment line is "<metric possibly with labels> <value>"
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        metric, value = line.rsplit(" ", 1)
+        assert metric.startswith("thunder_tpu_")
+        float(value)
+
+
+# ---------------------------------------------------------------------------
+# bench integration + tier-1 hygiene
+# ---------------------------------------------------------------------------
+
+def test_bench_metric_names_exist_after_compile():
+    """bench.py reads these registry names; renaming them must fail a test,
+    not silently zero the bench JSON."""
+    observe.enable(clear=True)
+    train_step, params, opt_state, tokens, targets = _tiny_llama_step()
+    jstep = tt.jit(train_step, horizontal_fusion=True)
+    jstep(params, opt_state, tokens, targets)
+    snap = observe.snapshot()
+    assert snap["counters"].get("fusion.xla_regions", 0) >= 1
+    assert snap["counters"].get("fusion.horizontal_merges", 0) >= 1
+    assert snap["gauges"]["compile.transform_ms"] > 0
+
+
+def test_observe_tests_stay_in_tier1():
+    """Marker audit: this module must run under ``-m 'not slow'`` in full —
+    no test here may carry the slow marker (tier-1 is the only gate that
+    runs on every PR, and observability regressions must fail it)."""
+    with open(__file__) as f:
+        src = f.read()
+    marker = "mark." + "slow"  # split so this line doesn't trip the scan
+    assert marker not in src, "observe tests must stay in the tier-1 budget"

@@ -244,6 +244,105 @@ def test_debug_transform_sees_every_op():
     assert len(seen) >= 2  # mul and add observed
 
 
+def test_debug_transform_capture_ordering_and_values():
+    """The per-op callback fires in PROGRAM order, after each op, with that
+    op's concrete outputs — the contract golden-value capture relies on."""
+    import thunder_tpu as tt
+    from thunder_tpu import ops
+    from thunder_tpu.dev_utils import DebugTransform
+    import numpy as np
+
+    seen = []
+    tr = DebugTransform(lambda name, bsym, vals: seen.append(
+        (name, [np.asarray(v).copy() for v in vals])))
+    # whole_program_jit=False: under the outer jit the callback would see
+    # tracers; the per-region path hands it concrete arrays (the documented
+    # golden-value-capture mode)
+    jf = tt.jit(lambda x: ops.add(ops.mul(x, 2.0), 1.0), transforms=[tr],
+                executors=["eagerjax"], whole_program_jit=False)
+    out = np.asarray(jf(np.ones(4, np.float32)))
+    np.testing.assert_allclose(out, np.full(4, 3.0))
+
+    names = [n for n, _ in seen]
+    # mul's callback precedes add's: capture interleaves with execution
+    # rather than batching at the end
+    i_mul = next(i for i, n in enumerate(names) if "mul" in n)
+    i_add = next(i for i, n in enumerate(names) if "add" in n)
+    assert i_mul < i_add, names
+    # each callback saw that op's OUTPUT values, not a later state
+    np.testing.assert_allclose(seen[i_mul][1][0], np.full(4, 2.0))
+    np.testing.assert_allclose(seen[i_add][1][0], np.full(4, 3.0))
+
+
+def test_comm_report_byte_accounting_distributed_prims():
+    """comm_report's in/out bytes follow each collective's semantics exactly:
+    all_gather multiplies the payload by the axis size, reduce_scatter
+    divides it, all_reduce preserves it."""
+    from thunder_tpu.core.dtypes import float32
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.core.trace import TraceCtx, tracectx
+    from thunder_tpu.distributed import prims as dprims
+    from thunder_tpu.examine import comm_report
+
+    trc = TraceCtx("comm")
+    with tracectx(trc):
+        a = TensorProxy("a", shape=(4, 8), dtype=float32)   # 128 bytes local
+        g = dprims.all_gather(a, "x", 0, 8)                 # out: (32, 8)
+        r = dprims.all_reduce(a, "x")                       # out: (4, 8)
+        s = dprims.reduce_scatter(a, "x", 0, 4)             # out: (1, 8)
+
+    rep = comm_report(trc)
+    nbytes = 4 * 8 * 4
+    ag = rep["collectives"]["all_gather"]
+    assert ag["count"] == 1
+    assert ag["in_bytes"] == nbytes and ag["out_bytes"] == 8 * nbytes
+    ar = rep["collectives"]["all_reduce"]
+    assert ar["in_bytes"] == nbytes and ar["out_bytes"] == nbytes
+    rs = rep["collectives"]["reduce_scatter"]
+    assert rs["in_bytes"] == nbytes and rs["out_bytes"] == nbytes // 4
+    assert rep["total_in_bytes"] == 3 * nbytes
+    assert rep["total_out_bytes"] == 8 * nbytes + nbytes + nbytes // 4
+
+
+def test_comm_report_fsdp_step_accounting(eight_devices):
+    """End-to-end: on a real FSDP train step the gathers/scatters obey the
+    world-size relationship (out = in * 8 for gathers of dim-0 shards) and
+    composite-level collectives are not double-counted against their
+    decompositions."""
+    from thunder_tpu.distributed import fsdp, MeshSpec
+    from thunder_tpu.examine import comm_report
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import SGD
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=1)
+    opt = SGD(lr=1e-2)
+
+    def step(p, s, tok, tgt):
+        loss, g = tt.value_and_grad(lambda pp: llama.loss_fn(pp, tok, tgt, cfg))(p)
+        p2, s2 = opt.update(p, g, s)
+        return loss, p2, s2
+
+    js = fsdp(step, MeshSpec.make(fsdp=8))
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, cfg.vocab_size, size=(8, 8)).astype(np.int32)
+    js(params, opt.init(params), tok, np.roll(tok, -1, 1))
+
+    rep = comm_report(js)
+    colls = rep["collectives"]
+    assert rep["total_in_bytes"] > 0 and rep["total_out_bytes"] > 0
+    # param gathers: dim-0 sharded -> full, so out == 8 * in per op
+    gathers = [colls[k] for k in ("synchronize", "regather", "all_gather")
+               if k in colls]
+    assert gathers, colls
+    for c in gathers:
+        assert c["out_bytes"] == 8 * c["in_bytes"], c
+    # grad reduce-scatters go the other way
+    if "reduce_scatter" in colls:
+        c = colls["reduce_scatter"]
+        assert c["in_bytes"] == 8 * c["out_bytes"], c
+
+
 def test_profile_transform_preserves_results():
     import thunder_tpu as tt
     from thunder_tpu import ops

@@ -46,6 +46,8 @@ from thunder_tpu.core.rematerialization import (
     checkpoint,
     rematerialize_forward_and_backward,
 )
+from thunder_tpu import observe  # noqa: F401  (thunder_tpu.observe.*)
+from thunder_tpu.observe import registry as _observe
 
 __version__ = "0.1.0"
 
@@ -123,6 +125,46 @@ class CompileStats:
         self.last_interpreted_ns = 0
         self.last_transform_ns = 0
         self.last_entry = None  # most recently compiled CacheEntry (for last_hlo)
+        # observe subsystem: per-compile decision log (executor claims /
+        # rejections, fusion accept/reject with cost-model inputs) and
+        # per-pass walltimes (ms) — always collected, see thunder_tpu.observe
+        self.last_decisions: list[dict] = []
+        self.last_pass_times: dict[str, float] = {}
+
+    @property
+    def last_interpreted_ms(self) -> float:
+        return self.last_interpreted_ns / 1e6
+
+    @property
+    def last_transform_ms(self) -> float:
+        return self.last_transform_ns / 1e6
+
+    def summary(self) -> str:
+        """Human-readable compile-time breakdown of the last compilation.
+        Pass times render hierarchically (sub-passes key as ``parent/child``
+        in ``last_pass_times``): siblings at one level sum to their parent,
+        so no line double-counts another."""
+        lines = [
+            f"cache: {self.cache_misses} miss(es), {self.cache_hits} hit(s)",
+            f"tracing (interpretation): {self.last_interpreted_ms:.2f} ms",
+            f"transforms + dispatch: {self.last_transform_ms:.2f} ms",
+        ]
+
+        def render(prefix: str, depth: int):
+            level = {k: v for k, v in self.last_pass_times.items()
+                     if k.startswith(prefix) and "/" not in k[len(prefix):]}
+            for name, ms in sorted(level.items(), key=lambda kv: -kv[1]):
+                lines.append(f"  {'  ' * depth}{name[len(prefix):]}: {ms:.2f} ms")
+                render(name + "/", depth + 1)
+
+        render("", 0)
+        if self.last_decisions:
+            lines.append(f"decisions recorded: {len(self.last_decisions)} "
+                         f"(see thunder_tpu.observe.explain)")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return f"<CompileStats\n{self.summary()}\n>"
 
 
 class CacheEntry:
@@ -304,11 +346,14 @@ class ThunderTPUFunction:
         entry = self._cache.get(key) if key is not None else None
         if entry is None:
             self._stats.cache_misses += 1
+            _observe.inc("cache.misses")
+            _observe.event("cache_miss", fn=self.fn_name)
             entry = self._compile(flat, treedef, args, kwargs)
             if key is not None:
                 self._cache[key] = entry
         else:
             self._stats.cache_hits += 1
+            _observe.inc("cache.hits")
         return entry, flat
 
     def compile(self, *args, **kwargs) -> "CacheEntry":
@@ -422,10 +467,31 @@ class ThunderTPUFunction:
             return self._compile_inner(flat, treedef, args, kwargs)
 
     def _compile_inner(self, flat, treedef, args, kwargs) -> CacheEntry:
+        from thunder_tpu.observe import decisions as _decisions
+
+        # collect locally, install into stats only on success: a failed
+        # recompile must not leave explain()/summary() mixing this compile's
+        # partial decisions/pass-times with the previous compile's traces
+        pass_times: dict[str, float] = {}
+        with _observe.collect_pass_times(pass_times), \
+                _decisions.collect() as decision_log, \
+                _observe.span("compile", args={"fn": self.fn_name},
+                              record_pass_time=False):
+            entry = self._compile_instrumented(flat, treedef, args, kwargs)
+        self._stats.last_pass_times = pass_times
+        self._stats.last_decisions = decision_log
+        _observe.inc("compile.count")
+        _observe.set_gauge("compile.interpreted_ms", self._stats.last_interpreted_ms)
+        _observe.set_gauge("compile.transform_ms", self._stats.last_transform_ms)
+        return entry
+
+    def _compile_instrumented(self, flat, treedef, args, kwargs) -> CacheEntry:
         from thunder_tpu.executors.passes import del_last_used, transform_for_execution
+        from thunder_tpu.observe import runtime as _obs_runtime
 
         t0 = time.perf_counter_ns()
-        trc, tensor_indices = self._trace(flat, treedef)
+        with _observe.span("trace"):
+            trc, tensor_indices = self._trace(flat, treedef)
         self._stats.last_interpreted_ns = time.perf_counter_ns() - t0
         if trc.sharp_edges and self.sharp_edges != "allow":
             msg = "sharp edges detected during tracing (reference SHARP_EDGES_OPTIONS):\n  " \
@@ -438,22 +504,26 @@ class ThunderTPUFunction:
         traces = [trc]
 
         t1 = time.perf_counter_ns()
-        prologue = self._build_prologue(flat, tensor_indices)
-        for tr in self.transforms:
-            _, trc, _ = tr.transform_traces_pre_prologue(prologue, trc, None)
+        with _observe.span("prologue"):
+            prologue = self._build_prologue(flat, tensor_indices)
+            for tr in self.transforms:
+                _, trc, _ = tr.transform_traces_pre_prologue(prologue, trc, None)
 
-        trc = dce(trc)
-        traces.append(trc)
-        if self.enable_cse:
-            trc = cse(trc)
+        with _observe.span("dce+cse"):
             trc = dce(trc)
             traces.append(trc)
+            if self.enable_cse:
+                trc = cse(trc)
+                trc = dce(trc)
+                traces.append(trc)
 
-        exec_trc = transform_for_execution(trc, self.executors)
+        with _observe.span("transform_for_execution"):
+            exec_trc = transform_for_execution(trc, self.executors)
         for tr in self.transforms:
             exec_trc = tr.transform_trace_post_optimization(exec_trc)
         if self.insert_dels:
-            exec_trc = del_last_used(exec_trc)
+            with _observe.span("del_last_used"):
+                exec_trc = del_last_used(exec_trc)
         traces.append(exec_trc)
         self._stats.last_transform_ns = time.perf_counter_ns() - t1
 
@@ -465,8 +535,9 @@ class ThunderTPUFunction:
             "already exists (user-edited), execute its contents instead "
             "(reference set_execution_callback_file: hand-patch generated code)",
             None)
-        computation_fn = exec_trc.python_callable(execution_file=execution_file)
-        prologue_fn = prologue.python_callable()
+        with _observe.span("codegen"):
+            computation_fn = exec_trc.python_callable(execution_file=execution_file)
+            prologue_fn = prologue.python_callable()
         # sanity-run the prologue guards once on the compiling inputs
         prologue_fn(*flat)
 
@@ -491,7 +562,11 @@ class ThunderTPUFunction:
                 entry.input_avals.append(_jax.ShapeDtypeStruct((2,), _np.uint32))
         # else (symbolic-values caching: number inputs): no avals — last_hlo
         # reports accordingly
-        self._finalize_entry(entry, flat, exec_trc)
+        with _observe.span("finalize"):
+            self._finalize_entry(entry, flat, exec_trc)
+        # runtime step metrics: one disabled-check per call when observe is
+        # off, walltime/span/memory-estimate recording when on
+        entry.run_fn = _obs_runtime.instrument_entry(entry, self.fn_name)
         self._stats.last_traces = traces
         self._stats.last_prologue_traces = [prologue]
         self._stats.last_entry = entry

@@ -48,6 +48,8 @@ from thunder_tpu.core.prims import PrimIDs
 from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable
 from thunder_tpu.core.symbol import BoundSymbol
 from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
+from thunder_tpu.observe import decisions as _decisions
+from thunder_tpu.observe import registry as _observe
 
 HORIZONTAL_MARKER = "horizontal-fusion"
 EPILOGUE_MARKER = "epilogue-fusion"
@@ -222,9 +224,25 @@ def horizontal_fusion_pass(trc: TraceCtx) -> TraceCtx:
             if d not in sc:
                 m_tokens *= int(shared.shape[d])
         widths = [int(m[2][varying_pos].shape[free_dim]) for m in members]
+        # decision log: the cost-model inputs behind every merge verdict
+        # (observe.explain's "why did/didn't QKV merge" answer)
+        group_cost = {"siblings": len(members), "m_tokens": m_tokens,
+                      "widths": widths, "shared": shared.name,
+                      "saved_reads": m_tokens * (len(members) - 1),
+                      "concat_write": sum(widths)}
         if enabled is not True and not cost_model.horizontal_merge_profitable(
                 m_tokens, widths):
+            _decisions.record(
+                "fusion", "horizontal_merge", None, "rejected",
+                "cost model: concat write outweighs saved shared-operand "
+                "reads (need m_tokens*(k-1) > sum(widths))", cost=group_cost)
             continue
+        _decisions.record(
+            "fusion", "horizontal_merge", None, "merged",
+            "forced by horizontal_fusion=True" if enabled is True
+            else "cost model: saved reads beat the concat write",
+            cost=group_cost)
+        _observe.inc("fusion.horizontal_merges")
         replacements[first_idx] = _merge_group(trc, members, shared_pos, free_dim)
         dropped.update(m[0] for m in members[1:])
         merged_ids.update(id(m[1]) for m in members)
@@ -332,13 +350,21 @@ def _rms_residual_pattern(executors) -> tuple[Pattern, callable]:
         normed = rms_b.flat_proxy_outs()[0]
         weight = rms_b.args[1] if len(rms_b.args) > 1 else rms_b.kwargs.get("weight")
         eps = rms_b.kwargs.get("eps", rms_b.args[2] if len(rms_b.args) > 2 else 1e-5)
+        cost = {"pattern": "add+rms_norm", "bytes_saved_roundtrip":
+                cost_model.tensor_bytes(h) * 2}
         if not _some_executor_claims(executors, "nn.rms_norm_residual",
                                      (res, x, weight), {"eps": eps}, (h, normed)):
+            _decisions.record("fusion", "nn.rms_norm_residual", None, "rejected",
+                              "no executor claims the fused composite "
+                              "(checker or cost-model gate)", cost=cost)
             return None
         repl = _build_composite(trc, tnn.rms_norm_residual, (res, x, weight),
                                 {"eps": eps}, [h, normed])
         if repl:
             repl[-1].header = f"{EPILOGUE_MARKER}: residual add absorbed into rms_norm"
+            _decisions.record("fusion", "nn.rms_norm_residual", None, "rewritten",
+                              "residual add absorbed into rms_norm", cost=cost)
+            _observe.inc("fusion.epilogue_fusions")
         return repl
 
     return p, build
@@ -383,12 +409,20 @@ def _linear_act_pattern(executors) -> tuple[Pattern, callable]:
         bias = lin_b.args[2] if len(lin_b.args) > 2 else lin_b.kwargs.get("bias")
         out = act_b.flat_proxy_outs()[0]
         act = env["act"]
+        cost = {"pattern": f"linear+{act}", "bytes_saved_roundtrip":
+                cost_model.tensor_bytes(out) * 2}
         if not _some_executor_claims(executors, "nn.linear_act",
                                      (a, w, bias), {"act": act}, (out,)):
+            _decisions.record("fusion", "nn.linear_act", None, "rejected",
+                              "no executor claims the fused composite "
+                              "(checker or cost-model gate)", cost=cost)
             return None
         repl = _build_composite(trc, tnn.linear_act, (a, w, bias), {"act": act}, [out])
         if repl:
             repl[-1].header = f"{EPILOGUE_MARKER}: {act} epilogue fused into linear"
+            _decisions.record("fusion", "nn.linear_act", None, "rewritten",
+                              f"{act} epilogue fused into linear", cost=cost)
+            _observe.inc("fusion.epilogue_fusions")
         return repl
 
     return p, build

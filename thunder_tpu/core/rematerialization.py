@@ -28,6 +28,7 @@ from thunder_tpu.core.proxies import NumberProxy, Proxy, TensorProxy, Variable
 from thunder_tpu.core.pytree import tree_flatten
 from thunder_tpu.core.symbol import BoundSymbol, Symbol
 from thunder_tpu.core.trace import TraceCtx, from_trace, get_tracectx, tracectx
+from thunder_tpu.observe import registry as _observe
 
 _SKIP_IDS = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL)
 
@@ -72,6 +73,11 @@ def find_cut(fwd: TraceCtx, required: list[Proxy]) -> set[str]:
     """
     import networkx as nx
 
+    with _observe.span("remat.find_cut"):
+        return _find_cut_impl(fwd, required, nx)
+
+
+def _find_cut_impl(fwd: TraceCtx, required: list[Proxy], nx) -> set[str]:
     INF = float("inf")
     g = nx.DiGraph()
     arg_names = {p.name for p in fwd.args if isinstance(p, Proxy)}
@@ -134,6 +140,11 @@ def rematerialize_forward_and_backward(fwd: TraceCtx, bwd: TraceCtx) -> tuple[Tr
     ``thunder/core/rematerialization.py:572``)."""
     from thunder_tpu.core.transform_common import dce
 
+    with _observe.span("remat.forward_and_backward"):
+        return _remat_fwd_bwd_impl(fwd, bwd, dce)
+
+
+def _remat_fwd_bwd_impl(fwd: TraceCtx, bwd: TraceCtx, dce) -> tuple[TraceCtx, TraceCtx]:
     # current contract: fwd returns (out, saved); bwd.args = saved + cotangents
     out, old_saved = fwd.output
     old_saved_names = {p.name for p in old_saved if isinstance(p, Proxy)}
@@ -157,6 +168,12 @@ def rematerialize_forward_and_backward(fwd: TraceCtx, bwd: TraceCtx) -> tuple[Tr
             name_to_proxy[p.name] = p
 
     new_saved = [name_to_proxy[n] for n in sorted(saved_names) if n in name_to_proxy]
+    if _observe.is_enabled():
+        old_bytes = sum(_save_cost(p) for p in old_saved if isinstance(p, Proxy))
+        new_bytes = sum(_save_cost(p) for p in new_saved)
+        _observe.set_gauge("remat.saved_bytes", new_bytes)
+        _observe.event("remat", n_saved_before=len(old_saved), n_saved_after=len(new_saved),
+                       saved_bytes_before=old_bytes, saved_bytes_after=new_bytes)
 
     # --- recompute plan: emit producers (in fwd order) for every required
     # value not saved, transitively ---------------------------------------
@@ -215,6 +232,11 @@ def rematerialize_all_gather(trc: TraceCtx) -> TraceCtx:
     its last forward use, bounding peak memory to one gathered layer at a
     time.
     """
+    with _observe.span("remat.all_gather"):
+        return _remat_all_gather_impl(trc)
+
+
+def _remat_all_gather_impl(trc: TraceCtx) -> TraceCtx:
     from thunder_tpu.core.proxies import DistParallelType
     from thunder_tpu.core.trace import tracectx
     from thunder_tpu.distributed.prims import DistPrimIDs, regather

@@ -59,13 +59,22 @@ class DebugTransform(Transform):
 
 class ProfileTransform(Transform):
     """Wrap every executor callable in a ``jax.profiler.TraceAnnotation`` so
-    per-region spans appear in profiler traces alongside XLA ops."""
+    per-region spans appear in profiler traces alongside XLA ops. When the
+    ``thunder_tpu.observe`` registry is enabled, each wrapped call also
+    records an observe span (cat ``op``) visible in
+    ``observe.export_chrome_trace``. NOTE: under the default whole-program
+    jit the wrapped impls execute once, at jax trace time — you get one
+    trace-time span per op, not a per-step runtime timeline; compile with
+    ``whole_program_jit=False`` (the per-region execution path) for real
+    per-op runtime spans."""
 
     def __init__(self, prefix: str = "thunder_tpu"):
         self.prefix = prefix
 
     def transform_trace_post_optimization(self, trc: TraceCtx, **kwargs) -> TraceCtx:
         import jax
+
+        from thunder_tpu.observe import registry as _observe
 
         new = from_trace(trc)
         bsyms: list[BoundSymbol] = []
@@ -78,7 +87,8 @@ class ProfileTransform(Transform):
 
             def make_impl(_name, _inner):
                 def profiled(*args, **kw):
-                    with jax.profiler.TraceAnnotation(_name):
+                    with jax.profiler.TraceAnnotation(_name), \
+                            _observe.span(_name, cat="op"):
                         return _inner(*args, **kw)
 
                 return profiled

@@ -21,6 +21,8 @@ from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
 from thunder_tpu.core.transform_common import dce
 from thunder_tpu.core.utils import consumed_vars, produced_vars
 from thunder_tpu.executors import Executor, FusionExecutor
+from thunder_tpu.observe import decisions as _decisions
+from thunder_tpu.observe import registry as _observe
 
 
 _PASSTHROUGH_IDS = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL,
@@ -45,11 +47,17 @@ def _run_execution_transform(transform, bsym: BoundSymbol, trc: TraceCtx) -> lis
 def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]:
     if bsym.sym.id in _PASSTHROUGH_IDS or bsym.sym.executor is not None:
         return [bsym]
+    log = _decisions.active()  # decision log: one flag read per bsym when off
     for ex in executors:
         if isinstance(ex, FusionExecutor):
             continue  # fusion executors run as whole-trace passes afterwards
         impl = ex.get_impl(bsym)
-        if impl is None or not ex.can_execute(bsym):
+        if impl is None:
+            continue
+        if not ex.can_execute(bsym):
+            if log:
+                _decisions.record("claim", bsym.sym.name, ex.name, "rejected",
+                                  "checker refused (shape/dtype/tiling legality)")
             continue
         # cost-model gate: a legal claim may still lose to leaving the op
         # inside an XLA fusion region (memory-bound op, tiny working set).
@@ -61,12 +69,35 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
             except Exception:
                 profitable = False
             if not profitable:
+                if log:
+                    from thunder_tpu.core import cost_model
+
+                    # a broken cost model fails the claim CLOSED (above);
+                    # logging its numbers must not resurrect the exception
+                    try:
+                        flops, nbytes = cost_model.bsym_cost(bsym)
+                        cost = {"flops": flops, "bytes": nbytes,
+                                "min_claim_bytes": cost_model.MIN_CLAIM_BYTES}
+                    except Exception:
+                        cost = None
+                    _decisions.record(
+                        "claim", bsym.sym.name, ex.name, "rejected",
+                        "cost model: claim loses to XLA region fusion",
+                        cost=cost)
                 continue
         if not getattr(ex, "get_fuel", lambda *_: True)():
+            if log:
+                _decisions.record("claim", bsym.sym.name, ex.name, "rejected",
+                                  "optimization fuel exhausted")
             continue
         if impl.execution_transform is not None:
+            if log:
+                _decisions.record("claim", bsym.sym.name, ex.name, "claimed",
+                                  "via execution transform")
             return _run_execution_transform(impl.execution_transform, bsym, trc)
         if impl.symbol is not None:
+            if log:
+                _decisions.record("claim", bsym.sym.name, ex.name, "claimed")
             claimed = impl.symbol.bind(*bsym.args, output=bsym.output,
                                        subsymbols=bsym.subsymbols, **bsym.kwargs)
             claimed.header = bsym.header  # keep pass annotations (fusion markers)
@@ -76,6 +107,9 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
     if bsym.sym.is_prim:
         check(get_eager_impl(bsym.sym) is not None or bsym.sym.python_impl is not None,
               lambda: f"no executor can run prim {bsym.sym.name}")
+        if log:
+            _decisions.record("claim", bsym.sym.name, "eagerjax", "fallback",
+                              "unclaimed prim runs on the eager JAX executor")
         return [bsym]
     if len(bsym.subsymbols) == 0:
         # identity composite (e.g. eval-mode dropout returns its input):
@@ -86,6 +120,10 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
         if outs and all(p.name in arg_names for p in outs):
             return []
     check(len(bsym.subsymbols) > 0, lambda: f"unclaimed symbol {bsym.sym.name} has no decomposition")
+    if log:
+        _decisions.record("claim", bsym.sym.name, None, "decomposed",
+                          f"no executor claims the composite; re-offering its "
+                          f"{len(bsym.subsymbols)} subsymbols")
     out: list[BoundSymbol] = []
     for sub in bsym.subsymbols:
         out.extend(claim_bsym(sub, executors, trc))
@@ -99,18 +137,22 @@ def transform_for_execution(trc: TraceCtx, executors) -> TraceCtx:
 
     # run BEFORE claiming: horizontal merging works on unclaimed dot_generals,
     # and the epilogue rewrite builds composites for the claim walk to offer
-    trc = horizontal_fusion_pass(trc)
-    trc = epilogue_fusion_pass(trc, executors)
+    with _observe.span("horizontal_fusion"):
+        trc = horizontal_fusion_pass(trc)
+    with _observe.span("epilogue_fusion"):
+        trc = epilogue_fusion_pass(trc, executors)
 
-    ex_bsyms: list[BoundSymbol] = []
-    for bsym in trc.bound_symbols:
-        ex_bsyms.extend(claim_bsym(bsym, executors, trc))
-    new = from_trace(trc)
-    new.bound_symbols = ex_bsyms
-    new.set_provenance("Executor claim pass")
+    with _observe.span("claim"):
+        ex_bsyms: list[BoundSymbol] = []
+        for bsym in trc.bound_symbols:
+            ex_bsyms.extend(claim_bsym(bsym, executors, trc))
+        new = from_trace(trc)
+        new.bound_symbols = ex_bsyms
+        new.set_provenance("Executor claim pass")
     for ex in executors:
         if isinstance(ex, FusionExecutor):
-            new = ex.fusion_pass(new)
+            with _observe.span(f"fusion_pass:{ex.name}"):
+                new = ex.fusion_pass(new)
     new = dce(new)
     new.set_provenance("Transform for execution")
     return new

@@ -27,6 +27,8 @@ from thunder_tpu.core.symbol import BoundSymbol, Symbol
 from thunder_tpu.core.trace import TraceCtx, from_trace
 from thunder_tpu.core.utils import consumed_vars, produced_vars
 from thunder_tpu.executors import FusionExecutor, register_executor
+from thunder_tpu.observe import decisions as _decisions
+from thunder_tpu.observe import registry as _observe
 
 _NOFUSE_IDS = {
     PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL, PrimIDs.PYTHON_PRINT,
@@ -213,6 +215,21 @@ class XLAFusionExecutor(FusionExecutor):
         sym = Symbol(f"fusion{idx}", None, id=f"xla.fusion{idx}", is_prim=True,
                      executor=self, python_impl=jitted)
         bsym = sym.bind(*inputs, output=tuple(outputs), subsymbols=list(gbsyms))
+        _observe.inc("fusion.xla_regions")
+        if _decisions.active():
+            from thunder_tpu.core import cost_model
+
+            # logging the region's cost numbers must not resurrect a
+            # cost-model exception and abort the compile
+            try:
+                flops, nbytes = cost_model.region_cost(gbsyms)
+                cost = {"ops": len(gbsyms), "flops": flops, "boundary_bytes": nbytes,
+                        "memory_bound": cost_model.is_memory_bound(flops, nbytes)}
+            except Exception:
+                cost = {"ops": len(gbsyms)}
+            _decisions.record(
+                "fusion", f"xla.fusion{idx}", self.name, "fused",
+                f"{len(gbsyms)} ops into one jax.jit region", cost=cost)
         notes = []
         absorbed = [b.sym.codegen_name() for b in gbsyms
                     if b.sym.executor is not None and b.sym.executor is not self]

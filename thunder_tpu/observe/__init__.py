@@ -1,0 +1,65 @@
+"""thunder_tpu.observe: unified tracing/metrics/explain for the compiler
+and runtime.
+
+The paper's promise is that every *trace* is inspectable; this subsystem
+makes the compiler's *decisions* inspectable too:
+
+- a process-wide metric registry (counters/gauges/histograms/spans) with
+  near-zero cost when disabled (``registry.py``),
+- compile-pipeline spans and a per-op decision log (every executor
+  claim/rejection, every fusion accept/reject with its cost-model inputs)
+  threaded through ``_compile_inner``, ``executors/passes.py``,
+  ``core/fusion_passes.py``, and ``core/rematerialization.py``,
+- runtime step metrics via a wrapper on ``CacheEntry.run_fn``
+  (``runtime.py``),
+- exporters: JSONL, Chrome/Perfetto trace, Prometheus text
+  (``exporters.py``),
+- ``explain(jfn)`` — the human report: who executes each op, why fusions
+  did or didn't fire, where compile time went (``explain.py``).
+
+Quick start::
+
+    from thunder_tpu import observe
+    observe.enable()
+    jfn = thunder_tpu.jit(fn); jfn(*args)
+    print(observe.explain(jfn))
+    observe.export_chrome_trace("/tmp/tt.json")   # open in chrome://tracing
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.observe import decisions  # noqa: F401
+from thunder_tpu.observe.exporters import (  # noqa: F401
+    chrome_trace_dict,
+    export_chrome_trace,
+    export_jsonl,
+    export_prometheus,
+)
+from thunder_tpu.observe.explain import explain  # noqa: F401
+from thunder_tpu.observe.registry import (  # noqa: F401
+    collect_pass_times,
+    disable,
+    event,
+    get_registry,
+    inc,
+    is_enabled,
+    observe_value,
+    reset,
+    set_gauge,
+    snapshot,
+    span,
+)
+from thunder_tpu.observe.registry import enable as _enable_registry
+from thunder_tpu.observe.runtime import instrument_entry, set_sync_steps  # noqa: F401
+
+
+def enable(*, clear: bool = False, sync_steps: bool | None = None) -> None:
+    """Enable instrumentation. ``clear=True`` resets prior metrics;
+    ``sync_steps=True`` blocks on step outputs so ``step.walltime_ms`` is
+    device walltime rather than dispatch time (measurement runs only).
+    ``sync_steps=None`` (default) leaves the current setting unchanged, so
+    re-enabling to clear counters never silently reverts a measurement-mode
+    choice; pass ``False`` explicitly to turn it off."""
+    if sync_steps is not None:
+        set_sync_steps(sync_steps)
+    _enable_registry(clear=clear)

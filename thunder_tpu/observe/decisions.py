@@ -1,0 +1,57 @@
+"""Per-op decision log: every executor claim/rejection, every fusion
+accept/reject, with the cost-model numbers behind each verdict.
+
+Decisions are collected per compile into ``CompileStats.last_decisions``
+(a ContextVar sink installed by ``_compile_inner``), so
+``observe.explain()`` works without enabling the process-wide registry —
+the log is a handful of small dicts per compile, negligible against
+tracing itself. When the registry is enabled, each decision is mirrored as
+an event too, so exporters see them.
+
+Record shape::
+
+    {"kind": "claim" | "fusion",
+     "op": <symbol name>,            # or pattern name for fusion decisions
+     "executor": <name> | None,
+     "decision": "claimed" | "rejected" | "fallback" | "decomposed"
+                 | "merged" | "rewritten",
+     "reason": <short string>,
+     "cost": {<cost-model inputs>} | None}
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+from thunder_tpu.observe import registry as _registry
+
+_sink: ContextVar[list | None] = ContextVar("observe_decision_sink", default=None)
+
+
+@contextmanager
+def collect():
+    """Install a fresh decision sink; yields the list decisions append to."""
+    decisions: list[dict] = []
+    tok = _sink.set(decisions)
+    try:
+        yield decisions
+    finally:
+        _sink.reset(tok)
+
+
+def active() -> bool:
+    return _sink.get() is not None or _registry.is_enabled()
+
+
+def record(kind: str, op: str, executor: str | None, decision: str,
+           reason: str = "", cost: dict | None = None) -> None:
+    sink = _sink.get()
+    if sink is None and not _registry.is_enabled():
+        return
+    rec = {"kind": kind, "op": op, "executor": executor,
+           "decision": decision, "reason": reason, "cost": cost}
+    if sink is not None:
+        sink.append(rec)
+    _registry.event("decision", decision_kind=kind, op=op, executor=executor,
+                    decision=decision, reason=reason, cost=cost)

@@ -1,0 +1,125 @@
+"""``observe.explain(jfn)``: the "why" report for a compiled function.
+
+Answers, from the last compilation of a ``thunder_tpu.jit`` function:
+
+- who executes each bound symbol of the execution trace (fusion regions
+  list their members and anything they absorbed),
+- why each fusion fired or didn't (the decision log with its cost-model
+  inputs: token counts, widths, flops/bytes),
+- why each executor claim was accepted or rejected (checker, cost model,
+  fuel),
+- where compile time went (per-pass walltimes), and
+- what a step is estimated to cost (liveness peak bytes, collective bytes).
+
+Works without ``observe.enable()`` — the decision log and pass times are
+collected per compile into ``CompileStats`` unconditionally (they are
+negligible against tracing itself).
+"""
+
+from __future__ import annotations
+
+
+def _executor_name(bsym) -> str:
+    if bsym.sym.executor is not None:
+        return bsym.sym.executor.name
+    return "eagerjax"
+
+
+def _fmt_cost(cost: dict | None) -> str:
+    if not cost:
+        return ""
+    return " (" + ", ".join(f"{k}={v}" for k, v in cost.items()) + ")"
+
+
+def explain(jfn) -> str:
+    """Return the textual report. The structured data behind it stays
+    available on ``thunder_tpu.compile_stats(jfn)`` (``last_decisions``,
+    ``last_pass_times``)."""
+    import thunder_tpu as tt
+
+    stats = tt.compile_stats(jfn)
+    lines: list[str] = []
+    name = getattr(jfn, "fn_name", getattr(jfn, "__name__", "fn"))
+    lines.append(f"thunder_tpu.observe.explain: {name}")
+
+    if not stats.last_traces:
+        lines.append("  (no compilation has run yet — call or .compile() the "
+                     "function first)")
+        return "\n".join(lines)
+
+    # -- compile summary (one renderer: CompileStats.summary) ---------------
+    lines.append("")
+    lines.append("== compile ==")
+    lines.append(stats.summary())
+
+    # -- executor assignment ------------------------------------------------
+    exec_trc = stats.last_traces[-1]
+    from thunder_tpu.core.prims import PrimIDs
+
+    skip = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL)
+    lines.append("")
+    lines.append("== executors (execution trace) ==")
+    for bsym in exec_trc.bound_symbols:
+        if bsym.sym.id in skip:
+            continue
+        ex = _executor_name(bsym)
+        entry = f"  {bsym.sym.name} [{ex}]"
+        if bsym.subsymbols and bsym.sym.executor is not None:
+            members = [s.sym.name for s in bsym.subsymbols]
+            shown = ", ".join(members[:8]) + (", ..." if len(members) > 8 else "")
+            entry += f" <- {len(members)} ops: {shown}"
+        lines.append(entry)
+
+    # -- decisions ----------------------------------------------------------
+    decisions = stats.last_decisions
+    fusion_dec = [d for d in decisions if d["kind"] == "fusion"]
+    claim_dec = [d for d in decisions if d["kind"] == "claim"]
+    lines.append("")
+    lines.append(f"== fusion decisions ({len(fusion_dec)}) ==")
+    for d in fusion_dec:
+        who = f" by {d['executor']}" if d.get("executor") else ""
+        why = f": {d['reason']}" if d.get("reason") else ""
+        lines.append(f"  {d['op']} -> {d['decision']}{who}{why}"
+                     f"{_fmt_cost(d.get('cost'))}")
+    if not fusion_dec:
+        lines.append("  (none — no fusion opportunities in this trace)")
+
+    lines.append("")
+    lines.append(f"== claim decisions ({len(claim_dec)}) ==")
+    # collapse repeats: the same (op, executor, decision, reason) may fire
+    # hundreds of times in a deep trace
+    seen: dict[tuple, int] = {}
+    order: list[tuple] = []
+    for d in claim_dec:
+        key = (d["op"], d.get("executor"), d["decision"], d.get("reason", ""))
+        if key not in seen:
+            order.append(key)
+        seen[key] = seen.get(key, 0) + 1
+    for key in order:
+        op, ex, decision, reason = key
+        n = seen[key]
+        who = f" by {ex}" if ex else ""
+        why = f": {reason}" if reason else ""
+        mult = f"  x{n}" if n > 1 else ""
+        lines.append(f"  {op} -> {decision}{who}{why}{mult}")
+
+    # -- step cost estimates ------------------------------------------------
+    lines.append("")
+    lines.append("== step estimates ==")
+    try:
+        from thunder_tpu.examine import comm_report, estimate_memory
+
+        mem = estimate_memory(exec_trc)
+        comm = comm_report(exec_trc)
+        lines.append(f"liveness peak: {mem['peak_bytes'] / 1e6:.2f} MB "
+                     f"(outputs {mem['output_bytes'] / 1e6:.2f} MB)")
+        if comm["collectives"]:
+            lines.append(f"collectives: " + ", ".join(
+                f"{k} x{v['count']} ({(v['in_bytes'] + v['out_bytes']) / 1e6:.2f} MB)"
+                for k, v in sorted(comm["collectives"].items())))
+        else:
+            lines.append("collectives: none (single-device program)")
+    except Exception as e:  # estimates must never break the report
+        lines.append(f"(estimates unavailable: {e})")
+
+    return "\n".join(lines)

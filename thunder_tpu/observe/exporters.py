@@ -1,0 +1,124 @@
+"""Exporters for the observe registry: JSONL, Chrome/Perfetto, Prometheus.
+
+- ``export_jsonl(path)`` — one JSON object per line: every counter, gauge,
+  histogram, event, and span. The grep-able archival format.
+- ``export_chrome_trace(path)`` — a ``chrome://tracing`` / Perfetto-loadable
+  JSON object: spans become complete (``ph: "X"``) events on per-thread
+  tracks, registry events become instants (``ph: "i"``). Open the file at
+  chrome://tracing or ui.perfetto.dev to see compile passes and runtime
+  steps on one timeline.
+- ``export_prometheus([path])`` — Prometheus text exposition format
+  (``# TYPE`` comments, ``_count``/``_sum``/``_bucket`` histogram series),
+  for scraping or pushing from a serving process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from thunder_tpu.observe.registry import HIST_BOUNDS, snapshot
+
+_PREFIX = "thunder_tpu"
+
+
+def export_jsonl(path: str) -> int:
+    """Write the full registry snapshot as JSON lines; returns line count."""
+    snap = snapshot()
+    n = 0
+    with open(path, "w") as f:
+        for name, v in sorted(snap["counters"].items()):
+            f.write(json.dumps({"type": "counter", "name": name, "value": v}) + "\n")
+            n += 1
+        for name, v in sorted(snap["gauges"].items()):
+            f.write(json.dumps({"type": "gauge", "name": name, "value": v}) + "\n")
+            n += 1
+        for name, h in sorted(snap["histograms"].items()):
+            f.write(json.dumps({"type": "histogram", "name": name, **h}) + "\n")
+            n += 1
+        for e in snap["events"]:
+            f.write(json.dumps({"type": "event", **e}, default=str) + "\n")
+            n += 1
+        for s in snap["spans"]:
+            f.write(json.dumps({"type": "span", **s}, default=str) + "\n")
+            n += 1
+    return n
+
+
+def _jsonable(v):
+    return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
+
+
+def chrome_trace_dict() -> dict:
+    """The Chrome Trace Event Format object (before serialization)."""
+    snap = snapshot()
+    pid = os.getpid()
+    events: list[dict] = []
+    tids = set()
+    for s in snap["spans"]:
+        tids.add(s["tid"])
+        events.append({
+            "name": s["name"], "cat": s["cat"], "ph": "X",
+            "ts": s["ts_us"], "dur": s["dur_us"],
+            "pid": pid, "tid": s["tid"],
+            # user spans take arbitrary args; one non-JSON value must not
+            # lose the whole trace
+            "args": {k: _jsonable(v) for k, v in s["args"].items()},
+        })
+    for e in snap["events"]:
+        args = {k: v for k, v in e.items() if k not in ("kind", "ts_us")}
+        events.append({
+            "name": e["kind"], "cat": "event", "ph": "i", "s": "p",
+            "ts": e["ts_us"], "pid": pid, "tid": 0,
+            "args": {k: _jsonable(v) for k, v in args.items()},
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": "thunder_tpu"}}]
+    for tid in sorted(tids):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                     "args": {"name": f"thread-{tid}"}})
+    return {"traceEvents": meta + sorted(events, key=lambda e: e["ts"]),
+            "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write a chrome://tracing-loadable trace; returns event count."""
+    trace = chrome_trace_dict()
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])
+
+
+def _prom_name(name: str) -> str:
+    return f"{_PREFIX}_" + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def export_prometheus(path: str | None = None) -> str:
+    """Prometheus text format of counters/gauges/histograms. Returns the
+    text; also writes it to ``path`` when given."""
+    snap = snapshot()
+    lines: list[str] = []
+    for name, v in sorted(snap["counters"].items()):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {v}")
+    for name, v in sorted(snap["gauges"].items()):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {v}")
+    for name, h in sorted(snap["histograms"].items()):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for bound, count in zip([*HIST_BOUNDS, float("inf")], h["buckets"].values()):
+            cum += count
+            le = "+Inf" if bound == float("inf") else repr(bound)
+            lines.append(f'{m}_bucket{{le="{le}"}} {cum}')
+        lines.append(f"{m}_count {h['count']}")
+        lines.append(f"{m}_sum {h['sum']}")
+    text = "\n".join(lines) + ("\n" if lines else "")
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

@@ -1,0 +1,269 @@
+"""Process-wide event/metric registry: counters, gauges, histograms, spans.
+
+The instrumentation contract for the whole compiler/runtime stack:
+
+- **Near-zero cost when disabled.** Every recording entry point checks one
+  module-level boolean first and returns immediately; ``span()`` hands back
+  a shared no-op context manager (no allocation, no clock read). The hot
+  paths (``CacheEntry.run_fn`` per step, ``claim_bsym`` per op per compile)
+  pay a single predictable branch.
+- **Thread-safe when enabled.** Mutations take one lock; ``snapshot()``
+  returns plain-dict copies so exporters never race recorders.
+- **Bounded.** Events and spans live in deques with a max length — a
+  long-running serving process with observability left on cannot grow
+  memory without bound.
+
+Metric names are dotted (``cache.hits``, ``fusion.horizontal_merges``,
+``step.walltime_ms``); exporters map them to their own conventions
+(Prometheus flattens dots to underscores).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any
+
+MAX_EVENTS = 65536
+MAX_SPANS = 65536
+
+# histogram bucket ladder (unitless; walltimes are recorded in ms)
+HIST_BOUNDS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+               250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    __slots__ = ("count", "total", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.buckets = [0] * (len(HIST_BOUNDS) + 1)  # last = +Inf
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        for i, b in enumerate(HIST_BOUNDS):
+            if value <= b:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "sum": self.total,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "buckets": dict(zip([*map(str, HIST_BOUNDS), "+Inf"], self.buckets))}
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self.events: deque = deque(maxlen=MAX_EVENTS)
+        self.spans: deque = deque(maxlen=MAX_SPANS)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+            self.events.clear()
+            self.spans.clear()
+
+
+_registry = Registry()
+_enabled = False
+
+# epoch anchor so span timestamps are wall-clock-meaningful while durations
+# come from the monotonic clock
+_EPOCH_US = time.time() * 1e6 - time.perf_counter_ns() / 1e3
+
+
+def _now_us() -> float:
+    return _EPOCH_US + time.perf_counter_ns() / 1e3
+
+
+def enable(*, clear: bool = False) -> None:
+    """Turn instrumentation on process-wide. ``clear=True`` resets all
+    previously recorded metrics/events first."""
+    global _enabled
+    if clear:
+        _registry.clear()
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Clear all recorded metrics, events, and spans (enabled state is kept)."""
+    _registry.clear()
+
+
+def get_registry() -> Registry:
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# recording entry points (each begins with the enabled check)
+# ---------------------------------------------------------------------------
+
+def inc(name: str, value: float = 1.0) -> None:
+    if not _enabled:
+        return
+    with _registry._lock:
+        _registry.counters[name] = _registry.counters.get(name, 0.0) + value
+
+
+def set_gauge(name: str, value: float) -> None:
+    if not _enabled:
+        return
+    with _registry._lock:
+        _registry.gauges[name] = float(value)
+
+
+def observe_value(name: str, value: float) -> None:
+    if not _enabled:
+        return
+    with _registry._lock:
+        h = _registry.histograms.get(name)
+        if h is None:
+            h = _registry.histograms[name] = Histogram()
+        h.observe(value)
+
+
+def event(kind: str, **fields: Any) -> None:
+    if not _enabled:
+        return
+    rec = {"kind": kind, "ts_us": _now_us(), **fields}
+    with _registry._lock:
+        _registry.events.append(rec)
+
+
+def record_span(name: str, cat: str, ts_us: float, dur_us: float,
+                args: dict | None = None) -> None:
+    with _registry._lock:
+        _registry.spans.append({"name": name, "cat": cat, "ts_us": ts_us,
+                                "dur_us": dur_us, "tid": threading.get_ident(),
+                                "args": args or {}})
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+# per-compile sink for pass walltimes: _compile_inner sets this to the
+# CompileStats.last_pass_times dict, so pass timing is ALWAYS collected per
+# compile (a handful of clock reads against milliseconds of compilation)
+# even when the process-wide registry is off
+_pass_sink: ContextVar[dict | None] = ContextVar("observe_pass_sink", default=None)
+
+# nesting path of sink-recorded spans: a span opened inside another records
+# under "parent/child", so a flat sink dict still distinguishes a top-level
+# pass from its sub-passes (summing siblings per level is meaningful; summing
+# the whole dict is not)
+_span_path: ContextVar[tuple] = ContextVar("observe_span_path", default=())
+
+
+@contextmanager
+def collect_pass_times(sink: dict):
+    tok = _pass_sink.set(sink)
+    try:
+        yield sink
+    finally:
+        _pass_sink.reset(tok)
+
+
+class _NullCM:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CM = _NullCM()
+
+
+class _SpanCM:
+    __slots__ = ("name", "cat", "args", "sink", "_t0", "_ts", "_key", "_tok")
+
+    def __init__(self, name, cat, args, sink):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.sink = sink
+
+    def __enter__(self):
+        if self.sink is not None:
+            path = _span_path.get() + (self.name,)
+            self._key = "/".join(path)
+            self._tok = _span_path.set(path)
+        self._ts = _now_us()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ns = time.perf_counter_ns() - self._t0
+        if self.sink is not None:
+            _span_path.reset(self._tok)
+            self.sink[self._key] = self.sink.get(self._key, 0.0) + dur_ns / 1e6
+        if _enabled:
+            record_span(self.name, self.cat, self._ts, dur_ns / 1e3, self.args)
+            observe_value(f"{self.cat}.{self.name}.ms", dur_ns / 1e6)
+        return False
+
+
+def span(name: str, cat: str = "compile", args: dict | None = None,
+         record_pass_time: bool = True):
+    """Timed span context manager. Records into the per-compile pass-time
+    sink when one is active (always, during compilation; nested spans key
+    as ``parent/child``) and into the process registry when enabled;
+    otherwise a shared no-op. ``record_pass_time=False`` keeps a span out
+    of the sink (the whole-compile umbrella span, which would otherwise
+    parent — and double-count against — every pass)."""
+    sink = _pass_sink.get() if record_pass_time else None
+    if sink is None and not _enabled:
+        return _NULL_CM
+    return _SpanCM(name, cat, args, sink)
+
+
+# ---------------------------------------------------------------------------
+# snapshot
+# ---------------------------------------------------------------------------
+
+def _copy_rec(rec: dict) -> dict:
+    # records hold one level of nested dicts (span args, decision cost);
+    # copy that level too so a mutated snapshot never aliases live registry
+    # state (and exporters never race a recorder mutating a shared dict)
+    return {k: dict(v) if isinstance(v, dict) else v for k, v in rec.items()}
+
+
+def snapshot() -> dict:
+    """Plain-dict copy of all metrics/events/spans (safe to mutate/serialize)."""
+    with _registry._lock:
+        return {
+            "counters": dict(_registry.counters),
+            "gauges": dict(_registry.gauges),
+            "histograms": {k: h.to_dict() for k, h in _registry.histograms.items()},
+            "events": [_copy_rec(e) for e in _registry.events],
+            "spans": [_copy_rec(s) for s in _registry.spans],
+        }

@@ -1,0 +1,98 @@
+"""Runtime step metrics: a lightweight wrapper over ``CacheEntry.run_fn``.
+
+Every compiled entry's ``run_fn`` is wrapped once at compile time; per call
+the wrapper costs one boolean check when the registry is disabled. When
+enabled it records:
+
+- ``step.count`` / ``step.walltime_ms`` — dispatch walltime per step. JAX
+  dispatch is asynchronous: by default this measures time-to-dispatch (plus
+  any synchronous work — prologue guards, host syncs). Pass
+  ``observe.enable(sync_steps=True)`` to block on the step's outputs and
+  record true device walltime (changes pipelining — use for measurement
+  runs, not production serving). The FIRST call of an entry triggers lazy
+  XLA compilation inside ``run_fn``; it is recorded separately as
+  ``step.first_call_ms`` (and its span carries ``first_call: True``) so the
+  walltime histogram reflects steady-state steps, not compiles.
+- a ``step`` span per call (Perfetto/chrome exporter material).
+- ``step.est_live_bytes`` — the trace-liveness peak-memory estimate
+  (``examine.estimate_memory``), computed once per entry, lazily.
+- ``step.collective_bytes`` — local collective payload of one step
+  (``examine.comm_report`` total in+out), computed once per entry, lazily.
+"""
+
+from __future__ import annotations
+
+import time
+
+from thunder_tpu.observe import registry as _registry
+
+_sync_steps = False
+
+
+def set_sync_steps(value: bool) -> None:
+    global _sync_steps
+    _sync_steps = bool(value)
+
+
+def instrument_entry(entry, fn_name: str):
+    """Wrap ``entry.run_fn``; returns the wrapped callable. Static per-entry
+    estimates are computed lazily on the first *enabled* step so disabled
+    runs never pay for them."""
+    import itertools
+
+    inner = entry.run_fn
+    exec_trc = entry.traces[-1] if entry.traces else None
+    estimates: dict | None = None
+    call_counter = itertools.count(1)  # next() is atomic: concurrent callers
+    # (serving threads) each draw a distinct number, so exactly one call is
+    # classified as the compile-paying first call
+
+    def _estimates() -> dict:
+        nonlocal estimates
+        if estimates is None:
+            est: dict = {"live_bytes": 0, "collective_bytes": 0}
+            if exec_trc is not None:
+                try:
+                    from thunder_tpu.examine import comm_report, estimate_memory
+
+                    est["live_bytes"] = estimate_memory(exec_trc)["peak_bytes"]
+                    comm = comm_report(exec_trc)
+                    est["collective_bytes"] = (comm["total_in_bytes"]
+                                               + comm["total_out_bytes"])
+                except Exception:
+                    pass
+            estimates = est
+        return estimates
+
+    def run(*inps):
+        n_call = next(call_counter)
+        if not _registry.is_enabled():
+            return inner(*inps)
+        first_call = n_call == 1  # lazy XLA compile happens inside this call
+        ts = _registry._now_us()
+        t0 = time.perf_counter_ns()
+        out = inner(*inps)
+        if _sync_steps:
+            try:
+                import jax
+
+                jax.block_until_ready(out)
+            except Exception:
+                pass
+        ms = (time.perf_counter_ns() - t0) / 1e6
+        est = _estimates()
+        _registry.record_span(f"step:{fn_name}", "step", ts, ms * 1e3,
+                              {"est_live_bytes": est["live_bytes"],
+                               "collective_bytes": est["collective_bytes"],
+                               "first_call": first_call})
+        _registry.inc("step.count")
+        if first_call:
+            _registry.observe_value("step.first_call_ms", ms)
+        else:
+            _registry.observe_value("step.walltime_ms", ms)
+        _registry.set_gauge("step.est_live_bytes", est["live_bytes"])
+        _registry.set_gauge("step.collective_bytes", est["collective_bytes"])
+        return out
+
+    run.__wrapped__ = inner
+    return run
