@@ -26,6 +26,11 @@ Env overrides: BENCH_LAYERS, BENCH_BATCH, BENCH_SEQ, BENCH_STEPS,
 BENCH_MODEL (llama2-7b-bench | llama3-8b-bench [GQA]),
 BENCH_LOSS (fused | naive), BENCH_FP8=1 (FP8 delayed-scaling linears on the
 thunder side; the TransformerEngine-analog path).
+
+``--breakdown`` (or BENCH_BREAKDOWN=1) re-runs the knockout attribution
+(``thunder_tpu/benchmarks/breakdown.py``) at bench geometry with device_put
+isolated inputs and REWRITES BENCH_BREAKDOWN.json — the per-region table
+regenerates with every bench run instead of going stale as a manual runbook.
 """
 
 from __future__ import annotations
@@ -41,6 +46,8 @@ import time
 def main():
     import jax
 
+    if "--breakdown" in sys.argv:
+        os.environ["BENCH_BREAKDOWN"] = "1"
     if "--smoke" in sys.argv:
         # verify-skill hook: tiny config on whatever backend is available,
         # proving the bench path end-to-end without a real TPU or long run.
@@ -185,6 +192,7 @@ def main():
     fused_region_count = int(snap["counters"].get("fusion.xla_regions", 0))
     qkv_merges = int(snap["counters"].get("fusion.horizontal_merges", 0))
     epilogue_fusions = int(snap["counters"].get("fusion.epilogue_fusions", 0))
+    optimizer_fusions = int(snap["counters"].get("fusion.optimizer_buckets", 0))
     trace_pass_ms = snap["gauges"].get("compile.transform_ms", 0.0)
     exec_trc = tt.last_execution_trace(jstep)
     regions = [b for b in exec_trc.bound_symbols if str(b.sym.id).startswith("xla.fusion")]
@@ -195,6 +203,7 @@ def main():
         1 for b in regions if cost_model.is_memory_bound(*cost_model.region_cost(b.subsymbols)))
     print(f"fused_region_count={fused_region_count} (memory_bound={mem_bound_regions}) "
           f"horizontal_merges={qkv_merges} epilogue_fusions={epilogue_fusions} "
+          f"optimizer_fusions={optimizer_fusions} "
           f"trace_pass_ms={trace_pass_ms:.1f}", file=sys.stderr)
 
     # ---- pure jax.jit baseline (independent implementation) ----------------
@@ -298,7 +307,8 @@ def main():
         # copies were donated/consumed by the timed steps above
         rows = _bd.run_breakdown(
             cfg=cfg, n_layers=n_layers, params=params, tokens=tokens,
-            targets=targets, model_loss=model_loss, t_full=t_ours, steps=steps)
+            targets=targets, model_loss=model_loss, t_full=t_ours, steps=steps,
+            opt=opt)
         _bd.save(rows, {"model": model, "layers": n_layers, "batch": batch,
                         "seq": seq, "remat": use_remat})
 
@@ -323,6 +333,7 @@ def main():
         "fused_region_count": fused_region_count,
         "horizontal_merges": qkv_merges,
         "epilogue_fusions": epilogue_fusions,
+        "optimizer_fusions": optimizer_fusions,
         "trace_pass_ms": round(trace_pass_ms, 1),
     }))
 

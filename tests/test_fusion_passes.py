@@ -358,8 +358,189 @@ def test_bench_geometry_qkv_merges_in_trace():
 
 
 # ---------------------------------------------------------------------------
+# optimizer-phase fusion: dtype-bucketed multi-tensor AdamW
+# ---------------------------------------------------------------------------
+
+def _count_unfused_adamw_steps(trc):
+    """adamw_step bound symbols OUTSIDE a fused_adamw call (the claimed
+    fused bsym keeps the per-param chains as provenance subsymbols — those
+    don't execute and must not count as unfused)."""
+    n = 0
+
+    def walk(bsyms):
+        nonlocal n
+        for b in bsyms:
+            if b.sym.name == "fused_adamw":
+                continue
+            if b.sym.id == "optim.adamw_step":
+                n += 1
+            walk(b.subsymbols)
+
+    walk(trc.bound_symbols)
+    return n
+
+
+def _adamw_train_step(cfg_name="tiny", **adamw_kwargs):
+    from thunder_tpu.optim import AdamW
+
+    cfg = llama.CONFIGS[cfg_name]
+    params = llama.init_params(cfg, seed=9, scale_layers=2)
+    opt = AdamW(lr=1e-3, **adamw_kwargs)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_params, new_state = opt.update(params, grads, opt_state)
+        return loss, new_params, new_state
+
+    rng = np.random.RandomState(9)
+    tokens = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    return train_step, params, opt.init(params), tokens, targets
+
+
+def test_llama_train_step_fused_optimizer_shape():
+    """The llama train trace at DEFAULT options (cost-model decision, no
+    override) contains exactly one optim.fused_adamw call per dtype bucket —
+    the uniform-f32 tiny tree is ONE bucket — and zero unfused update
+    chains; numerics match the unfused path exactly."""
+    train_step, params, opt_state, tokens, targets = _adamw_train_step()
+
+    fused = tt.jit(train_step, executors=["pallas", "xla"])
+    unfused = tt.jit(train_step, executors=["pallas", "xla"], fused_optimizer=False)
+    l_f, p_f, s_f = fused(params, opt_state, tokens, targets)
+    l_u, p_u, s_u = unfused(params, opt_state, tokens, targets)
+    # ULP-scale tolerance, not bit-equality: interpret-mode pallas compiles
+    # the kernel body as one XLA computation (FMA contraction) while the
+    # unfused chain compiles per-op — see the 4-ULP parity suite in
+    # tests/test_pallas.py for the measured bound and rationale
+    np.testing.assert_allclose(np.asarray(l_f), np.asarray(l_u), rtol=1e-6)
+    for kf, ku in zip(jax.tree_util.tree_leaves(p_f), jax.tree_util.tree_leaves(p_u)):
+        np.testing.assert_allclose(np.asarray(kf), np.asarray(ku), atol=1e-6)
+
+    trc = tt.last_execution_trace(fused)
+    assert _count_symbols(trc, "fused_adamw") == 1, trc.python()
+    assert _count_unfused_adamw_steps(trc) == 0, trc.python()
+    assert "optimizer-fusion" in trc.python()
+    u_trc = tt.last_execution_trace(unfused)
+    assert _count_symbols(u_trc, "fused_adamw") == 0
+
+    decisions = tt.compile_stats(fused).last_decisions
+    bucketed = [d for d in decisions
+                if d["op"] == "optim.fused_adamw" and d["decision"] == "bucketed"]
+    assert len(bucketed) == 1
+    assert {"tensors", "total_bytes", "saved_launches"} <= set(bucketed[0]["cost"])
+
+
+def test_fused_optimizer_dtype_buckets():
+    """A mixed f32/bf16 parameter tree buckets into one fused_adamw call PER
+    dtype bucket (bf16 moment state keeps m in its own slab dtype)."""
+    import jax.numpy as jnp
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.optim import AdamW
+
+    rng = np.random.RandomState(12)
+    params = {
+        "wf1": rng.randn(16, 8).astype(np.float32),
+        "wf2": rng.randn(8,).astype(np.float32),
+        "wb1": jnp.asarray(rng.randn(8, 8).astype(np.float32), jnp.bfloat16),
+        "wb2": jnp.asarray(rng.randn(24,).astype(np.float32), jnp.bfloat16),
+    }
+    grads = jax.tree_util.tree_map(lambda p: (p * 0.1).astype(p.dtype), params)
+    opt = AdamW(lr=1e-2, state_dtype=dtypes.bfloat16)
+
+    jf = tt.jit(lambda p, g, s: opt.update(p, g, s), executors=["pallas", "xla"])
+    new_p, new_s = jf(params, grads, opt.init(params))
+    trc = tt.last_execution_trace(jf)
+    assert _count_symbols(trc, "fused_adamw") == 2, trc.python()  # f32 + bf16 buckets
+    assert _count_unfused_adamw_steps(trc) == 0
+
+    ju = tt.jit(lambda p, g, s: opt.update(p, g, s), fused_optimizer=False)
+    ref_p, ref_s = ju(params, grads, opt.init(params))
+    for a, b in zip(jax.tree_util.tree_leaves(new_p), jax.tree_util.tree_leaves(ref_p)):
+        # ULP-scale tolerance (FMA contraction across compilation modes);
+        # the strict bound lives in test_pallas.py's 4-ULP parity suite
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-5)
+
+
+def test_fused_optimizer_recoerces_checkpoint_state_dtype():
+    """Resume from an f32-moment checkpoint with a bf16-configured
+    optimizer: the first update must store the NEW m in the CONFIGURED
+    state_dtype (the long-standing AdamW.update contract), fused and
+    unfused alike — not silently keep the wider checkpoint dtype."""
+    import jax.numpy as jnp
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.optim import AdamW
+
+    rng = np.random.RandomState(13)
+    params = {"w": rng.randn(16, 8).astype(np.float32)}
+    grads = {"w": (rng.randn(16, 8) * 0.1).astype(np.float32)}
+    opt = AdamW(lr=1e-2, state_dtype=dtypes.bfloat16)
+    # checkpoint saved the moments in f32 (wider than configured)
+    ckpt_state = {"m": {"w": (rng.randn(16, 8) * 0.01).astype(np.float32)},
+                  "v": {"w": np.abs(rng.randn(16, 8) * 1e-4).astype(np.float32)},
+                  "step": np.float32(7.0)}
+
+    for kwargs in ({"executors": ["pallas", "xla"]}, {"fused_optimizer": False}):
+        jf = tt.jit(lambda p, g, s: opt.update(p, g, s), **kwargs)
+        _, new_state = jf(params, grads, ckpt_state)
+        assert jnp.asarray(new_state["m"]["w"]).dtype == jnp.bfloat16, kwargs
+        assert jnp.asarray(new_state["v"]["w"]).dtype == jnp.float32, kwargs
+
+
+def test_fused_optimizer_never_merges_dist_annotated():
+    """Dist-annotated parameters are NEVER bucketed across shards: the pass
+    must leave their adamw_step chains unfused while still bucketing the
+    plain ones in the same trace."""
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.core.fusion_passes import optimizer_fusion_pass
+    from thunder_tpu.core.proxies import DistParallelType, TensorProxy
+    from thunder_tpu.core.trace import TraceCtx, tracectx
+    from thunder_tpu.executors import pallasex
+    from thunder_tpu.ops import optim as optim_ops
+
+    trc = TraceCtx("opt_step")
+    with tracectx(trc):
+        bc1 = TensorProxy("bc1", shape=(), dtype=dtypes.float32)
+        bc2 = TensorProxy("bc2", shape=(), dtype=dtypes.float32)
+
+        def quad(name, dist=False):
+            kw = dict(shape=(8, 8), dtype=dtypes.float32)
+            p = TensorProxy(f"p_{name}", **kw)
+            if dist:
+                p.distparallel_type = DistParallelType.FULLY_SHARDED
+            return (p, TensorProxy(f"g_{name}", **kw),
+                    TensorProxy(f"m_{name}", **kw), TensorProxy(f"v_{name}", **kw))
+
+        for name, dist in (("a", False), ("b", False), ("sh", True)):
+            optim_ops.adamw_step(*quad(name, dist), bc1, bc2, lr=1e-3)
+
+    new = optimizer_fusion_pass(trc, [pallasex.ex])
+    top_ids = [b.sym.id for b in new.bound_symbols]
+    assert top_ids.count("optim.fused_adamw") == 1
+    assert top_ids.count("optim.adamw_step") == 1  # the sharded one, unfused
+    fused_bsym = next(b for b in new.bound_symbols if b.sym.id == "optim.fused_adamw")
+    fused_params = {p.name for p in fused_bsym.args[0]}
+    assert fused_params == {"p_a", "p_b"}
+
+
+# ---------------------------------------------------------------------------
 # cost model
 # ---------------------------------------------------------------------------
+
+def test_cost_model_fused_adamw_profitability():
+    # singleton bucket: nothing to amortize
+    assert not cost_model.fused_adamw_profitable(1, 10 << 20)
+    # bench-scale bucket (~100 params, ~2.7 GB of update traffic): both the
+    # launch amortization and the slab-streaming efficiency favor fusing
+    assert cost_model.fused_adamw_profitable(100, 2_700_000_000)
+    # tiny many-tensor bucket: wins on the launch term alone
+    assert cost_model.fused_adamw_profitable(2, 64 << 10)
+    c = cost_model.fused_adamw_cost(100, 2_700_000_000)
+    assert c["saved_launches"] == 99
+    assert c["est_fused_us"] < c["est_unfused_us"]
+
 
 def test_cost_model_merge_profitability():
     # bench shapes: M = 8*2048 tokens, GQA QKV widths 4096+512+512 -> merge

@@ -112,6 +112,11 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
     "nn.ring_attention": "registered lazily by the context-parallel transform; its VJP "
                          "is the ring backward in distributed/ring.py, exercised by "
                          "tests/test_distributed.py ring-attention parity tests",
+    "optim.adamw_step": "optimizer update chain — runs on detached grads/state "
+                        "strictly after the backward; never differentiated",
+    "optim.fused_adamw": "built POST-autodiff by the optimizer fusion pass "
+                         "(core/fusion_passes.py) — autodiff never sees it; "
+                         "never differentiated",
 }
 
 # OpInfo name -> composite ids its samples differentiate through (used when

@@ -290,3 +290,217 @@ def test_pallas_sdpa_combined_causal_bwd_matches_autodiff():
     np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), atol=2e-4)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), atol=2e-4)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# flash-backward parity at ragged / degenerate / GQA shapes, per kernel path
+# (satellite of the r6 backward rewrite: the dispatch in pallas_sdpa_bwd now
+# picks combined-resident -> resident-K/V pair -> grid-streaming; every path
+# must match the eagerjax sdpa VJP / jax autodiff of the decomposition)
+# ---------------------------------------------------------------------------
+
+def _causal_ref_grads(q, k, v, g):
+    import jax.numpy as jnp
+
+    def loss(q, k, v):
+        T = q.shape[-2]
+        s = (q.astype(jnp.float32) @ jnp.swapaxes(k.astype(jnp.float32), -1, -2)) \
+            / math.sqrt(q.shape[-1])
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), -1)
+        return jnp.sum((p @ v.astype(jnp.float32)) * g)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def _bwd_parity_at(T, hd=16, B=2, H=2, seed=21):
+    import jax.numpy as jnp
+    from thunder_tpu.executors import pallasex as px
+
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray((rng.randn(B, H, T, hd) * 0.3).astype(np.float32))
+    q, k, v, g = mk(), mk(), mk(), mk()
+    out, lse = px.pallas_sdpa_fwd(q, k, v, is_causal=True)
+    dq, dk, dv = px.pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=True)
+    for got, want, name in zip((dq, dk, dv), _causal_ref_grads(q, k, v, g),
+                               ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg=f"T={T} {name}")
+
+
+@pytest.mark.parametrize("T", [48, 1], ids=["ragged-T48", "decode-T1"])
+def test_pallas_sdpa_bwd_parity_ragged_and_decode(T):
+    """T not a multiple of any preferred block (48) and the T=S=1 decode
+    degenerate both claim and match the sdpa VJP decomposition. These shapes
+    take the resident-K/V pair (the causal default below the VMEM window)."""
+    _bwd_parity_at(T)
+
+
+def test_pallas_sdpa_bwd_resident_pair_diagonal_loops(monkeypatch):
+    """Force MULTI-sub-block loops through the resident-K/V pair (sub=16 at
+    T=64 -> 4 kv/q sub-blocks) so the diagonal start/stop arithmetic in both
+    kernels is exercised, not just the single-block trivial case."""
+    from thunder_tpu.executors import pallasex as px
+
+    monkeypatch.setattr(px, "_RESIDENT_BWD_COMBINED_ELEMS", 0)  # skip combined
+    monkeypatch.setattr(px, "_RESIDENT_BWD_SUB", 16)
+    _bwd_parity_at(64)
+
+
+def test_pallas_sdpa_bwd_streaming_parity_ragged(monkeypatch):
+    """The grid-streaming fallback (now reached only above the resident
+    windows on causal shapes) still matches at a ragged T."""
+    from thunder_tpu.executors import pallasex as px
+
+    monkeypatch.setattr(px, "_RESIDENT_BWD_COMBINED_ELEMS", 0)
+    monkeypatch.setattr(px, "_RESIDENT_BWD_KV_ELEMS", 0)
+    _bwd_parity_at(48)
+
+
+def test_pallas_sdpa_bwd_gqa_head_grouping():
+    """GQA: kv heads expanded across the query-head groups (the llama
+    attention path) — pallas fwd+bwd kernels vs the eagerjax/XLA VJP of the
+    same program, grads taken at the UNEXPANDED k/v (the group-sum runs
+    outside the kernels and must compose with them)."""
+    B, Hq, Hkv, T, hd = 2, 4, 2, 32, 16
+    n_rep = Hq // Hkv
+    rng = np.random.RandomState(22)
+    q = (rng.randn(B, Hq, T, hd) * 0.3).astype(np.float32)
+    k = (rng.randn(B, Hkv, T, hd) * 0.3).astype(np.float32)
+    v = (rng.randn(B, Hkv, T, hd) * 0.3).astype(np.float32)
+
+    def train(q, k, v):
+        def loss(q, k, v):
+            k2 = ops.reshape(ops.expand(ops.unsqueeze(k, 2),
+                                        (B, Hkv, n_rep, T, hd)), (B, Hq, T, hd))
+            v2 = ops.reshape(ops.expand(ops.unsqueeze(v, 2),
+                                        (B, Hkv, n_rep, T, hd)), (B, Hq, T, hd))
+            out = ops.scaled_dot_product_attention(q, k2, v2, is_causal=True)
+            return ops.sum(ops.mul(out, out))
+        return tt.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    jf = tt.jit(train, executors=["pallas", "xla"])
+    lp, gp = jf(q, k, v)
+    src = tt.last_execution_trace(jf).python()
+    assert "pallas_sdpa_bwd" in src and "pallas_sdpa_fwd" in src
+    l2, g2 = tt.jit(train, executors=["xla"])(q, k, v)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(l2), atol=1e-4, rtol=1e-4)
+    for a, b in zip(gp, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor AdamW parity: interpreter-mode Pallas vs the eager
+# per-parameter optim.AdamW.update chains, compared at ULP distance. The
+# kernel mirrors the decomposition's f32 op order EXACTLY, but bit-identity
+# across compilation modes is not well-defined on CPU: interpret-mode
+# pallas compiles the kernel body as one XLA computation whose LLVM
+# backend contracts mul+add into FMA, while the unfused chain runs per-op —
+# measured differences are a couple of final-bit ULPs, data-dependent. The
+# assertion below bounds the distance in units of the STORED dtype's last
+# place (4 ULP f32; bf16 state rounds ULP-close f32 to <= 1 bf16 ULP).
+# ---------------------------------------------------------------------------
+
+def _assert_ulp_close(a, b, max_ulp):
+    """Assert elementwise IEEE ULP distance (in the arrays' OWN dtype) is
+    bounded: the float bit patterns are mapped sign-magnitude -> monotonic
+    integer line, where adjacent representable floats differ by 1."""
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype, (a.dtype, b.dtype)
+    if a.dtype == np.float32:
+        bits, sign = np.uint32, np.int64(1) << 31
+    else:  # bfloat16 (ml_dtypes): same sign-magnitude layout, 16-bit payload
+        bits, sign = np.uint16, np.int64(1) << 15
+
+    def line(x):
+        i = x.view(bits).astype(np.int64)
+        return np.where(i & sign, -(i & (sign - 1)), i)
+
+    d = np.abs(line(a) - line(b))
+    assert int(d.max(initial=0)) <= max_ulp, \
+        f"max ULP distance {int(d.max(initial=0))} > {max_ulp}"
+
+
+def _assert_update_parity(opt, params, grads, n_steps=3, expect_buckets=1):
+    """Run n optimizer steps fused and unfused; every param/moment tensor
+    must agree to <= 4 ULP of its stored dtype, and the trace must show one
+    fused call per dtype bucket with zero unfused chains."""
+    import jax
+
+    step = lambda p, g, s: opt.update(p, g, s)
+    fused = tt.jit(step, executors=["pallas", "xla"])
+    unfused = tt.jit(step, fused_optimizer=False)
+    ps_f, ps_u = params, params
+    s_f, s_u = opt.init(params), opt.init(params)
+    for _ in range(n_steps):
+        ps_f, s_f = fused(ps_f, grads, s_f)
+        ps_u, s_u = unfused(ps_u, grads, s_u)
+    for tree_f, tree_u in ((ps_f, ps_u), (s_f["m"], s_u["m"]), (s_f["v"], s_u["v"])):
+        for a, b in zip(jax.tree_util.tree_leaves(tree_f),
+                        jax.tree_util.tree_leaves(tree_u)):
+            _assert_ulp_close(a, b, max_ulp=4)
+    names = _symbol_names(tt.last_execution_trace(fused))
+    assert "pallas_fused_adamw" in names, names
+    src_bsyms = tt.last_execution_trace(fused).bound_symbols
+
+    def count(bsyms):
+        n = 0
+        for b in bsyms:
+            n += (b.sym.name == "fused_adamw")
+            n += count(b.subsymbols) if b.sym.name != "fused_adamw" else 0
+        return n
+
+    assert count(src_bsyms) == expect_buckets
+
+
+def _param_tree(rng, dtype=np.float32):
+    import jax.numpy as jnp
+
+    mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32), dtype)
+    return {"w1": mk(16, 8), "b1": mk(16), "w2": mk(8, 16), "scale": mk(8)}
+
+
+def test_fused_adamw_parity_f32():
+    from thunder_tpu.optim import AdamW
+
+    rng = np.random.RandomState(30)
+    params = _param_tree(rng)
+    grads = _param_tree(rng)
+    _assert_update_parity(AdamW(lr=1e-2), params, grads)
+
+
+def test_fused_adamw_parity_bf16_moments():
+    """bf16 first-moment state: the m slab stays bf16 through the kernel
+    (ULP-close f32 arithmetic rounds to <= 1 bf16 ULP apart)."""
+    import jax.numpy as jnp
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.optim import AdamW
+
+    rng = np.random.RandomState(31)
+    params = _param_tree(rng)
+    grads = _param_tree(rng)
+    _assert_update_parity(AdamW(lr=1e-2, state_dtype=dtypes.bfloat16), params, grads)
+
+
+def test_fused_adamw_parity_no_weight_decay():
+    from thunder_tpu.optim import AdamW
+
+    rng = np.random.RandomState(32)
+    params = _param_tree(rng)
+    grads = _param_tree(rng)
+    _assert_update_parity(AdamW(lr=1e-2, weight_decay=0.0), params, grads)
+
+
+def test_fused_adamw_parity_mixed_dtype_tree():
+    """Mixed f32/bf16 parameter tree exercises the dtype bucketing: two
+    fused calls (one slab set per dtype), still bit-identical."""
+    import jax.numpy as jnp
+    from thunder_tpu.optim import AdamW
+
+    rng = np.random.RandomState(33)
+    p32 = _param_tree(rng)
+    p16 = {k + "_bf16": jnp.asarray(t, jnp.bfloat16) for k, t in _param_tree(rng).items()}
+    params = {**p32, **p16}
+    grads = {k: (t * 0.1).astype(t.dtype) for k, t in params.items()}
+    _assert_update_parity(AdamW(lr=1e-2), params, grads, expect_buckets=2)

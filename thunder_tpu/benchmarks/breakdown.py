@@ -50,7 +50,7 @@ def time_fn(fn, *args, steps: int = 5, trials: int = 3) -> float:
 
 
 def run_breakdown(*, cfg, n_layers, params, tokens, targets,
-                  model_loss, t_full: float, steps: int) -> dict:
+                  model_loss, t_full: float, steps: int, opt=None) -> dict:
     import jax
     import numpy as np
 
@@ -118,6 +118,18 @@ def run_breakdown(*, cfg, n_layers, params, tokens, targets,
         "lmhead_ce_fwdbwd_ms(isolated)": t_ce * 1e3,
         "linears_norms_rest_ms(residual)": t_rest * 1e3,
     }
+
+    # isolated optimizer update fed by REAL gradients: the knockout delta
+    # above includes XLA's cross-phase scheduling interplay — this is the
+    # kernel-only number the fused multi-tensor optimizer (PERF_R6) is
+    # measured against. No donation: time_fn re-feeds the same buffers each
+    # trial, and donated inputs are consumed on first use.
+    if opt is not None:
+        _, grads = jfb(params)
+        opt_state = jax.device_put(opt.init(params))
+        jupd = tt.jit(lambda p, g, s: opt.update(p, g, s))
+        rows["adamw_update_ms(isolated)"] = time_fn(
+            jupd, params, grads, opt_state, steps=steps) * 1e3
     print("--- breakdown (knockout attribution, ±10% shared-chip noise) ---",
           file=sys.stderr)
     for k_, v_ in rows.items():

@@ -146,6 +146,65 @@ def claim_worthwhile(bsym: BoundSymbol) -> bool:
     return nbytes >= MIN_CLAIM_BYTES
 
 
+# --- fused multi-tensor optimizer model -----------------------------------
+# The AdamW update is pure HBM-bound pointwise: read g,p,m,v + write p,m,v.
+# PERF_R5 measured the per-parameter fused chains at ~45% of nominal HBM
+# bandwidth at the bench scale (34 ms against a 14.7 ms roofline; a
+# hand-written pure-jax layout measured the same, so the inefficiency is the
+# per-fusion 7-stream access pattern, not framework overhead). A single
+# flattened multi-tensor kernel walks one contiguous slab per operand with
+# full-tile DMAs — modeled at 85% — and replaces n dispatches with one.
+ADAMW_LAUNCH_OVERHEAD_US = 8.0   # per-fusion dispatch + pipeline fill, v5e
+ADAMW_HBM_GBPS = 819.0           # v5e nominal HBM bandwidth
+ADAMW_CHAIN_EFFICIENCY = 0.45    # measured: per-param fused pointwise chains
+ADAMW_FUSED_EFFICIENCY = 0.85    # modeled: one contiguous slab per operand
+
+
+def fused_adamw_cost(n_tensors: int, total_bytes: int) -> dict:
+    """Bytes-moved model for one optimizer dtype bucket: estimated µs for the
+    per-parameter chains vs one flattened multi-tensor launch.
+    ``total_bytes`` is the update's moved bytes (g,p,m,v reads + p,m,v
+    writes, in their stored dtypes). Returned dict feeds the decision log
+    (``observe.explain`` shows why each bucket did or didn't fuse).
+
+    STATED ASSUMPTION: the slab pack/unpack around the kernel (the impl
+    ravels+concatenates the inputs and slices the outputs back) is NOT
+    charged to the fused path — the model assumes XLA's concatenate fusion
+    absorbs the packs into the gradient producers and the unpacks into the
+    donated-output consumers. If that fails on chip, the un-absorbed
+    traffic is another ~2× ``total_bytes`` (one staging read+write per
+    stream) and fusing large buckets is a net LOSS; the figure is surfaced
+    as ``pack_bytes_if_unabsorbed`` so the decision log carries the risk,
+    and PERF_R6 §4's interleaved A/B is the validation that decides it.
+    The same staging also defeats in-place donation aliasing for the
+    bucketed p/m/v (the slabs are fresh buffers), so peak optimizer-state
+    residency transiently grows by the bucket size during the update —
+    time, not residency, is what this model ranks; near the HBM capacity
+    limit pass ``fused_optimizer=False`` (or rely on the depth configs'
+    remat headroom) until slab-persistent state lands."""
+    stream_us = total_bytes / (ADAMW_HBM_GBPS * 1e3)
+    unfused = stream_us / ADAMW_CHAIN_EFFICIENCY + n_tensors * ADAMW_LAUNCH_OVERHEAD_US
+    fused = stream_us / ADAMW_FUSED_EFFICIENCY + ADAMW_LAUNCH_OVERHEAD_US
+    return {"tensors": n_tensors, "total_bytes": total_bytes,
+            "saved_launches": max(n_tensors - 1, 0),
+            "pack_bytes_if_unabsorbed": 2 * total_bytes,
+            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+            "est_saved_us": round(unfused - fused, 3)}
+
+
+def fused_adamw_profitable(n_tensors: int, total_bytes: int) -> bool:
+    """Fuse a bucket of n per-parameter AdamW chains into one multi-tensor
+    launch? Singleton buckets never fuse (nothing to amortize); for the rest
+    the estimate above decides — at bench scale both terms favor fusing
+    (launches amortized AND slab streaming beats the 7-stream chains), tiny
+    buckets fuse on the launch term alone. ``fused_optimizer=True/False``
+    overrides per-compile."""
+    if n_tensors < 2:
+        return False
+    c = fused_adamw_cost(n_tensors, total_bytes)
+    return c["est_fused_us"] < c["est_unfused_us"]
+
+
 def horizontal_merge_profitable(m_tokens: int, out_features) -> bool:
     """Merge k sibling GEMMs (M×K)·(K×Nᵢ) into one (M×K)·(K×ΣNᵢ)?
 

@@ -53,6 +53,7 @@ from thunder_tpu.observe import registry as _observe
 
 HORIZONTAL_MARKER = "horizontal-fusion"
 EPILOGUE_MARKER = "epilogue-fusion"
+OPTIMIZER_MARKER = "optimizer-fusion"
 
 
 # ---------------------------------------------------------------------------
@@ -426,6 +427,140 @@ def _linear_act_pattern(executors) -> tuple[Pattern, callable]:
         return repl
 
     return p, build
+
+
+# ---------------------------------------------------------------------------
+# optimizer-phase fusion (dtype-bucketed multi-tensor AdamW)
+# ---------------------------------------------------------------------------
+
+def optimizer_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
+    """Group the per-parameter ``optim.adamw_step`` chains emitted by
+    ``optim.AdamW.update`` into dtype-bucketed ``optim.fused_adamw`` calls —
+    one flattened multi-tensor kernel launch per bucket instead of one fused
+    pointwise chain per parameter (the "foreach" optimizer shape).
+
+    Bucket key: (p, g, m, v) dtypes + the shared bias-correction scalars +
+    hyperparameters — only chains that are elementwise-identical up to data
+    merge. Dist-annotated tensors are NEVER bucketed: concatenating shards
+    from different parameters would build a slab whose sharding the spec
+    propagation cannot express. Profitability comes from
+    ``cost_model.fused_adamw_profitable`` (overridable with the
+    ``fused_optimizer`` compile option), and a bucket is only rewritten when
+    some executor actually claims the fused composite; every verdict lands
+    in the decision log with the byte-model numbers.
+    """
+    enabled = get_compile_option(
+        "fused_optimizer",
+        "bucket per-parameter optimizer update chains (optim.adamw_step) by dtype "
+        "into multi-tensor optim.fused_adamw calls claimed as one kernel launch "
+        "per bucket: True = always, False = never, unset = cost-model decision",
+        None)
+    if enabled is False:
+        return trc
+    bsyms = trc.bound_symbols
+    if not any(b.sym.id == "optim.adamw_step" for b in bsyms):
+        return trc
+    from thunder_tpu.ops import optim as optim_ops
+
+    buckets: dict[tuple, list[tuple[int, BoundSymbol]]] = {}
+    for i, b in enumerate(bsyms):
+        if b.sym.id != "optim.adamw_step" or len(b.args) != 6:
+            continue
+        p, g, m, v, bc1, bc2 = b.args
+        if not all(isinstance(t, TensorProxy) for t in (p, g, m, v, bc1, bc2)):
+            continue
+        if len(b.flat_proxy_outs()) != 3:
+            continue
+        if any(_dist_annotated(t) for t in (p, g, m, v)):
+            _decisions.record(
+                "fusion", "optim.fused_adamw", None, "rejected",
+                "dist-annotated parameter: shards are never merged into a bucket",
+                cost={"param": p.name})
+            continue
+        key = (p.dtype.name, g.dtype.name, m.dtype.name, v.dtype.name,
+               bc1.name, bc2.name, tuple(sorted(b.kwargs.items())))
+        buckets.setdefault(key, []).append((i, b))
+
+    replacements: dict[int, list[BoundSymbol]] = {}  # last-member index -> bsyms
+    dropped: set[int] = set()
+    n_fused = 0
+    for key, members in sorted(buckets.items(), key=lambda kv: kv[1][0][0]):
+        n = len(members)
+        total_bytes = sum(
+            cost_model.tensor_bytes(m_[1].args[1])            # g read
+            + 2 * (cost_model.tensor_bytes(m_[1].args[0])     # p read+write
+                   + cost_model.tensor_bytes(m_[1].args[2])   # m read+write
+                   + cost_model.tensor_bytes(m_[1].args[3]))  # v read+write
+            for m_ in members)
+        cost = dict(cost_model.fused_adamw_cost(n, total_bytes), dtypes=key[:4])
+        if n < 2:
+            _decisions.record("fusion", "optim.fused_adamw", None, "rejected",
+                              "singleton dtype bucket: nothing to amortize",
+                              cost=cost)
+            continue
+        # the fused call lands at the LAST member's position (all inputs are
+        # defined by then); any interleaved consumer of an earlier member's
+        # output would then read it before it exists — skip such buckets
+        member_idx = {m_[0] for m_ in members}
+        out_names = {o.name for _, b in members for o in b.flat_proxy_outs()}
+        first, last = members[0][0], members[-1][0]
+        interleaved = any(
+            j not in member_idx
+            and any(p_.name in out_names for p_ in bsyms[j].flat_proxy_args())
+            for j in range(first, last + 1))
+        if interleaved:
+            _decisions.record("fusion", "optim.fused_adamw", None, "rejected",
+                              "an interleaved bsym consumes a member's output "
+                              "before the bucketed call would produce it",
+                              cost=cost)
+            continue
+        if enabled is not True and not cost_model.fused_adamw_profitable(n, total_bytes):
+            _decisions.record("fusion", "optim.fused_adamw", None, "rejected",
+                              "cost model: bucketing estimate loses to the "
+                              "per-parameter chains", cost=cost)
+            continue
+        ps, gs, ms, vs = (tuple(m_[1].args[j] for m_ in members) for j in range(4))
+        bc1, bc2 = members[0][1].args[4], members[0][1].args[5]
+        kwargs = dict(members[0][1].kwargs)
+        old_outs = ([m_[1].flat_proxy_outs()[0] for m_ in members]
+                    + [m_[1].flat_proxy_outs()[1] for m_ in members]
+                    + [m_[1].flat_proxy_outs()[2] for m_ in members])
+        if not _some_executor_claims(executors, "optim.fused_adamw",
+                                     (ps, gs, ms, vs, bc1, bc2), kwargs,
+                                     tuple(old_outs)):
+            _decisions.record("fusion", "optim.fused_adamw", None, "rejected",
+                              "no executor claims the fused composite "
+                              "(checker or cost-model gate)", cost=cost)
+            continue
+        repl = _build_composite(trc, optim_ops.fused_adamw,
+                                (ps, gs, ms, vs, bc1, bc2), kwargs, old_outs)
+        if not repl:
+            _decisions.record("fusion", "optim.fused_adamw", None, "rejected",
+                              "rebuild metadata mismatch", cost=cost)
+            continue
+        repl[-1].header = (f"{OPTIMIZER_MARKER}: {n} adamw_step chains bucketed "
+                           f"({key[0]} params, {total_bytes >> 20} MiB moved)")
+        _decisions.record("fusion", "optim.fused_adamw", None, "bucketed",
+                          "forced by fused_optimizer=True" if enabled is True
+                          else "cost model: one launch per bucket beats the "
+                               "per-parameter chains", cost=cost)
+        _observe.inc("fusion.optimizer_buckets")
+        replacements[last] = repl
+        dropped.update(m_[0] for m_ in members[:-1])
+        n_fused += 1
+
+    if not replacements:
+        return trc
+    new = from_trace(trc)
+    out: list[BoundSymbol] = []
+    for i, b in enumerate(bsyms):
+        if i in replacements:
+            out.extend(replacements[i])
+        elif i not in dropped:
+            out.append(b)
+    new.bound_symbols = out
+    new.set_provenance(f"Optimizer fusion ({n_fused} multi-tensor buckets)")
+    return new
 
 
 def epilogue_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:

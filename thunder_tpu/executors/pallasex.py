@@ -12,6 +12,9 @@ kernels claim the fused ops above what XLA would emit. Kernels:
 - ``ce_fwd``: fused cross-entropy rows (nll + logsumexp without
   materializing log-softmax).
 - ``rms_norm``: fused RMS normalization.
+- ``fused_adamw``: multi-tensor AdamW — one flattened kernel launch per
+  optimizer dtype bucket (claims ``optim.fused_adamw`` built by the
+  optimizer fusion pass; the apex ``multi_tensor_apply`` analog).
 
 Claim policy: on real TPU when shapes align to lane/sublane tiling; in
 interpret mode (``THUNDER_TPU_PALLAS_INTERPRET=1``) everywhere, which is how
@@ -427,6 +430,102 @@ def _sdpa_bwd_kernel_causal_resident(g_ref, q_ref, k_ref, v_ref, o_ref,
     dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
+# VMEM caps for the causal backward variants (elements of ONE (T, hd)
+# sequence). The combined one-kernel backward stages 9 resident (T, hd)
+# blocks + a (T, hd) f32 scratch — T*hd = 4096*128 measured 17.63M of
+# scoped VMEM on v5e (chip error, r5), so it caps at 2048*128. The
+# resident-K/V PAIR below keeps only 2-3 sequence-length tensors resident
+# per kernel, which admits the forward's 4096*128 window — sequences in
+# (2048*128, 4096*128] previously fell all the way back to the
+# grid-streaming kernels that compute (then mask) the full upper triangle.
+_RESIDENT_BWD_COMBINED_ELEMS = 2048 * 128
+_RESIDENT_BWD_KV_ELEMS = 4096 * 128
+_RESIDENT_BWD_SUB = 512  # kv/q sub-block width inside the fori_loops
+
+
+def _sdpa_dq_kernel_causal_kvres(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                                 dq_ref, *, scale: float, bq: int, sub: int):
+    """Causal dq with the WHOLE K/V resident in VMEM on a (bh, nq) grid: an
+    inner ``fori_loop`` walks kv sub-blocks and STOPS at the causal diagonal
+    — the grid-streaming dq kernel masks above-diagonal tiles but still pays
+    their MXU time, exactly the waste the r5 forward rewrite removed. dq for
+    the block is complete when the loop ends (no cross-grid scratch
+    accumulation), and delta = rowsum(dO·O) is per-q-row, computed once from
+    the streamed g/o blocks."""
+    qi = pl.program_id(1)
+    g = g_ref[0]                                  # (bq, hd) input dtype
+    q = q_ref[0]
+    lse = lse_ref[0].astype(jnp.float32)          # (bq, 1)
+    delta = jnp.sum(g.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    hd = q.shape[-1]
+    hi = (qi * bq + bq + sub - 1) // sub          # sub-blocks at/below diagonal
+
+    def body(j, acc):
+        kj = k_ref[0, pl.ds(j * sub, sub), :]     # VMEM slice, no DMA
+        vj = v_ref[0, pl.ds(j * sub, sub), :]
+        s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _causal_mask(s, qi * bq, j * sub)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(g, vj, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(kj.dtype)
+        return acc + jax.lax.dot_general(ds, kj, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, hd), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _sdpa_dkv_kernel_causal_qres(g_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                                 dk_ref, dv_ref, delta_acc, *, scale: float,
+                                 bk: int, sub: int, nsub: int):
+    """Causal dk/dv mirror: the WHOLE Q/G (and lse) resident in VMEM on a
+    (bh, nk) grid; the inner ``fori_loop`` walks q sub-blocks STARTING at
+    the kv block's diagonal (rows strictly above it contribute nothing).
+    delta is computed once per batch·head into scratch at kj == 0 and reused
+    by every kv block (the grid's innermost dimension is sequential)."""
+    kj = pl.program_id(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        delta_acc[...] = jnp.sum(g_ref[0].astype(jnp.float32)
+                                 * o_ref[0].astype(jnp.float32),
+                                 axis=-1, keepdims=True)
+
+    k = k_ref[0]                                  # (bk, hd) input dtype
+    v = v_ref[0]
+    hd = k.shape[-1]
+    lo = (kj * bk) // sub                         # first q sub-block touched
+
+    def body(i, carry):
+        dk, dv = carry
+        qi = q_ref[0, pl.ds(i * sub, sub), :]
+        gi = g_ref[0, pl.ds(i * sub, sub), :]
+        lse_i = lse_ref[0, pl.ds(i * sub, sub), :].astype(jnp.float32)
+        delta_i = delta_acc[pl.ds(i * sub, sub), :]
+        s = jax.lax.dot_general(qi, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _causal_mask(s, i * sub, kj * bk)
+        p = jnp.exp(s - lse_i)
+        dv = dv + jax.lax.dot_general(p.astype(gi.dtype), gi,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(gi, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_i) * scale).astype(qi.dtype)
+        dk = dk + jax.lax.dot_general(ds, qi, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lo, nsub, body, (jnp.zeros((bk, hd), jnp.float32),
+                         jnp.zeros((bk, hd), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
 def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
     orig_shape = q.shape
     T, hd = q.shape[-2], q.shape[-1]
@@ -442,10 +541,9 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
 
     blk = 512 if T % 512 == 0 else (256 if T % 256 == 0 else 0)
     # scoped-VMEM budget 16MB: 9 resident (T, hd) bf16 blocks + (T, hd) f32
-    # + (T, 1) f32 scratch. T*hd = 4096*128 measures 17.63M on v5e (chip
-    # error, r5) — the combined kernel caps at 2048*128 and longer
-    # sequences stream through the two-kernel path below
-    if is_causal and T == S and T * hd <= 2048 * 128 and blk:
+    # + (T, 1) f32 scratch — see _RESIDENT_BWD_COMBINED_ELEMS above; longer
+    # sequences take the resident-K/V pair, then the streaming kernels
+    if is_causal and T == S and T * hd <= _RESIDENT_BWD_COMBINED_ELEMS and blk:
         dq, dk, dv = pl.pallas_call(
             functools.partial(_sdpa_bwd_kernel_causal_resident, scale=scale_v,
                               blk=blk, nb=T // blk),
@@ -458,6 +556,41 @@ def pallas_sdpa_bwd(g, q, k, v, out, lse, is_causal=False, scale=None):
                        jax.ShapeDtypeStruct((bh, S, hd), v.dtype)],
             scratch_shapes=[pltpu.VMEM((T, hd), jnp.float32),
                             pltpu.VMEM((T, 1), jnp.float32)],
+            interpret=_interpret(),
+        )(g3, q3, k3, v3, o3, lse3)
+        return (dq.reshape(orig_shape), dk.reshape(k.shape), dv.reshape(v.shape))
+
+    sub = _pick_block(T, _RESIDENT_BWD_SUB)
+    if is_causal and T == S and T * hd <= _RESIDENT_BWD_KV_ELEMS and T % sub == 0:
+        # resident-K/V diagonal-stopping pair: the r5 forward recipe applied
+        # to both backward kernels. dq keeps K/V whole in VMEM and its inner
+        # loop stops AT the diagonal; dk/dv keeps Q/G whole and its loop
+        # starts at the diagonal — neither pays for the masked upper
+        # triangle, and neither carries scratch across grid steps.
+        seq_spec = pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0))
+        lse_seq_spec = pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0))
+        blk_spec = pl.BlockSpec((1, sub, hd), lambda b, i: (b, i, 0))
+        lse_blk_spec = pl.BlockSpec((1, sub, 1), lambda b, i: (b, i, 0))
+        dq = pl.pallas_call(
+            functools.partial(_sdpa_dq_kernel_causal_kvres, scale=scale_v,
+                              bq=sub, sub=sub),
+            grid=(bh, T // sub),
+            in_specs=[blk_spec, blk_spec, seq_spec, seq_spec, blk_spec,
+                      lse_blk_spec],
+            out_specs=blk_spec,
+            out_shape=jax.ShapeDtypeStruct((bh, T, hd), q.dtype),
+            interpret=_interpret(),
+        )(g3, q3, k3, v3, o3, lse3)
+        dk, dv = pl.pallas_call(
+            functools.partial(_sdpa_dkv_kernel_causal_qres, scale=scale_v,
+                              bk=sub, sub=sub, nsub=T // sub),
+            grid=(bh, S // sub),
+            in_specs=[seq_spec, seq_spec, blk_spec, blk_spec, seq_spec,
+                      lse_seq_spec],
+            out_specs=[blk_spec, blk_spec],
+            out_shape=[jax.ShapeDtypeStruct((bh, S, hd), k.dtype),
+                       jax.ShapeDtypeStruct((bh, S, hd), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((T, 1), jnp.float32)],
             interpret=_interpret(),
         )(g3, q3, k3, v3, o3, lse3)
         return (dq.reshape(orig_shape), dk.reshape(k.shape), dv.reshape(v.shape))
@@ -811,6 +944,122 @@ def _linear_act_checker(a, w, bias=None, act: str = "relu"):
     return K % 128 == 0 and Nf % 128 == 0 and M % 8 == 0
 
 
+# ---------------------------------------------------------------------------
+# fused multi-tensor AdamW (one kernel launch per dtype bucket: the
+# apex-multi_tensor_apply / torch-"foreach" analog, claimed from the
+# optim.fused_adamw composite built by core.fusion_passes.
+# optimizer_fusion_pass). The bucket's tensors are flattened into one
+# (rows, 128) slab per operand stream, so the kernel walks four contiguous
+# read streams and three write streams with full-tile DMAs instead of one
+# 7-stream pointwise fusion per parameter.
+# ---------------------------------------------------------------------------
+
+_ADAMW_LANE = 128        # last-dim tile width (v5e lane count)
+_ADAMW_ROW_BLOCK = 512   # rows per grid step: (512, 128) f32 = 256 KiB/stream
+
+
+def _fused_adamw_kernel(g_ref, p_ref, m_ref, v_ref, bc1_ref, bc2_ref,
+                        pn_ref, mn_ref, vn_ref, *, lr: float, beta1: float,
+                        beta2: float, eps: float, weight_decay: float):
+    """Elementwise AdamW on one slab tile; the op order mirrors the
+    ``optim.adamw_step`` decomposition exactly (f32 arithmetic, store
+    rounded to each stream's dtype). Exact op order bounds fused-vs-unfused
+    divergence at final-bit ULPs (XLA contracts mul+add to FMA differently
+    per compilation mode — bit-identity across modes is not well-defined;
+    the 4-ULP parity suite in tests/test_pallas.py pins the bound)."""
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    m_new = m * beta1 + g * (1.0 - beta1)
+    v_new = v * beta2 + (g * g) * (1.0 - beta2)
+    m_hat = m_new / bc1_ref[0, 0]
+    v_hat = v_new / bc2_ref[0, 0]
+    upd = m_hat / (jnp.sqrt(v_hat) + eps)
+    if weight_decay:
+        upd = upd + p * weight_decay
+    pn_ref[...] = (p - upd * lr).astype(pn_ref.dtype)
+    mn_ref[...] = m_new.astype(mn_ref.dtype)
+    vn_ref[...] = v_new.astype(vn_ref.dtype)
+
+
+def pallas_fused_adamw(params, grads, ms, vs, bc1, bc2, *, lr: float = 1e-3,
+                       beta1: float = 0.9, beta2: float = 0.999,
+                       eps: float = 1e-8, weight_decay: float = 0.0,
+                       state_dtype=None, v_dtype=None):
+    """One launch for the whole dtype bucket. Zero-padding the slab tail is
+    benign: padded lanes compute 0/(sqrt(0)+eps) = 0 (no NaNs) and are
+    sliced off on unpack."""
+    sizes = [int(math.prod(p.shape)) for p in params]  # () -> prod=1
+    total = sum(sizes)
+    rows = max(-(-total // _ADAMW_LANE), 1)
+    bn = min(_ADAMW_ROW_BLOCK, -(-rows // 8) * 8)
+    rows_pad = -(-rows // bn) * bn
+    n_pad = rows_pad * _ADAMW_LANE
+
+    def pack(ts):
+        flat = [jnp.ravel(t) for t in ts]
+        cat = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        if n_pad != total:
+            cat = jnp.concatenate([cat, jnp.zeros((n_pad - total,), cat.dtype)])
+        return cat.reshape(rows_pad, _ADAMW_LANE)
+
+    row_spec = pl.BlockSpec((bn, _ADAMW_LANE), lambda i: (i, 0))
+    scalar_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    pn, mn, vn = pl.pallas_call(
+        functools.partial(_fused_adamw_kernel, lr=lr, beta1=beta1, beta2=beta2,
+                          eps=eps, weight_decay=weight_decay),
+        grid=(rows_pad // bn,),
+        in_specs=[row_spec, row_spec, row_spec, row_spec, scalar_spec, scalar_spec],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE), params[0].dtype),
+            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE),
+                                 state_dtype.jax if state_dtype is not None
+                                 else ms[0].dtype),
+            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE),
+                                 v_dtype.jax if v_dtype is not None
+                                 else vs[0].dtype),
+        ],
+        interpret=_interpret(),
+        **_grid_params("parallel"),
+    )(pack(grads), pack(params), pack(ms), pack(vs),
+      jnp.asarray(bc1, jnp.float32).reshape(1, 1),
+      jnp.asarray(bc2, jnp.float32).reshape(1, 1))
+
+    def unpack(slab, like):
+        flat = slab.reshape(-1)
+        outs, off = [], 0
+        for t, s in zip(like, sizes):
+            outs.append(flat[off:off + s].reshape(t.shape))
+            off += s
+        return tuple(outs)
+
+    return unpack(pn, params), unpack(mn, ms), unpack(vn, vs)
+
+
+def _fused_adamw_checker(params, grads, ms, vs, bc1, bc2, **hyper):
+    if not _enabled():
+        return False
+    params, grads, ms, vs = tuple(params), tuple(grads), tuple(ms), tuple(vs)
+    if not params or any(len(g) != len(params) for g in (grads, ms, vs)):
+        return False
+    for group in (params, grads, ms, vs):
+        d0 = group[0].dtype
+        if any(t.dtype != d0 for t in group):
+            return False  # the fusion pass buckets by dtype; mixed = bug
+        # arithmetic is f32: claiming an f64 bucket (x64 mode) would
+        # silently narrow — reject, keep the decomposition
+        if not d0.is_float or d0.bytes > 4:
+            return False
+    # configured m/v storage dtypes (checkpoint re-coercion) must be float
+    # and representable by the f32 kernel too
+    for dt in (hyper.get("state_dtype"), hyper.get("v_dtype")):
+        if dt is not None and (not dt.is_float or dt.bytes > 4):
+            return False
+    return True
+
+
 def _pallas_claim_profitable(bsym):
     """Cost-model claim gate (``ImplInfo.profitable``): on real TPU a
     memory-bound claim with a tiny working set loses to leaving the op
@@ -856,6 +1105,15 @@ if PALLAS_AVAILABLE:
                                profitable=_pallas_claim_profitable)
     ex.register_implementation("nn.rms_norm", rms_norm_op, checker=_rms_checker,
                                profitable=_pallas_claim_profitable)
+
+    _fused_adamw_sym = get_op("optim.fused_adamw")
+    fused_adamw_op = ex.register_operator(
+        "fused_adamw", meta=_fused_adamw_sym.meta, fn=pallas_fused_adamw)
+    # no `profitable` hook: the optimizer fusion pass only BUILDS the
+    # composite when cost_model.fused_adamw_profitable already accepted the
+    # bucket, so a second claim-time gate would just re-ask the same question
+    ex.register_implementation("optim.fused_adamw", fused_adamw_op,
+                               checker=_fused_adamw_checker)
 
     _rms_res_sym = get_op("nn.rms_norm_residual")
     _linear_act_sym = get_op("nn.linear_act")

@@ -133,14 +133,21 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
 def transform_for_execution(trc: TraceCtx, executors) -> TraceCtx:
     """Fusion-prep passes + claim pass + fusion passes + DCE (reference
     ``passes.py:136``, extended with the Fusion 2.0 rewrites)."""
-    from thunder_tpu.core.fusion_passes import epilogue_fusion_pass, horizontal_fusion_pass
+    from thunder_tpu.core.fusion_passes import (
+        epilogue_fusion_pass,
+        horizontal_fusion_pass,
+        optimizer_fusion_pass,
+    )
 
     # run BEFORE claiming: horizontal merging works on unclaimed dot_generals,
-    # and the epilogue rewrite builds composites for the claim walk to offer
+    # and the epilogue/optimizer rewrites build composites for the claim walk
+    # to offer
     with _observe.span("horizontal_fusion"):
         trc = horizontal_fusion_pass(trc)
     with _observe.span("epilogue_fusion"):
         trc = epilogue_fusion_pass(trc, executors)
+    with _observe.span("optimizer_fusion"):
+        trc = optimizer_fusion_pass(trc, executors)
 
     with _observe.span("claim"):
         ex_bsyms: list[BoundSymbol] = []

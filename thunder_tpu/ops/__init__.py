@@ -2177,6 +2177,9 @@ def multinomial(a, num_samples, replacement=False, *, key=None):
 
 # nn composites live in ops.nn; re-export the common entry points
 from thunder_tpu.ops import nn  # noqa: E402
+# optimizer composites (optim.adamw_step / optim.fused_adamw) live in
+# ops.optim — imported for registration so executors can claim them
+from thunder_tpu.ops import optim  # noqa: E402,F401
 from thunder_tpu.ops.nn import (  # noqa: E402,F401
     cross_entropy,
     dropout,

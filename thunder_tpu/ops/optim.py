@@ -1,0 +1,84 @@
+"""Optimizer composite operations.
+
+``optim.adamw_step`` is the per-parameter AdamW update chain as ONE
+claimable composite (its decomposition is exactly the pointwise chain
+``thunder_tpu.optim.AdamW.update`` used to inline), and
+``optim.fused_adamw`` is the multi-tensor form the optimizer fusion pass
+(``core/fusion_passes.optimizer_fusion_pass``) builds from dtype-bucketed
+groups of those chains — the trace-level analog of the reference
+ecosystem's "foreach"/multi-tensor optimizer paths (apex
+``multi_tensor_apply``): one kernel launch per dtype bucket instead of one
+fused pointwise chain per parameter.
+
+Neither symbol is ever differentiated: both run on detached gradients and
+optimizer state strictly after the backward, so no VJP rules exist (see
+``tests/test_grad_coverage.py`` for the recorded exemption).
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check
+import thunder_tpu.ops as ops
+from thunder_tpu.ops import opsymbol
+
+
+@opsymbol(id="optim.adamw_step")
+def adamw_step(p, g, m, v, bc1, bc2, *, lr: float = 1e-3, beta1: float = 0.9,
+               beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
+               state_dtype=None, v_dtype=None):
+    """One parameter's AdamW update: ``(p, g, m, v, bias_corrections) ->
+    (p_new, m_new, v_new)``.
+
+    ``bc1``/``bc2`` are the traced bias-correction scalars ``1 - betaᵢ^step``
+    (computed once per update and shared by every parameter, so the fusion
+    pass can bucket chains that agree on them). Arithmetic is f32 (upcast,
+    update, store rounded). ``state_dtype``/``v_dtype`` are the CONFIGURED
+    storage dtypes for m/v (None keeps each input's own dtype): resuming
+    from a checkpoint whose moments were saved wider than the optimizer is
+    configured for must re-coerce on the first step, exactly as
+    ``AdamW.update`` always did — not silently keep the wider state.
+    """
+    gf = ops.convert_element_type(g, dtypes.float32)
+    mf = ops.convert_element_type(m, dtypes.float32)
+    vf = ops.convert_element_type(v, dtypes.float32)
+    m_new = ops.add(ops.mul(mf, beta1), ops.mul(gf, 1.0 - beta1))
+    v_new = ops.add(ops.mul(vf, beta2), ops.mul(ops.mul(gf, gf), 1.0 - beta2))
+    m_hat = ops.true_divide(m_new, bc1)
+    v_hat = ops.true_divide(v_new, bc2)
+    upd = ops.true_divide(m_hat, ops.add(ops.sqrt(v_hat), eps))
+    pf = ops.convert_element_type(p, dtypes.float32)
+    if weight_decay:
+        upd = ops.add(upd, ops.mul(pf, weight_decay))
+    p_new = ops.sub(pf, ops.mul(upd, lr))
+    return (ops.convert_element_type(p_new, p.dtype),
+            ops.convert_element_type(m_new, state_dtype if state_dtype is not None else m.dtype),
+            ops.convert_element_type(v_new, v_dtype if v_dtype is not None else v.dtype))
+
+
+@opsymbol(id="optim.fused_adamw")
+def fused_adamw(params, grads, ms, vs, bc1, bc2, *, lr: float = 1e-3,
+                beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0, state_dtype=None, v_dtype=None):
+    """Multi-tensor AdamW over one dtype bucket: applies ``adamw_step`` to
+    every (p, g, m, v) quadruple and returns ``(new_params, new_ms, new_vs)``
+    as parallel tuples.
+
+    Built POST-autodiff by ``optimizer_fusion_pass`` and claimed by the
+    Pallas executor as ONE flattened kernel launch per bucket
+    (``executors/pallasex.py::pallas_fused_adamw``). Unclaimed, this
+    decomposition is exactly the per-parameter chains, so numerics are
+    identical either way.
+    """
+    params, grads, ms, vs = tuple(params), tuple(grads), tuple(ms), tuple(vs)
+    check(len(params) > 0, "fused_adamw: empty bucket")
+    check(len(params) == len(grads) == len(ms) == len(vs),
+          lambda: f"fused_adamw: mismatched bucket lengths "
+                  f"{(len(params), len(grads), len(ms), len(vs))}")
+    triples = [adamw_step(p, g, m, v, bc1, bc2, lr=lr, beta1=beta1, beta2=beta2,
+                          eps=eps, weight_decay=weight_decay,
+                          state_dtype=state_dtype, v_dtype=v_dtype)
+               for p, g, m, v in zip(params, grads, ms, vs)]
+    return (tuple(t[0] for t in triples),
+            tuple(t[1] for t in triples),
+            tuple(t[2] for t in triples))

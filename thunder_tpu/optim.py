@@ -50,28 +50,28 @@ class AdamW:
 
     def update(self, params, grads, state):
         """Pure function: (params, grads, state) -> (new_params, new_state).
-        Runs under tracing; bias correction uses the traced step counter."""
+        Runs under tracing; bias correction uses the traced step counter.
+
+        Each parameter's pointwise chain is emitted as ONE
+        ``optim.adamw_step`` composite (decomposition identical to the
+        previous inline ops), so the optimizer fusion pass
+        (``core/fusion_passes.optimizer_fusion_pass``) can bucket the chains
+        by dtype into multi-tensor ``optim.fused_adamw`` calls — one Pallas
+        launch per bucket instead of ~#params fused chains. m/v store to the
+        CONFIGURED ``state_dtype``/``v_dtype`` (re-coercing checkpoint state
+        that was saved wider, as this method always did)."""
+        from thunder_tpu.ops import optim as optim_ops
+
         step = ops.add(state["step"], 1.0)
         b1, b2 = self.beta1, self.beta2
         bc1 = ops.sub(1.0, ops.pow(ops.full((), b1, dtype=dtypes.float32), step))
         bc2 = ops.sub(1.0, ops.pow(ops.full((), b2, dtype=dtypes.float32), step))
 
         def upd(p, g, m, v):
-            gf = ops.convert_element_type(g, dtypes.float32)
-            mf = ops.convert_element_type(m, dtypes.float32)
-            vf = ops.convert_element_type(v, dtypes.float32)
-            m_new = ops.add(ops.mul(mf, b1), ops.mul(gf, 1.0 - b1))
-            v_new = ops.add(ops.mul(vf, b2), ops.mul(ops.mul(gf, gf), 1.0 - b2))
-            m_hat = ops.true_divide(m_new, bc1)
-            v_hat = ops.true_divide(v_new, bc2)
-            upd_t = ops.true_divide(m_hat, ops.add(ops.sqrt(v_hat), self.eps))
-            pf = ops.convert_element_type(p, dtypes.float32)
-            if self.weight_decay:
-                upd_t = ops.add(upd_t, ops.mul(pf, self.weight_decay))
-            p_new = ops.sub(pf, ops.mul(upd_t, self.lr))
-            return (ops.convert_element_type(p_new, p.dtype),
-                    ops.convert_element_type(m_new, self.state_dtype),
-                    ops.convert_element_type(v_new, self.v_dtype))
+            return optim_ops.adamw_step(
+                p, g, m, v, bc1, bc2, lr=self.lr, beta1=b1, beta2=b2,
+                eps=self.eps, weight_decay=self.weight_decay,
+                state_dtype=self.state_dtype, v_dtype=self.v_dtype)
 
         triples = tree_map(upd, params, grads, state["m"], state["v"])
         new_params = tree_map(lambda t: t[0], triples, is_leaf=lambda x: isinstance(x, tuple))
