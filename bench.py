@@ -172,10 +172,20 @@ def main():
     observe.enable(clear=True)
     jstep = tt.jit(train_step, donate_argnums=(0, 1))
     opt_state0 = opt.init(params)
+    # warm-start accounting: with THUNDER_TPU_COMPILATION_CACHE set this
+    # wall time is the warm replay cost (executables come from disk); cold
+    # it is the full trace+compile. Stamped into the JSON either way so
+    # regressions in restart cost are tracked next to throughput.
+    t0_compile = time.perf_counter()
     if use_fp8:
         jstep.compile(params, opt_state0, fstate0, tokens, targets)
     else:
         jstep.compile(params, opt_state0, tokens, targets)
+    t_compile = time.perf_counter() - t0_compile
+    try:
+        persistent_cache_dir = jax.config.jax_compilation_cache_dir or None
+    except Exception:
+        persistent_cache_dir = None
     compile_snap = observe.snapshot()
     observe.disable()
     t_ours, loss_ours = time_steps(jstep, params, opt_state0,
@@ -335,6 +345,11 @@ def main():
         "epilogue_fusions": epilogue_fusions,
         "optimizer_fusions": optimizer_fusions,
         "trace_pass_ms": round(trace_pass_ms, 1),
+        # supervision/warm-restart health: compile wall time of the thunder
+        # step (seconds when the persistent cache is warm) + cache status
+        "compile_s": round(t_compile, 2),
+        "persistent_cache_enabled": bool(persistent_cache_dir),
+        "persistent_cache_dir": persistent_cache_dir,
     }))
 
 

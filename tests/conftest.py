@@ -25,6 +25,11 @@ def pytest_configure(config):
     # silently no-ops because of a typo'd/unknown marker
     config.addinivalue_line("markers", "slow: excluded from the tier-1 budget "
                             "(run explicitly or in the full suite)")
+    # chaos = deterministic fault-injection / recovery tests (runtime.faults
+    # schedules are seeded, so these stay IN tier-1 — the marker exists for
+    # selection, `-m chaos`, not exclusion)
+    config.addinivalue_line("markers", "chaos: deterministic fault-injection "
+                            "and recovery tests (tier-1; select with -m chaos)")
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

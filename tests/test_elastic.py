@@ -3,12 +3,13 @@ reference's failure detection / elastic recovery as Absent)."""
 
 import json
 import os
+import time
 
 import numpy as np
 import pytest
 
 import thunder_tpu as tt
-from thunder_tpu import ops
+from thunder_tpu import observe, ops
 from thunder_tpu.elastic import (
     CheckpointManager,
     ElasticTrainer,
@@ -206,3 +207,360 @@ def test_async_inflight_backlog_bounded(tmp_path):
     back = ckpt_io.load_checkpoint(str(tmp_path / "s0"),
                                    template={"w": jnp.zeros((4,))})
     np.testing.assert_array_equal(np.asarray(back["w"]), np.ones((4,)))
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-save recovery, retention correctness (torn step dirs)
+# ---------------------------------------------------------------------------
+
+def _torn_save(root: str, step: int, state):
+    """Simulate a crash between save_checkpoint and the LATEST flip: the
+    data lands but neither the commit marker nor the pointer is written."""
+    from thunder_tpu.checkpoint import save_checkpoint
+
+    save_checkpoint(os.path.join(root, f"step_{step}"), state)
+
+
+def test_crash_mid_save_recovers_previous_committed_step(tmp_path):
+    ck = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    ck.save(2, {"x": np.full((4,), 2.0)})
+    ck.save(4, {"x": np.full((4,), 4.0)})
+    _torn_save(ck.root, 6, {"x": np.full((4,), 6.0)})  # crashed before commit
+
+    # the torn dir never shadows the committed checkpoint
+    assert ck.latest_step() == 4
+    step, st = ck.restore_latest()
+    assert step == 4
+    np.testing.assert_allclose(np.asarray(st["x"]), np.full((4,), 4.0))
+
+    # a READER manager must not delete it (it could be another writer's
+    # in-flight save); the restarted writer sweeps it at startup
+    ck2 = CheckpointManager(ck.root, keep=2)
+    assert os.path.exists(ck2._step_dir(6))
+    ck2.sweep_uncommitted()
+    assert not os.path.exists(ck2._step_dir(6))
+    assert ck2.latest_step() == 4
+
+
+def test_torn_latest_pointer_falls_back_to_commit_markers(tmp_path):
+    ck = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    ck.save(2, {"x": np.full((4,), 2.0)})
+    ck.save(4, {"x": np.full((4,), 4.0)})
+    with open(os.path.join(ck.root, "LATEST"), "w") as f:
+        f.write('{"step": 4, "ti')  # torn mid-write
+    assert ck.latest_step() == 4  # newest commit marker wins
+    step, st = ck.restore_latest()
+    assert step == 4
+    np.testing.assert_allclose(np.asarray(st["x"]), np.full((4,), 4.0))
+
+
+def test_torn_dirs_never_consume_retention_slots(tmp_path):
+    """The old _gc counted ANY step dir toward `keep`, so torn uncommitted
+    dirs could push the LATEST-committed checkpoint out of the window."""
+    ck = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    ck.save(2, {"x": np.zeros((2,))})
+    ck.save(4, {"x": np.zeros((2,))})
+    _torn_save(ck.root, 6, {"x": np.zeros((2,))})
+    _torn_save(ck.root, 8, {"x": np.zeros((2,))})
+    ck.save(10, {"x": np.zeros((2,))})  # triggers _gc
+    # committed retention: {4, 10} survive; torn dirs didn't count, and the
+    # LATEST-referenced dir was never deleted
+    assert ck.latest_step() == 10
+    assert os.path.exists(ck._step_dir(4))
+    assert os.path.exists(ck._step_dir(10))
+    assert not os.path.exists(ck._step_dir(2))
+
+
+def test_gc_never_deletes_the_latest_referenced_dir(tmp_path):
+    ck = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    for s in (2, 4, 6):
+        ck.save(s, {"x": np.zeros((2,))})
+    # operator rollback: LATEST re-pinned to a step outside the keep window
+    ck._write_latest(2)
+    ck.keep = 1
+    ck._gc()
+    assert os.path.exists(ck._step_dir(2)), "LATEST's dir must survive gc"
+    assert os.path.exists(ck._step_dir(6))
+    assert not os.path.exists(ck._step_dir(4))
+
+
+def test_supervisor_resumes_after_crash_mid_save(tmp_path):
+    """End-to-end: a run dies between the checkpoint write and the LATEST
+    flip; the restarted supervisor resumes from the previous committed step
+    and reaches the same final state as an uninterrupted run."""
+    js, data_fn, state0 = _setup(tmp_path)
+    step = _make_step(js, data_fn)
+    ckdir = str(tmp_path / "ck")
+
+    ElasticTrainer(step, CheckpointManager(ckdir, keep=3), save_every=2).run(
+        state0, data_fn, 4)  # commits step_2, step_4
+    _torn_save(ckdir, 6, state0)  # the dying save of step 6
+
+    events = []
+    final = ElasticTrainer(step, CheckpointManager(ckdir, keep=3), save_every=2,
+                           on_event=lambda k, i: events.append((k, i))).run(
+        state0, data_fn, 8)
+    assert ("resume", {"step": 4}) in events  # not the torn 6
+
+    ref = ElasticTrainer(step, CheckpointManager(str(tmp_path / "ref"), keep=3),
+                         save_every=100).run(state0, data_fn, 8)
+    for a, b in zip(_final_params(ref), _final_params(final)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# stall detection: missing-heartbeat grace period, watchdog
+# ---------------------------------------------------------------------------
+
+def test_check_stalled_missing_heartbeat_grace(tmp_path):
+    path = str(tmp_path / "never_written.json")
+    t0 = 1000.0
+    # first look: inside the grace period -> not stalled yet
+    assert not check_stalled(path, timeout_s=60, _now=t0)
+    # still missing after the grace period -> stalled (the old code returned
+    # False forever for a trainer that died before its first beat)
+    assert check_stalled(path, timeout_s=60, _now=t0 + 61)
+    # explicit grace_s overrides the timeout default
+    path2 = str(tmp_path / "other.json")
+    assert not check_stalled(path2, timeout_s=60, grace_s=5, _now=t0)
+    assert check_stalled(path2, timeout_s=60, grace_s=5, _now=t0 + 6)
+    # a beat arriving later clears the missing anchor
+    hb = Heartbeat(path)
+    hb.beat(1)
+    assert not check_stalled(path, timeout_s=60, _now=time.time())
+
+
+def test_watchdog_escalates_on_missing_and_stale_beats(tmp_path):
+    from thunder_tpu.elastic import Watchdog
+
+    stalls = []
+    # never-written heartbeat: escalates after the grace period
+    wd = Watchdog(str(tmp_path / "hb.json"), timeout_s=0.05, poll_s=0.01,
+                  grace_s=0.05, escalate=stalls.append).start()
+    deadline = time.time() + 5.0
+    while not stalls and time.time() < deadline:
+        time.sleep(0.01)
+    wd.stop()
+    assert stalls and wd.escalations == 1 and wd.stalled
+
+    # stale beat: age gauge exported, one escalation per episode
+    observe.enable(clear=True)
+    hb = Heartbeat(str(tmp_path / "hb2.json"))
+    hb.beat(1)
+    with open(hb.path) as f:
+        d = json.load(f)
+    d["time"] -= 120
+    with open(hb.path, "w") as f:
+        json.dump(d, f)
+    stalls2 = []
+    wd2 = Watchdog(hb.path, timeout_s=60, poll_s=0.01, escalate=stalls2.append).start()
+    deadline = time.time() + 5.0
+    while not stalls2 and time.time() < deadline:
+        time.sleep(0.01)
+    wd2.stop()
+    assert len(stalls2) == 1 and stalls2[0] > 60
+    assert observe.snapshot()["gauges"]["runtime.heartbeat_age_s"] > 60
+    observe.disable()
+    observe.reset()
+
+
+# ---------------------------------------------------------------------------
+# supervisor: preemption, backoff, sliding-window restart budget
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_sigterm_preemption_commits_and_resumes(tmp_path):
+    """SIGTERM mid-run: the trainer finishes the in-flight step, commits a
+    checkpoint, and exits cleanly; a fresh process resumes from that step."""
+    js, data_fn, state0 = _setup(tmp_path)
+    inner = _make_step(js, data_fn)
+    ckdir = str(tmp_path / "ck")
+
+    import signal as _signal
+
+    def step_with_sigterm(state, batch):
+        state = inner(state, batch)
+        if step_with_sigterm.count == 2:  # preemption notice mid-run
+            os.kill(os.getpid(), _signal.SIGTERM)
+        step_with_sigterm.count += 1
+        return state
+
+    step_with_sigterm.count = 0
+    events = []
+    t1 = ElasticTrainer(step_with_sigterm, CheckpointManager(ckdir, keep=2),
+                        save_every=100,
+                        on_event=lambda k, i: events.append((k, i)))
+    t1.run(state0, data_fn, 8)  # returns cleanly instead of running to 8
+    preempt = [i for k, i in events if k == "preempted"]
+    assert preempt == [{"step": 3}]
+    ck = CheckpointManager(ckdir, keep=2)
+    assert ck.latest_step() == 3
+
+    # fresh process resumes from the committed step and matches a clean run
+    events2 = []
+    final = ElasticTrainer(inner, CheckpointManager(ckdir, keep=2), save_every=100,
+                           on_event=lambda k, i: events2.append((k, i))).run(
+        state0, data_fn, 8)
+    assert ("resume", {"step": 3}) in events2
+    ref = ElasticTrainer(inner, CheckpointManager(str(tmp_path / "ref"), keep=2),
+                         save_every=100).run(state0, data_fn, 8)
+    for a, b in zip(_final_params(ref), _final_params(final)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    # the run() teardown restored the default SIGTERM disposition
+    assert _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL
+
+
+@pytest.mark.chaos
+def test_transient_step_faults_recover_with_backoff(tmp_path):
+    from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+    from thunder_tpu.runtime.retry import RetryPolicy
+
+    js, data_fn, state0 = _setup(tmp_path)
+    step = _make_step(js, data_fn)
+    slept = []
+    events = []
+    trainer = ElasticTrainer(
+        step, CheckpointManager(str(tmp_path / "ck"), keep=2), save_every=2,
+        # the fault sits AT the checkpointed step, so each restore replays
+        # straight into it: consecutive failures, no resetting success between
+        fault_plan=FaultPlan([FaultSpec("step", at_steps={2}, transient=False,
+                                        max_fires=2)]),
+        retry_policy=RetryPolicy(base_delay_s=0.05, multiplier=2.0, jitter=0.0),
+        sleep_fn=slept.append,
+        on_event=lambda k, i: events.append((k, i)))
+    final = trainer.run(state0, data_fn, 6)
+
+    # two consecutive failures at step 2 -> two backoffs, exponentially grown
+    assert slept == [0.05, 0.1]
+    assert trainer.backoffs == slept
+    assert [i["attempt"] for k, i in events if k == "backoff"] == [1, 2]
+    ref = ElasticTrainer(step, CheckpointManager(str(tmp_path / "ref"), keep=2),
+                         save_every=2).run(state0, data_fn, 6)
+    for a, b in zip(_final_params(ref), _final_params(final)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_sliding_window_restart_budget(tmp_path):
+    """A fault that keeps firing exhausts a tight window; the same fault
+    pattern under a window that lets restarts age out completes the run."""
+    js, data_fn, state0 = _setup(tmp_path)
+    step = _make_step(js, data_fn)
+
+    clock = {"now": 0.0}
+    # permanent fault at step 1: the trainer can never get past it
+    with pytest.raises(RuntimeError, match="injected"):
+        ElasticTrainer(
+            step, CheckpointManager(str(tmp_path / "a"), keep=2), save_every=2,
+            max_restarts=2, restart_window_s=100.0,
+            clock=lambda: clock["now"],
+            fault_injector=FaultInjector(fail_at={1}, repeat=True)).run(
+            state0, data_fn, 4)
+
+    # four transient fires with the clock jumping past the window between
+    # failures: never more than max_restarts in any window -> run completes
+    from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+
+    def advancing_clock():
+        clock["now"] += 200.0  # every observation is a new window
+        return clock["now"]
+
+    events = []
+    trainer = ElasticTrainer(
+        step, CheckpointManager(str(tmp_path / "b"), keep=2), save_every=2,
+        max_restarts=1, restart_window_s=100.0, clock=advancing_clock,
+        fault_plan=FaultPlan([FaultSpec("step", at_steps={1}, transient=False,
+                                        max_fires=4)]),
+        on_event=lambda k, i: events.append(k))
+    trainer.run(state0, data_fn, 4)
+    assert trainer.restarts == 4  # all four recovered; lifetime cap would
+    # have raised at the second failure
+
+
+def test_fatal_exceptions_are_not_retried(tmp_path):
+    js, data_fn, state0 = _setup(tmp_path)
+
+    def bad_step(state, batch):
+        raise ValueError("programming bug, not a fault")
+
+    events = []
+    with pytest.raises(ValueError):
+        ElasticTrainer(bad_step, CheckpointManager(str(tmp_path / "ck"), keep=2),
+                       save_every=2, max_restarts=5,
+                       on_event=lambda k, i: events.append(k)).run(
+            state0, data_fn, 4)
+    assert "failure" not in events  # classified fatal: no restart attempt
+
+
+def test_elastic_tests_stay_in_tier1():
+    """Marker audit: recovery regressions must fail the gate that runs on
+    every PR, so nothing here may carry the slow marker."""
+    with open(__file__) as f:
+        src = f.read()
+    marker = "mark." + "slow"  # split so this line doesn't trip the scan
+    assert marker not in src, "elastic tests must stay in the tier-1 budget"
+
+
+def test_failure_before_first_periodic_save_replays_exactly(tmp_path):
+    """A failure before any periodic save must not replay on top of
+    already-advanced state (double-applied steps): restart-from-scratch
+    resets to the run's initial state, not the advanced one."""
+    events = []
+
+    def step(state, batch):
+        return {"w": state["w"] + batch}
+
+    final = ElasticTrainer(
+        step, CheckpointManager(str(tmp_path / "ck"), keep=2), save_every=100,
+        fault_injector=FaultInjector(fail_at={1}),
+        on_event=lambda k, i: events.append(k)).run(
+        {"w": np.zeros((2,), np.float32)},
+        lambda s: np.full((2,), float(s), np.float32), 3)
+    # steps 0,1,2 applied exactly once despite the replay: 0+1+2 = 3
+    np.testing.assert_allclose(final["w"], np.full((2,), 3.0))
+    assert "restart_from_scratch" in events
+
+
+def test_watchdog_requires_heartbeat(tmp_path):
+    with pytest.raises(ValueError, match="heartbeat"):
+        ElasticTrainer(lambda s, b: s, CheckpointManager(str(tmp_path / "ck")),
+                       watchdog_timeout_s=5.0)
+
+
+def test_sweep_preserves_pre_marker_era_checkpoints(tmp_path):
+    """A root written before commit markers existed has committed dirs with
+    no .committed files; the sweep must not destroy those rollback points —
+    only unmarked dirs ABOVE the committed latest (the in-flight tear)."""
+    ck = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    for s in (2, 4, 6):
+        ck.save(s, {"x": np.zeros((2,))})
+    for s in (2, 4, 6):  # simulate the pre-marker era
+        os.remove(os.path.join(ck._step_dir(s), CheckpointManager.COMMIT_MARKER))
+    _torn_save(ck.root, 8, {"x": np.zeros((2,))})  # the actual crash tear
+    ck2 = CheckpointManager(ck.root, keep=3)
+    ck2.sweep_uncommitted()
+    for s in (2, 4, 6):
+        assert os.path.exists(ck2._step_dir(s)), s  # rollback points survive
+    assert not os.path.exists(ck2._step_dir(8))     # the tear is gone
+
+
+def test_watchdog_grace_reanchors_when_beat_disappears(tmp_path):
+    """A heartbeat that disappears after healthy operation gets the FULL
+    grace window anchored at the disappearance — not instant escalation
+    measured from watchdog start."""
+    from thunder_tpu.elastic import Watchdog
+
+    hb = Heartbeat(str(tmp_path / "hb.json"))
+    hb.beat(1)
+    stalls = []
+    wd = Watchdog(hb.path, timeout_s=30, poll_s=0.01, grace_s=1.0,
+                  escalate=stalls.append).start()
+    time.sleep(0.2)      # healthy polls well past any zero-grace window
+    os.remove(hb.path)   # the beat vanishes mid-run
+    time.sleep(0.3)      # still inside the grace window
+    assert not stalls, "escalated with zero grace after a mid-run disappearance"
+    deadline = time.time() + 10.0
+    while not stalls and time.time() < deadline:
+        time.sleep(0.02)
+    wd.stop()
+    assert stalls  # and the grace window did eventually expire

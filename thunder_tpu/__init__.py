@@ -48,6 +48,10 @@ from thunder_tpu.core.rematerialization import (
 )
 from thunder_tpu import observe  # noqa: F401  (thunder_tpu.observe.*)
 from thunder_tpu.observe import registry as _observe
+from thunder_tpu import runtime as runtime  # noqa: F401  (fault-domain runtime)
+from thunder_tpu.runtime import faults as _faults
+from thunder_tpu.runtime import quarantine as _quarantine
+from thunder_tpu.runtime.faults import KernelExecutionError
 
 __version__ = "0.1.0"
 
@@ -91,6 +95,11 @@ def enable_compilation_cache(directory: str, *, min_compile_secs: float = 1.0) -
 
         warnings.warn("could not set the persistent-cache compile-time threshold; "
                       "jax's default (1s) applies — sub-second compiles won't persist")
+    # the kernel-quarantine set persists next to the cached executables: a
+    # warm restart skips known-bad kernels BEFORE paying a doomed compile
+    from thunder_tpu.runtime import quarantine as _rt_quarantine
+
+    _rt_quarantine.configure(str(directory))
 
 
 if _os.environ.get("THUNDER_TPU_COMPILATION_CACHE"):
@@ -340,7 +349,9 @@ class ThunderTPUFunction:
         if self.seq_buckets is not None:
             args, kwargs = self._pad_to_bucket(args, kwargs)
         flat, treedef = tree_flatten((args, kwargs))
-        key = (treedef, self._extra_cache_key,
+        # the quarantine epoch joins the key: entries compiled before a
+        # kernel was quarantined embed that kernel and must never hit again
+        key = (treedef, self._extra_cache_key, _quarantine.epoch(),
                tuple(self._leaf_cache_key(l) for l in flat)) \
             if self.cache_option != "no caching" else None
         entry = self._cache.get(key) if key is not None else None
@@ -368,8 +379,39 @@ class ThunderTPUFunction:
         inps = [flat[i] for i in entry.tensor_indices]
         if entry.uses_rng:
             inps.append(_next_rng_key())
-        result_flat = entry.run_fn(*inps)
-        return result_flat
+        try:
+            return entry.run_fn(*inps)
+        except KernelExecutionError as err:
+            return self._quarantine_and_rerun(err, args, kwargs)
+
+    def _quarantine_and_rerun(self, err: KernelExecutionError, args, kwargs):
+        """Graceful degradation: a claimed kernel died at compile or at
+        runtime — quarantine that claim id, recompile the trace with the
+        claim disabled (the op falls back to the XLA executor), and re-run.
+        Loops in case a second claimed kernel fails on the recompiled
+        program; a claim id seen twice means quarantining it didn't remove
+        it from the program, so the error is real and propagates."""
+        seen: set[str] = set()
+        while True:
+            if err.claim_id in seen:
+                raise err
+            seen.add(err.claim_id)
+            _quarantine.get_quarantine().add(
+                err.claim_id, reason=repr(err.__cause__ or err), phase=err.phase)
+            _observe.inc("runtime.fallbacks")
+            _observe.event("kernel_fallback", fn=self.fn_name, claim=err.claim_id,
+                           phase=err.phase)
+            # every cached entry may embed the quarantined kernel; the epoch
+            # in the cache key already forces misses — drop the dead entries
+            self._cache.clear()
+            entry, flat = self._entry_for(args, kwargs)
+            inps = [flat[i] for i in entry.tensor_indices]
+            if entry.uses_rng:
+                inps.append(_next_rng_key())
+            try:
+                return entry.run_fn(*inps)
+            except KernelExecutionError as e2:
+                err = e2
 
     def bind(self, *args, **kwargs):
         """Compile for these inputs and return a ZERO-GUARD callable bound
@@ -469,6 +511,7 @@ class ThunderTPUFunction:
     def _compile_inner(self, flat, treedef, args, kwargs) -> CacheEntry:
         from thunder_tpu.observe import decisions as _decisions
 
+        _faults.maybe_fail("compile", site=self.fn_name)
         # collect locally, install into stats only on success: a failed
         # recompile must not leave explain()/summary() mixing this compile's
         # partial decisions/pass-times with the previous compile's traces

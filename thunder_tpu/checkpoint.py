@@ -99,6 +99,12 @@ def save_checkpoint(path: str, state: Any, *, asynchronous: bool = False) -> boo
     when the data is fully committed on return (synchronous orbax, or the
     numpy fallback — which has no async path, so callers deferring commit
     markers can flip them immediately instead)."""
+    # `checkpoint_io` fault-injection domain: a fault here models the write
+    # tearing BEFORE any commit marker flips (the crash-mid-save scenario
+    # CheckpointManager's retention/sweep logic must survive)
+    from thunder_tpu.runtime import faults as _faults
+
+    _faults.maybe_fail("checkpoint_io", site=path)
     ocp = _orbax()
     path = os.path.abspath(path)
     if ocp is not None:

@@ -164,15 +164,35 @@ synchronize_tp_input = make_prim(DistPrimIDs.SYNCHRONIZE_TP_INPUT, "synchronize_
 # eager (jax.lax) implementations — valid inside shard_map
 # ---------------------------------------------------------------------------
 
+import functools  # noqa: E402
+
 from thunder_tpu.executors.eagerjax import impl  # noqa: E402
 
 
+def _collective_faults(fn):
+    """Host the ``collective`` fault-injection domain on each comm lowering.
+    The lowerings run while the sharded program is traced, so an injected
+    collective fault surfaces at compile/dispatch of the distributed step —
+    the point where a real hung/failed collective would take the job down."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from thunder_tpu.runtime import faults as _faults
+
+        _faults.maybe_fail("collective", site=fn.__name__.strip("_"))
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
 @impl(DistPrimIDs.ALL_GATHER)
+@_collective_faults
 def _all_gather_impl(a, axis, dim, size):
     return jax.lax.all_gather(a, axis, axis=dim, tiled=True)
 
 
 @impl(DistPrimIDs.ALL_REDUCE)
+@_collective_faults
 def _all_reduce_impl(a, axis, op="sum"):
     if op == "sum":
         return jax.lax.psum(a, axis)
@@ -186,11 +206,13 @@ def _all_reduce_impl(a, axis, op="sum"):
 
 
 @impl(DistPrimIDs.REDUCE_SCATTER)
+@_collective_faults
 def _reduce_scatter_impl(a, axis, dim, size):
     return jax.lax.psum_scatter(a, axis, scatter_dimension=dim, tiled=True)
 
 
 @impl(DistPrimIDs.BROADCAST)
+@_collective_faults
 def _broadcast_impl(a, axis, src_index=0):
     # true broadcast: every rank receives src_index's value. Lowered as a
     # masked psum — zero everywhere except src, then sum across the axis —
@@ -202,11 +224,13 @@ def _broadcast_impl(a, axis, src_index=0):
 
 
 @impl(DistPrimIDs.PPERMUTE)
+@_collective_faults
 def _ppermute_impl(a, axis, perm):
     return jax.lax.ppermute(a, axis, perm=list(perm))
 
 
 @impl(DistPrimIDs.ALL_TO_ALL)
+@_collective_faults
 def _all_to_all_impl(a, axis, split_dim, concat_dim, size):
     return jax.lax.all_to_all(a, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
 
@@ -222,6 +246,7 @@ def _axis_index_impl(axis):
 
 
 @impl(DistPrimIDs.SYNCHRONIZE)
+@_collective_faults
 def _synchronize_impl(a, axis, parallel_type, size, token=None):
     if parallel_type is DistParallelType.FULLY_SHARDED:
         return jax.lax.all_gather(a, axis, axis=0, tiled=True)
@@ -229,6 +254,7 @@ def _synchronize_impl(a, axis, parallel_type, size, token=None):
 
 
 @impl(DistPrimIDs.REGATHER)
+@_collective_faults
 def _regather_impl(a, axis, parallel_type, size, token=None):
     # the barrier prevents XLA CSE from merging this with the forward
     # all_gather (which would revert ZeRO-3 to ZeRO-2); chaining ``token``
@@ -245,11 +271,13 @@ def _regather_impl(a, axis, parallel_type, size, token=None):
 
 
 @impl(DistPrimIDs.SYNCHRONIZE_TP_OUTPUT)
+@_collective_faults
 def _sync_tp_output_impl(a, axis, size):
     return jax.lax.psum(a, axis)
 
 
 @impl(DistPrimIDs.SYNCHRONIZE_TP_INPUT)
+@_collective_faults
 def _sync_tp_input_impl(a, axis, size):
     return a
 

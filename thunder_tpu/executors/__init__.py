@@ -77,8 +77,17 @@ class OperatorExecutor(Executor):
                           like: Symbol | None = None, tags=None) -> Symbol:
         if meta is None and like is not None:
             meta = like.meta
-        sym = Symbol(name, meta, id=f"{self.name}.{name}", is_prim=True, executor=self,
-                     python_impl=fn, tags=tags or (like.tags if like is not None else None))
+        # every claimed kernel impl runs under the fault-domain guard: it
+        # hosts the `kernel:<executor>.<op>` injection domain and attributes
+        # failures to the claim id (KernelExecutionError), which is what lets
+        # the dispatch layer quarantine exactly this kernel and recompile
+        # with the XLA fallback instead of killing the job
+        from thunder_tpu.runtime.faults import kernel_guard
+
+        sym_id = f"{self.name}.{name}"
+        sym = Symbol(name, meta, id=sym_id, is_prim=True, executor=self,
+                     python_impl=kernel_guard(sym_id, fn),
+                     tags=tags or (like.tags if like is not None else None))
         return sym
 
     def register_implementation(self, id_or_sym, op: Symbol | None = None, *,

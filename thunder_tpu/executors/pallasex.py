@@ -19,6 +19,14 @@ kernels claim the fused ops above what XLA would emit. Kernels:
 Claim policy: on real TPU when shapes align to lane/sublane tiling; in
 interpret mode (``THUNDER_TPU_PALLAS_INTERPRET=1``) everywhere, which is how
 the CPU test suite exercises these kernels.
+
+Fault domains + quarantine: every impl registered below runs under
+``runtime.faults.kernel_guard`` (applied by ``register_operator``) — it
+hosts the ``kernel:pallas.<op>`` fault-injection domain and re-raises any
+failure as ``KernelExecutionError`` with the claim id, which the dispatch
+layer turns into quarantine-recompile-and-XLA-fallback instead of a dead
+job (see KERNELS.md "Kernel quarantine"). A kernel that breaks on a new
+libtpu degrades the op, not the deployment.
 """
 
 from __future__ import annotations

@@ -23,6 +23,7 @@ from thunder_tpu.core.utils import consumed_vars, produced_vars
 from thunder_tpu.executors import Executor, FusionExecutor
 from thunder_tpu.observe import decisions as _decisions
 from thunder_tpu.observe import registry as _observe
+from thunder_tpu.runtime import quarantine as _quarantine
 
 
 _PASSTHROUGH_IDS = (PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL,
@@ -53,6 +54,22 @@ def claim_bsym(bsym: BoundSymbol, executors, trc: TraceCtx) -> list[BoundSymbol]
             continue  # fusion executors run as whole-trace passes afterwards
         impl = ex.get_impl(bsym)
         if impl is None:
+            continue
+        # quarantine gate: a claim id that failed at compile/runtime (this
+        # process or a previous one — the set persists next to the compile
+        # cache) is never offered again; the op falls through to the XLA
+        # lowering. ALWAYS recorded in the decision log so explain() answers
+        # "why is this op no longer fused".
+        claim_id = impl.symbol.id if impl.symbol is not None \
+            else f"{ex.name}.{bsym.sym.name}"
+        qreason = _quarantine.quarantine_reason(claim_id)
+        if qreason is not None:
+            # (runtime.fallbacks counts degradation EVENTS at the dispatch
+            # layer; counting every per-compile rejection here would inflate
+            # the metric with each unrelated recompile)
+            if log:
+                _decisions.record("claim", bsym.sym.name, ex.name, "rejected",
+                                  f"quarantined: {qreason}")
             continue
         if not ex.can_execute(bsym):
             if log:

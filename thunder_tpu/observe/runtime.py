@@ -40,6 +40,11 @@ def instrument_entry(entry, fn_name: str):
     runs never pay for them."""
     import itertools
 
+    # the run_fn wrapper is the per-step chokepoint, so it also hosts the
+    # `dispatch` fault-injection domain (one module-global None check per
+    # call when no FaultPlan is installed)
+    from thunder_tpu.runtime import faults as _faults
+
     inner = entry.run_fn
     exec_trc = entry.traces[-1] if entry.traces else None
     estimates: dict | None = None
@@ -65,6 +70,7 @@ def instrument_entry(entry, fn_name: str):
         return estimates
 
     def run(*inps):
+        _faults.maybe_fail("dispatch", site=fn_name)
         n_call = next(call_counter)
         if not _registry.is_enabled():
             return inner(*inps)

@@ -1,0 +1,37 @@
+"""thunder_tpu.runtime: the fault-domain runtime.
+
+Production hardening for the compile/dispatch stack (ROADMAP item 5,
+SURVEY §5 "Failure detection / elastic recovery: Absent" in the reference):
+
+- ``faults``: layered fault injection — a :class:`FaultPlan` names injection
+  *domains* (``compile``, ``dispatch``, ``kernel:<claim>``, ``collective``,
+  ``checkpoint_io``, ``step``) with deterministic schedules (step sets,
+  every-N, seeded probability) and transient-vs-permanent semantics. Hook
+  points are threaded through ``_compile_inner``, the ``CacheEntry.run_fn``
+  wrapper, every ``register_operator`` claim impl (the Pallas kernels), the
+  distributed collective lowerings, and ``checkpoint.save_checkpoint``.
+- ``retry``: per-domain retry/timeout/backoff policies — jittered
+  exponential backoff, deadline budgets, a sliding-window
+  :class:`RestartBudget`, and an exception classifier
+  (retryable / fatal / degradable).
+- ``quarantine``: when a claimed kernel fails at compile or at runtime the
+  dispatch layer quarantines that claim id, recompiles the trace with the
+  claim disabled (the op falls back to the XLA executor), and persists the
+  quarantine set next to the persistent compile cache so restarts skip the
+  known-bad kernel. Every fallback lands in ``CompileStats.last_decisions``
+  (visible in ``observe.explain()``) and the ``runtime.fallbacks`` counter.
+
+The supervisor side (SIGTERM-aware checkpoint-and-exit, restart backoff,
+heartbeat watchdog) lives in ``thunder_tpu.elastic`` on top of these.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.runtime import faults, quarantine, retry  # noqa: F401
+from thunder_tpu.runtime.faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    KernelExecutionError,
+)
+from thunder_tpu.runtime.retry import RestartBudget, RetryPolicy  # noqa: F401

@@ -1,0 +1,248 @@
+"""Layered fault injection: named domains, deterministic schedules.
+
+The old ``elastic.FaultInjector`` could only raise *between* training steps.
+Recovery paths below the step loop — a Pallas kernel that dies at compile,
+a collective that times out, a checkpoint write that tears — were untestable.
+This module generalizes it: a :class:`FaultPlan` holds :class:`FaultSpec`
+entries addressed to *injection domains*, and the runtime calls
+:func:`maybe_fail` at each layer's hook point:
+
+==================  =========================================================
+domain              hook point
+==================  =========================================================
+``compile``         ``ThunderTPUFunction._compile_inner`` (trace→executable)
+``dispatch``        the ``CacheEntry.run_fn`` wrapper (one check per step)
+``kernel:<claim>``  every ``register_operator`` claim impl — e.g.
+                    ``kernel:pallas.rms_norm`` fires inside the guarded
+                    Pallas kernel (at trace time under the whole-program
+                    jit = a compile-phase kernel fault; per call on the
+                    eager per-region path = a runtime kernel fault)
+``collective``      the eager lowerings in ``distributed/prims.py``
+``checkpoint_io``   ``checkpoint.save_checkpoint``
+``step``            ``ElasticTrainer``'s step loop
+==================  =========================================================
+
+Schedules are deterministic so chaos tests are reproducible: explicit step
+sets (``at_steps``), every-N invocation counting (``every_n``), or seeded
+probability (``probability`` + ``seed``). ``transient=True`` (default) makes
+a fault fire once per schedule point and then clear — the retry/replay path
+sees a healthy system; ``transient=False`` is a permanent fault that fires
+on every matching invocation (bounded by ``max_fires``).
+
+When no plan is installed every hook costs one module-global ``is None``
+check — the production path pays nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import threading
+from contextlib import contextmanager
+from typing import Callable
+
+from thunder_tpu.observe import registry as _observe
+
+
+class InjectedFault(RuntimeError):
+    """Raised by :func:`maybe_fail` when a :class:`FaultSpec` fires."""
+
+    def __init__(self, message: str, *, domain: str = "", step: int | None = None,
+                 transient: bool = True):
+        super().__init__(message)
+        self.domain = domain
+        self.step = step
+        self.transient = transient
+
+
+class KernelExecutionError(RuntimeError):
+    """A claimed custom kernel failed. Carries the claim id so the dispatch
+    layer can quarantine exactly that kernel and recompile with the claim
+    disabled (XLA fallback) instead of taking the job down.
+
+    ``phase`` is ``"compile"`` when the failure surfaced while the impl was
+    being traced (jit/lowering time) and ``"runtime"`` when it ran eagerly.
+    """
+
+    def __init__(self, claim_id: str, phase: str = "runtime",
+                 cause: BaseException | None = None):
+        super().__init__(f"claimed kernel {claim_id!r} failed at {phase} time: "
+                         f"{cause!r}")
+        self.claim_id = claim_id
+        self.phase = phase
+
+
+class FaultSpec:
+    """One injected fault: a domain plus a deterministic schedule.
+
+    Exactly-one-of ``at_steps`` / ``every_n`` / ``probability`` selects the
+    schedule; with none given the spec fires on every matching invocation
+    (once total when ``transient``).
+    """
+
+    __slots__ = ("domain", "at_steps", "every_n", "probability", "seed",
+                 "transient", "max_fires", "exc", "_rng", "_calls", "_fires",
+                 "_fired_steps")
+
+    def __init__(self, domain: str, *, at_steps=None, every_n: int | None = None,
+                 probability: float | None = None, seed: int = 0,
+                 transient: bool = True, max_fires: int | None = None,
+                 exc: Callable[[str], BaseException] | None = None):
+        self.domain = domain
+        self.at_steps = set(at_steps) if at_steps is not None else None
+        self.every_n = every_n
+        self.probability = probability
+        self.seed = seed
+        self.transient = transient
+        self.max_fires = max_fires
+        self.exc = exc
+        self._rng = random.Random(seed)
+        self._calls = 0
+        self._fires = 0
+        self._fired_steps: set[int] = set()
+
+    def matches(self, domain: str) -> bool:
+        if self.domain.endswith("*"):
+            return domain.startswith(self.domain[:-1])
+        return domain == self.domain
+
+    def should_fire(self, step: int | None) -> bool:
+        """Advance this spec's deterministic schedule by one invocation and
+        report whether the fault fires. Not thread-safe on its own — the
+        owning :class:`FaultPlan` serializes calls."""
+        self._calls += 1
+        if self.max_fires is not None and self._fires >= self.max_fires:
+            return False
+        if self.at_steps is not None:
+            if step is None or step not in self.at_steps:
+                return False
+            if self.transient and step in self._fired_steps:
+                return False
+            self._fired_steps.add(step)
+        elif self.every_n is not None:
+            if self._calls % self.every_n != 0:
+                return False
+        elif self.probability is not None:
+            if self._rng.random() >= self.probability:
+                return False
+        elif self.transient and self._fires > 0:
+            # unscheduled transient fault: fires exactly once, ever
+            return False
+        self._fires += 1
+        return True
+
+
+class FaultPlan:
+    """A set of :class:`FaultSpec` entries consulted by every hook point."""
+
+    def __init__(self, specs=()):
+        self.specs = list(specs)
+        self._lock = threading.Lock()
+
+    def add(self, spec: FaultSpec) -> "FaultPlan":
+        self.specs.append(spec)
+        return self
+
+    def maybe_fail(self, domain: str, *, step: int | None = None,
+                   site: str | None = None) -> None:
+        for spec in self.specs:
+            if not spec.matches(domain):
+                continue
+            with self._lock:
+                fire = spec.should_fire(step)
+            if not fire:
+                continue
+            _observe.inc("runtime.faults_injected")
+            _observe.event("fault_injected", domain=domain, step=step, site=site,
+                           transient=spec.transient)
+            where = f" at step {step}" if step is not None else ""
+            at = f" ({site})" if site else ""
+            if spec.exc is not None:
+                raise spec.exc(f"injected fault in domain {domain!r}{where}{at}")
+            raise InjectedFault(
+                f"injected {'transient' if spec.transient else 'permanent'} "
+                f"fault in domain {domain!r}{where}{at}",
+                domain=domain, step=step, transient=spec.transient)
+
+
+# ---------------------------------------------------------------------------
+# the process-wide active plan (None = zero-cost hooks)
+# ---------------------------------------------------------------------------
+
+_active_plan: FaultPlan | None = None
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Install ``plan`` process-wide (``None`` clears it)."""
+    global _active_plan
+    _active_plan = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active_plan() -> FaultPlan | None:
+    return _active_plan
+
+
+@contextmanager
+def active(plan: FaultPlan):
+    """Scope a plan to a ``with`` block (restores the previous plan)."""
+    global _active_plan
+    prev = _active_plan
+    _active_plan = plan
+    try:
+        yield plan
+    finally:
+        _active_plan = prev
+
+
+def maybe_fail(domain: str, *, step: int | None = None,
+               site: str | None = None) -> None:
+    """The hook every instrumented layer calls. One ``is None`` check when
+    no plan is installed."""
+    if _active_plan is None:
+        return
+    _active_plan.maybe_fail(domain, step=step, site=site)
+
+
+# ---------------------------------------------------------------------------
+# kernel guard: fault hook + failure attribution for claimed kernels
+# ---------------------------------------------------------------------------
+
+def _looks_traced(args, kwargs) -> bool:
+    """True when any argument is a jax tracer — the guarded impl is being
+    traced into a jit program, so a failure here is a compile-phase failure.
+    Checked by mro name to avoid pinning a jax.core import surface."""
+    for x in list(args) + list(kwargs.values()):
+        if any(c.__name__ == "Tracer" for c in type(x).__mro__):
+            return True
+    return False
+
+
+def kernel_guard(claim_id: str, fn: Callable) -> Callable:
+    """Wrap a claimed kernel impl (``OperatorExecutor.register_operator``):
+
+    1. fault hook for the ``kernel:<claim_id>`` injection domain, and
+    2. failure attribution — any exception escaping the impl is re-raised as
+       :class:`KernelExecutionError` carrying ``claim_id`` and the phase, so
+       the dispatch layer can quarantine the kernel and fall back to XLA.
+    """
+    domain = f"kernel:{claim_id}"
+
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        try:
+            maybe_fail(domain, site=claim_id)
+            return fn(*args, **kwargs)
+        except KernelExecutionError:
+            raise  # a nested claim already attributed itself
+        except Exception as e:
+            # phase detection only on the failure path: the healthy per-call
+            # cost stays the module's one is-None check in maybe_fail
+            phase = "compile" if _looks_traced(args, kwargs) else "runtime"
+            raise KernelExecutionError(claim_id, phase=phase, cause=e) from e
+
+    guarded.__wrapped__ = fn
+    return guarded

@@ -1,0 +1,183 @@
+"""Kernel quarantine: remember which claimed kernels are broken, compile
+around them.
+
+When a claimed custom kernel (a Pallas claim) fails at compile or at
+runtime, the dispatch layer calls :func:`get_quarantine().add(claim_id)` and
+recompiles; the claim pass (``executors/passes.py``) consults
+:func:`quarantine_reason` before offering a bound symbol to an executor, so
+the quarantined claim is rejected with a ``"quarantined: ..."`` decision
+record (visible in ``observe.explain()``) and the op falls through to the
+XLA executor's lowering — graceful degradation instead of a dead job.
+
+Persistence: :func:`configure` points the quarantine at a directory (by
+default the persistent compile cache directory, wired through
+``thunder_tpu.enable_compilation_cache``); the set is written as JSON next
+to the cached executables, so a restarted process skips the known-bad
+kernel *before* paying a doomed compile. ``THUNDER_TPU_QUARANTINE_DIR``
+configures it from the environment.
+
+Every mutation bumps a process-wide *epoch* that joins the dispatch cache
+key, so entries compiled before a quarantine event can never serve after it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from thunder_tpu.observe import registry as _observe
+
+_FILENAME = "kernel_quarantine.json"
+
+_epoch = 0
+_epoch_lock = threading.Lock()
+
+
+def _bump_epoch() -> None:
+    global _epoch
+    with _epoch_lock:
+        _epoch += 1
+
+
+def epoch() -> int:
+    """Monotonic counter of quarantine mutations; part of the dispatch
+    cache key (a stale entry embedding a quarantined kernel never hits)."""
+    return _epoch
+
+
+class KernelQuarantine:
+    """The quarantine set: claim id -> {reason, phase, time, count}."""
+
+    def __init__(self, path: str | None = None):
+        self._lock = threading.Lock()
+        self._kernels: dict[str, dict] = {}
+        self._path: str | None = None
+        if path is not None:
+            self.attach(path)
+
+    # -- persistence --------------------------------------------------------
+    def attach(self, path: str) -> None:
+        """Bind to ``path`` (a JSON file): merge whatever a previous process
+        quarantined there, then persist the union."""
+        path = os.path.abspath(path)
+        with self._lock:
+            self._path = path
+            disk = self._load(path)
+            for k, rec in disk.items():
+                self._kernels.setdefault(k, rec)
+            self._persist()
+        _bump_epoch()
+        _observe.set_gauge("runtime.quarantined_kernels", len(self._kernels))
+
+    @staticmethod
+    def _load(path: str) -> dict:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            kernels = data.get("kernels", {})
+            return kernels if isinstance(kernels, dict) else {}
+        except Exception:
+            return {}  # missing or torn file: start empty, rewrite on add
+
+    def _persist(self) -> None:
+        if self._path is None:
+            return
+        tmp = self._path + ".tmp"
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "kernels": self._kernels}, f, indent=2)
+        os.replace(tmp, self._path)
+
+    # -- mutation -----------------------------------------------------------
+    def add(self, claim_id: str, *, reason: str = "", phase: str = "runtime") -> None:
+        with self._lock:
+            rec = self._kernels.get(claim_id)
+            if rec is None:
+                self._kernels[claim_id] = {"reason": reason, "phase": phase,
+                                           "time": time.time(), "count": 1}
+            else:
+                rec["count"] = rec.get("count", 0) + 1
+                rec["reason"] = reason or rec.get("reason", "")
+            self._persist()
+            n = len(self._kernels)
+        _bump_epoch()
+        _observe.set_gauge("runtime.quarantined_kernels", n)
+        _observe.event("kernel_quarantined", claim=claim_id, reason=reason,
+                       phase=phase)
+
+    def remove(self, claim_id: str) -> None:
+        with self._lock:
+            self._kernels.pop(claim_id, None)
+            self._persist()
+            n = len(self._kernels)
+        _bump_epoch()
+        _observe.set_gauge("runtime.quarantined_kernels", n)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._kernels.clear()
+            self._persist()
+        _bump_epoch()
+        _observe.set_gauge("runtime.quarantined_kernels", 0)
+
+    # -- queries ------------------------------------------------------------
+    def reason(self, claim_id: str) -> str | None:
+        rec = self._kernels.get(claim_id)
+        if rec is None:
+            return None
+        return rec.get("reason") or f"quarantined at {rec.get('phase', '?')} time"
+
+    def ids(self) -> tuple[str, ...]:
+        return tuple(self._kernels)
+
+    def __contains__(self, claim_id: str) -> bool:
+        return claim_id in self._kernels
+
+    def __len__(self) -> int:
+        return len(self._kernels)
+
+    @property
+    def path(self) -> str | None:
+        return self._path
+
+
+# ---------------------------------------------------------------------------
+# the process-wide quarantine
+# ---------------------------------------------------------------------------
+
+_active = KernelQuarantine()
+
+
+def get_quarantine() -> KernelQuarantine:
+    return _active
+
+
+def configure(directory: str) -> KernelQuarantine:
+    """Persist the quarantine set under ``directory`` (next to the compile
+    cache): loads claim ids a previous process recorded there."""
+    _active.attach(os.path.join(str(directory), _FILENAME))
+    return _active
+
+
+def reset(path: str | None = None) -> KernelQuarantine:
+    """Replace the process quarantine with a fresh instance (test harness:
+    simulates a process restart; pass ``path`` to re-read a persisted set)."""
+    global _active
+    _active = KernelQuarantine(path)
+    _bump_epoch()
+    _observe.set_gauge("runtime.quarantined_kernels", len(_active))
+    return _active
+
+
+def is_quarantined(claim_id: str) -> bool:
+    return claim_id in _active
+
+
+def quarantine_reason(claim_id: str) -> str | None:
+    return _active.reason(claim_id)
+
+
+if os.environ.get("THUNDER_TPU_QUARANTINE_DIR"):
+    configure(os.environ["THUNDER_TPU_QUARANTINE_DIR"])

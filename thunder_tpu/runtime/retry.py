@@ -1,0 +1,158 @@
+"""Retry/timeout/backoff policy engine.
+
+Per-domain policies with jittered exponential backoff and deadline budgets,
+plus the exception classifier that decides what a failure *means*:
+
+- ``retryable`` — transient (device error, preemption, injected transient
+  fault): back off and try again.
+- ``fatal`` — never retry (``KeyboardInterrupt``, programming errors);
+  re-raise immediately.
+- ``degradable`` — the failure names a component that can be disabled
+  (:class:`~thunder_tpu.runtime.faults.KernelExecutionError` carries a claim
+  id): quarantine it and recompile rather than retrying the same program.
+
+:class:`RestartBudget` is the sliding-window restart counter the supervisor
+uses instead of a per-lifetime cap — a job that fails once a day for a week
+is healthy; one that fails five times in ten minutes is not.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from typing import Callable
+
+from thunder_tpu.observe import registry as _observe
+from thunder_tpu.runtime.faults import InjectedFault, KernelExecutionError
+
+RETRYABLE = "retryable"
+FATAL = "fatal"
+DEGRADABLE = "degradable"
+
+
+def classify(exc: BaseException) -> str:
+    """Default exception classifier (override per call site as needed)."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+        return FATAL
+    if isinstance(exc, KernelExecutionError):
+        return DEGRADABLE
+    if isinstance(exc, InjectedFault):
+        return RETRYABLE
+    # XlaRuntimeError lives in jaxlib; match by name so environments without
+    # the extension (or with a moved module path) still classify correctly
+    if any(c.__name__ == "XlaRuntimeError" for c in type(exc).__mro__):
+        return RETRYABLE
+    if isinstance(exc, (OSError, RuntimeError)):
+        return RETRYABLE
+    return FATAL
+
+
+class RetryPolicy:
+    """Jittered exponential backoff with an optional deadline budget.
+
+    ``delay_s(attempt)`` is deterministic for a given ``seed``:
+    ``base * multiplier**(attempt-1)`` capped at ``max_delay_s``, scaled by
+    a uniform jitter in ``[1-jitter, 1+jitter]``.
+    """
+
+    def __init__(self, *, max_attempts: int = 3, base_delay_s: float = 0.05,
+                 max_delay_s: float = 5.0, multiplier: float = 2.0,
+                 jitter: float = 0.25, deadline_s: float | None = None,
+                 seed: int = 0):
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.deadline_s = deadline_s
+        self._rng = random.Random(seed)
+
+    def delay_s(self, attempt: int) -> float:
+        d = min(self.base_delay_s * self.multiplier ** max(attempt - 1, 0),
+                self.max_delay_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(d, 0.0)
+
+
+# per-domain defaults: compiles are expensive (few, patient attempts);
+# dispatch/collective failures are cheap to retry; checkpoint IO sits between
+DEFAULT_POLICIES: dict[str, RetryPolicy] = {
+    "compile": RetryPolicy(max_attempts=2, base_delay_s=1.0, max_delay_s=30.0),
+    "dispatch": RetryPolicy(max_attempts=3, base_delay_s=0.05),
+    "collective": RetryPolicy(max_attempts=3, base_delay_s=0.2, max_delay_s=10.0),
+    "checkpoint_io": RetryPolicy(max_attempts=4, base_delay_s=0.5, max_delay_s=30.0),
+    "step": RetryPolicy(max_attempts=3, base_delay_s=0.5, max_delay_s=60.0),
+}
+
+
+def policy_for(domain: str) -> RetryPolicy:
+    return DEFAULT_POLICIES.get(domain, RetryPolicy())
+
+
+def call_with_retry(fn: Callable, *args, policy: RetryPolicy | None = None,
+                    domain: str = "", classify_fn: Callable = classify,
+                    sleep: Callable[[float], None] = time.sleep,
+                    clock: Callable[[], float] = time.monotonic,
+                    on_retry: Callable | None = None, **kwargs):
+    """Run ``fn`` under ``policy``. Retries ``retryable`` failures with
+    backoff until attempts or the deadline budget run out; ``fatal`` and
+    ``degradable`` failures propagate immediately (degradation is the
+    dispatch layer's job, not a blind re-run's)."""
+    policy = policy or (policy_for(domain) if domain else RetryPolicy())
+    start = clock()
+    failures = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            if classify_fn(e) != RETRYABLE:
+                raise
+            failures += 1
+            if failures >= policy.max_attempts:
+                raise
+            d = policy.delay_s(failures)
+            if policy.deadline_s is not None and \
+                    clock() - start + d > policy.deadline_s:
+                raise
+            _observe.inc("runtime.retries")
+            _observe.observe_value("runtime.backoff_ms", d * 1e3)
+            _observe.event("retry", domain=domain, attempt=failures,
+                           delay_s=d, error=repr(e))
+            if on_retry is not None:
+                on_retry(failures, d, e)
+            sleep(d)
+
+
+class RestartBudget:
+    """Sliding-window restart counter: at most ``max_restarts`` restarts per
+    ``window_s`` seconds (``None`` = lifetime window, the legacy behavior).
+
+    ``record()`` logs one restart and returns whether the budget still
+    allows it; old restarts age out of the window, so a long-lived job is
+    judged by its recent stability, not its history."""
+
+    def __init__(self, max_restarts: int = 3, window_s: float | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self._clock = clock
+        self._events: deque[float] = deque()
+
+    def _prune(self, now: float) -> None:
+        if self.window_s is None:
+            return
+        while self._events and now - self._events[0] > self.window_s:
+            self._events.popleft()
+
+    def record(self) -> bool:
+        now = self._clock()
+        self._events.append(now)
+        self._prune(now)
+        return len(self._events) <= self.max_restarts
+
+    @property
+    def in_window(self) -> int:
+        self._prune(self._clock())
+        return len(self._events)
