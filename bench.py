@@ -216,6 +216,27 @@ def main():
           f"optimizer_fusions={optimizer_fusions} "
           f"trace_pass_ms={trace_pass_ms:.1f}", file=sys.stderr)
 
+    # ---- numerics-sentinel overhead (guarded step, same trace) --------------
+    # the "detection is cheap" claim, measured: the same train_step jitted
+    # under NumericsGuardTransform (in-graph health reductions + where-select
+    # + the one health-word fetch per step) vs the unguarded time above
+    from thunder_tpu.runtime.sentinel import NumericsPolicy
+    from thunder_tpu.transforms import NumericsGuardTransform
+
+    # overhead of DETECTION only: the escalation rungs are disarmed so an
+    # ordinary early-training loss swing can't raise LossSpike out of the
+    # timing loop (the ladder is measured by its own chaos tests, not here)
+    guard = NumericsGuardTransform(policy=NumericsPolicy(
+        spike_zscore=float("inf"), max_rewinds=0, bisect=False,
+        bisect_after=10 ** 9))
+    params_g = llama.init_params(cfg, seed=0, scale_layers=n_layers)
+    jstep_g = tt.jit(train_step, donate_argnums=(0, 1), transforms=[guard])
+    t_guard, _ = time_steps(jstep_g, params_g, opt.init(params_g),
+                            fstate0 if use_fp8 else None)
+    sentinel_overhead_pct = (t_guard - t_ours) / t_ours * 100.0
+    print(f"sentinel: {t_guard*1e3:.1f} ms/step guarded "
+          f"(overhead {sentinel_overhead_pct:+.2f}%)", file=sys.stderr)
+
     # ---- pure jax.jit baseline (independent implementation) ----------------
     def jax_rope(x, theta):
         B, H, T, hd = x.shape
@@ -350,6 +371,9 @@ def main():
         "compile_s": round(t_compile, 2),
         "persistent_cache_enabled": bool(persistent_cache_dir),
         "persistent_cache_dir": persistent_cache_dir,
+        # numerics-sentinel cost: guarded step time vs unguarded, same trace
+        # (in-graph health word + skip select + one scalar fetch per step)
+        "sentinel_overhead_pct": round(sentinel_overhead_pct, 2),
     }))
 
 

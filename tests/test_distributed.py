@@ -866,3 +866,83 @@ def test_size_1_mesh_fsdp_zero3():
     jstep = fsdp(_make_step(cfg, opt), MeshSpec.make(fsdp=1), zero=3)
     losses, _ = _run_steps(jstep, params, opt.init(params), tokens, targets)
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_clip_grad_norm_is_dist_aware(eight_devices):
+    """optim.clip_grad_norm under FSDP: each rank holds grad SHARDS, so the
+    local sum-of-squares must be all-reduced over the mesh axis — the
+    distributed global norm (and the clipped update) must match the
+    single-device run exactly."""
+    from thunder_tpu import ops
+    from thunder_tpu.core.pytree import tree_map
+    from thunder_tpu.optim import clip_grad_norm
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=1)
+    tokens, targets = _data(cfg, N, 8, seed=0)
+    max_norm = 0.25  # well below the actual norm so clipping really fires
+
+    def wrapped(params, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        clipped, norm = clip_grad_norm(grads, max_norm, params=params)
+        new_p = tree_map(ops.sub, params, clipped)
+        return loss, new_p, norm
+
+    jref = tt.jit(wrapped)
+    _, p_ref, norm_ref = jref(params, tokens, targets)
+    jdist = fsdp(wrapped, MeshSpec.make(fsdp=N))
+    _, p_dist, norm_dist = jdist(params, tokens, targets)
+    np.testing.assert_allclose(float(np.asarray(norm_dist)),
+                               float(np.asarray(norm_ref)), rtol=1e-5)
+    assert float(np.asarray(norm_ref)) > max_norm  # the clip actually engaged
+    for r, d in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_dist)):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(d),
+                                   atol=1e-6, rtol=1e-5)
+
+
+@pytest.mark.chaos
+def test_numerics_guard_composes_with_fsdp(eight_devices):
+    """NumericsGuardTransform on an FSDP step: the health word is all-reduced
+    over the mesh axis (one packed collective), so every shard takes the
+    same branch of the in-graph skip — an injected NaN-grad step holds the
+    SHARDED state bit-identical on every rank."""
+    from thunder_tpu import observe
+    from thunder_tpu.runtime import faults
+    from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+    from thunder_tpu.transforms import NumericsGuardTransform
+
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init_params(cfg, seed=0, scale_layers=1)
+    opt = AdamW(lr=1e-3)
+    tokens, targets = _data(cfg, N, 8, seed=0)
+
+    guard = NumericsGuardTransform()
+    js = fsdp(_make_step(cfg, opt), MeshSpec.make(fsdp=N), transforms=[guard])
+    ref_guard = NumericsGuardTransform()
+    jref = tt.jit(_make_step(cfg, opt), transforms=[ref_guard])
+    jref(params, opt.init(params), tokens, targets)
+    observe.enable(clear=True)
+    try:
+        l1, p1, s1 = js(params, opt.init(params), tokens, targets)
+        # the health word's global grad norm is the TRUE norm (sharded
+        # leaves psum'd, replicated leaves local), matching single-device
+        np.testing.assert_allclose(guard.sentinel.last_verdict.grad_norm,
+                                   ref_guard.sentinel.last_verdict.grad_norm,
+                                   rtol=1e-4)
+        with faults.active(FaultPlan([FaultSpec("numerics:grads",
+                                                at_steps={2})])):
+            l2, p2, s2 = js(p1, s1, tokens, targets)
+        for a, b in zip(jax.tree_util.tree_leaves((p1, s1)),
+                        jax.tree_util.tree_leaves((p2, s2))):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        l3, p3, s3 = js(p2, s2, tokens, targets)
+        assert np.isfinite(float(np.asarray(l3)))
+        snap = observe.snapshot()
+        assert snap["counters"]["runtime.skipped_steps"] == 1
+        assert guard.sentinel.last_verdict.healthy
+    finally:
+        observe.disable()
+        observe.reset()
+        faults.clear()

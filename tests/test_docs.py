@@ -19,3 +19,37 @@ def test_tutorial_blocks_execute():
     exec(compile(src, DOC, "exec"), ns)  # noqa: S102 - the doc IS the test
     # the tutorial's own asserts ran; spot-check its final state
     assert ns["rep"]["total_in_bytes"] > 0
+
+
+def test_runtime_metric_names_documented():
+    """Every ``runtime.*`` metric name the code emits must appear in the
+    docs' metrics reference table — the names are the ops contract
+    (dashboards and alerts key on them), and silent drift breaks dashboards
+    without breaking any test. Same spirit as tests/test_imports.py: the
+    contract is enforced, not remembered."""
+    import glob
+
+    import thunder_tpu
+
+    pkg_root = os.path.dirname(thunder_tpu.__file__)
+    sources = glob.glob(os.path.join(pkg_root, "**", "*.py"), recursive=True)
+    assert sources
+    names: set = set()
+    for path in sources:
+        with open(path) as f:
+            src = f.read()
+        names |= set(re.findall(r"[\"'](runtime\.[a-z0-9_]+)[\"']", src))
+    # the sentinel/retry/quarantine/supervisor metric families must all be
+    # present (a refactor that stops emitting them should fail loudly here)
+    for required in ("runtime.nonfinite_steps", "runtime.skipped_steps",
+                     "runtime.rewinds", "runtime.bisections",
+                     "runtime.grad_norm", "runtime.loss_ewma",
+                     "runtime.retries", "runtime.fallbacks",
+                     "runtime.quarantined_kernels"):
+        assert required in names, f"code no longer emits {required}"
+    with open(DOC) as f:
+        doc = f.read()
+    missing = [n for n in sorted(names) if f"`{n}`" not in doc]
+    assert not missing, (
+        "runtime metrics emitted by the code but missing from the docs "
+        f"metrics table (docs/zero_to_thunder_tpu.md): {missing}")

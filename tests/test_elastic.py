@@ -521,6 +521,36 @@ def test_failure_before_first_periodic_save_replays_exactly(tmp_path):
     assert "restart_from_scratch" in events
 
 
+def test_fault_injector_delegates_to_fault_plan():
+    """The legacy FaultInjector is now a facade over runtime.faults.FaultPlan
+    (one injection surface): old constructor signature and semantics intact,
+    schedules/metrics flowing through the shared machinery."""
+    from thunder_tpu.runtime.faults import FaultPlan
+
+    inj = FaultInjector(fail_at={2, 4})
+    assert isinstance(inj.plan, FaultPlan)  # delegation, not a parallel path
+    inj.maybe_fail(1)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        inj.maybe_fail(2)
+    inj.maybe_fail(2)  # transient (legacy: fires once per step)
+    assert inj.fired == {2}
+    with pytest.raises(RuntimeError, match="injected fault"):
+        inj.maybe_fail(4)
+    assert inj.fired == {2, 4}
+
+    class Boom(OSError):
+        pass
+
+    perm = FaultInjector(fail_at={3}, exc=Boom, repeat=True)
+    for _ in range(3):  # repeat=True = permanent: fires on every replay
+        with pytest.raises(Boom):
+            perm.maybe_fail(3)
+    empty = FaultInjector()  # legacy default: never fires
+    for s in range(5):
+        empty.maybe_fail(s)
+    assert empty.fired == set()
+
+
 def test_watchdog_requires_heartbeat(tmp_path):
     with pytest.raises(ValueError, match="heartbeat"):
         ElasticTrainer(lambda s, b: s, CheckpointManager(str(tmp_path / "ck")),

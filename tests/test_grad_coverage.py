@@ -117,6 +117,11 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
     "optim.fused_adamw": "built POST-autodiff by the optimizer fusion pass "
                          "(core/fusion_passes.py) — autodiff never sees it; "
                          "never differentiated",
+    "sentinel.observe_grads": "identity marker tagging grads for the numerics "
+                              "guard — consumes DETACHED grads strictly after "
+                              "the backward; stripped by the guard transform "
+                              "or dropped by the claim pass, never "
+                              "differentiated",
 }
 
 # OpInfo name -> composite ids its samples differentiate through (used when

@@ -51,6 +51,7 @@ from thunder_tpu.observe import registry as _observe
 from thunder_tpu import runtime as runtime  # noqa: F401  (fault-domain runtime)
 from thunder_tpu.runtime import faults as _faults
 from thunder_tpu.runtime import quarantine as _quarantine
+from thunder_tpu.runtime import sentinel as _sentinel
 from thunder_tpu.runtime.faults import KernelExecutionError
 
 __version__ = "0.1.0"
@@ -349,9 +350,20 @@ class ThunderTPUFunction:
         if self.seq_buckets is not None:
             args, kwargs = self._pad_to_bucket(args, kwargs)
         flat, treedef = tree_flatten((args, kwargs))
-        # the quarantine epoch joins the key: entries compiled before a
-        # kernel was quarantined embed that kernel and must never hit again
+        # the quarantine epoch joins the key (entries compiled before a
+        # kernel was quarantined embed that kernel and must never hit
+        # again), as does the context's bisection-suppression set (a probe
+        # entry only serves calls under that same probe configuration), and
+        # — only for plans with trace-time numerics:kernel specs — the
+        # active FaultPlan's identity (that corruption is baked into the
+        # executable, and must never serve after the plan is cleared;
+        # grads/loss poison rides runtime inputs, so ordinary plans and the
+        # production no-plan path add nothing to the key)
+        plan = _faults.active_plan()
+        plan_key = id(plan) if plan is not None and plan.affects_compile() \
+            else None
         key = (treedef, self._extra_cache_key, _quarantine.epoch(),
+               _quarantine.suppression_key(), plan_key,
                tuple(self._leaf_cache_key(l) for l in flat)) \
             if self.cache_option != "no caching" else None
         entry = self._cache.get(key) if key is not None else None
@@ -379,10 +391,19 @@ class ThunderTPUFunction:
         inps = [flat[i] for i in entry.tensor_indices]
         if entry.uses_rng:
             inps.append(_next_rng_key())
+        return self._run_contained(entry.run_fn, inps, args, kwargs)
+
+    def _run_contained(self, run_fn, inps, args, kwargs):
+        """Run a compiled entry with the two containment paths armed: a
+        claimed-kernel crash quarantines and recompiles; a sentinel
+        silent-fault escalation bisects. Shared by ``__call__`` and the
+        ``bind()`` fast path so the dispatch can never drift between them."""
         try:
-            return entry.run_fn(*inps)
+            return run_fn(*inps)
         except KernelExecutionError as err:
             return self._quarantine_and_rerun(err, args, kwargs)
+        except _sentinel.SilentNumericsFault as err:
+            return self._bisect_and_rerun(err, args, kwargs)
 
     def _quarantine_and_rerun(self, err: KernelExecutionError, args, kwargs):
         """Graceful degradation: a claimed kernel died at compile or at
@@ -412,6 +433,88 @@ class ThunderTPUFunction:
                 return entry.run_fn(*inps)
             except KernelExecutionError as e2:
                 err = e2
+            except _sentinel.SilentNumericsFault as snf:
+                # the crash was contained but another kernel is SILENTLY
+                # corrupt: hand over to the bisection path (same symmetry as
+                # __call__'s own dispatch between the two containments)
+                return self._bisect_and_rerun(snf, args, kwargs)
+
+    def _bisect_and_rerun(self, err, args, kwargs):
+        """Silent-fault containment: the numerics sentinel saw repeated
+        non-finite output at this trace point. Bisect the claimed custom
+        kernels — recompile with candidate groups disabled
+        (``runtime.quarantine.suppress``) and re-run on the same inputs —
+        to attribute the corruption; the offender joins the PERSISTED
+        quarantine (same path as crashing kernels) and the step re-runs on
+        the XLA fallback. Unattributable corruption (still non-finite with
+        every custom kernel disabled) re-raises as PersistentNonFinite for
+        the supervisor's rewind/restart ladder."""
+        guard = err.transform
+        if guard is None:  # raised outside a guard wrapper: nothing to bisect
+            raise err
+        if not _sentinel.inputs_alive((args, kwargs)):
+            # donate_argnums consumed the call's buffers in the failing
+            # execution: probes cannot re-run these inputs. Escalate to the
+            # supervisor ladder (rewind/restart from a checkpoint) instead
+            # of crashing every probe on deleted arrays.
+            raise _sentinel.PersistentNonFinite(
+                f"persistent non-finite output of {self.fn_name}: the step's "
+                f"inputs were donated (donate_argnums), so in-process "
+                f"bisection cannot replay them — recover via the supervisor "
+                f"(checkpoint restore + replay), or jit without donation to "
+                f"enable bisection") from err
+        sent = guard.sentinel
+        seen: set[str] = set()
+        # pin the RNG stream: every probe must run the SAME program on the
+        # SAME inputs (probes differing only in the disabled set), and the
+        # containment path must not advance the training stream — the final
+        # re-run draws exactly the key a plain retry of this step would have
+        rng_key0 = _rng_state["key"]
+        while True:
+            entry = err.entry if err.entry is not None else self._stats.last_entry
+            exec_trc = entry.traces[-1] if entry is not None and entry.traces else None
+            candidates = [] if exec_trc is None else \
+                [c for c in _sentinel.claimed_kernel_ids(exec_trc) if c not in seen]
+            if candidates:  # an empty set probes nothing: not a bisection run
+                _observe.inc("runtime.bisections")
+                _observe.event("bisection_started", fn=self.fn_name,
+                               candidates=len(candidates))
+
+            def probe(disabled):
+                _rng_state["key"] = rng_key0
+                with _quarantine.suppress(disabled):
+                    self._cache.clear()
+                    with sent.probing():
+                        self(*args, **kwargs)
+                return sent.last_verdict is not None and sent.last_verdict.healthy
+
+            try:
+                offenders = _sentinel.attribute_offenders(candidates, probe)
+            finally:
+                # a probe that raises (an active FaultPlan firing on a probe
+                # recompile, an XLA error) must still unpin the RNG stream
+                # and drop the probe-configuration entries
+                self._cache.clear()
+                _rng_state["key"] = rng_key0
+            if not offenders:
+                _observe.event("bisection_unattributed", fn=self.fn_name)
+                raise _sentinel.PersistentNonFinite(
+                    f"persistent non-finite output of {self.fn_name} could not "
+                    f"be attributed to a claimed kernel "
+                    f"({len(candidates)} candidates probed)") from err
+            for offender in offenders:
+                seen.add(offender)
+                _quarantine.get_quarantine().add(
+                    offender, phase="numerics",
+                    reason=f"silent numerics fault attributed by bisection ({err})")
+                _observe.inc("runtime.fallbacks")
+                _observe.event("bisection_attributed", fn=self.fn_name,
+                               claim=offender)
+            sent.reset_episode()  # containment done: the re-run starts clean
+            try:
+                return self(*args, **kwargs)
+            except _sentinel.SilentNumericsFault as e2:
+                err = e2  # a second corrupt kernel: bisect the rest
 
     def bind(self, *args, **kwargs):
         """Compile for these inputs and return a ZERO-GUARD callable bound
@@ -422,7 +525,13 @@ class ThunderTPUFunction:
         The caller owns revalidation: invoking it with a different pytree
         structure, shapes, or dtypes than the binding inputs is undefined
         (reference analog: the reference hands back a compiled
-        ``CompiledFunction`` the same way, thunder/__init__.py jit)."""
+        ``CompiledFunction`` the same way, thunder/__init__.py jit).
+
+        Containment still applies: a claimed-kernel crash or a sentinel
+        silent-fault escalation re-enters the driver's quarantine/bisection
+        path with the call's own arguments — but the containment recompiles
+        under a NEW cache entry, so after it fires the caller should
+        re-``bind`` (the stale bound entry would re-contain every call)."""
         check(self.seq_buckets is None,
               "bind() does not compose with seq_buckets: the bound callable "
               "skips the guard path that pads inputs to the bucket — call "
@@ -437,7 +546,7 @@ class ThunderTPUFunction:
             inps = [fl[i] for i in tensor_indices]
             if uses_rng:
                 inps.append(_next_rng_key())
-            return run_fn(*inps)
+            return self._run_contained(run_fn, inps, a, k)
 
         bound.entry = entry
         return bound
@@ -468,6 +577,11 @@ class ThunderTPUFunction:
         trc.output = result
         if getattr(trc, "rng_input_proxy", None) is not None:
             trc.args.append(trc.rng_input_proxy)
+        # the full (proxy-for-every-leaf) input structure, for transforms
+        # that need to map positional args to their proxies (the numerics
+        # guard pairs state args with state outputs through this)
+        trc.input_proxies = list(proxies)
+        trc.input_treedef = treedef
         trc.set_provenance("Tracing (duck-typed interpretation)")
         return trc, tensor_indices
 
@@ -603,6 +717,12 @@ class ThunderTPUFunction:
                 for i in tensor_indices]
             if uses_rng:
                 entry.input_avals.append(_jax.ShapeDtypeStruct((2,), _np.uint32))
+            # transforms may thread extra runtime inputs into the trace
+            # signature (the numerics guard's poison scalars)
+            for tr in self.transforms:
+                extra = getattr(tr, "extra_input_avals", None)
+                if extra is not None:
+                    entry.input_avals.extend(extra())
         # else (symbolic-values caching: number inputs): no avals — last_hlo
         # reports accordingly
         with _observe.span("finalize"):
@@ -610,6 +730,16 @@ class ThunderTPUFunction:
         # runtime step metrics: one disabled-check per call when observe is
         # off, walltime/span/memory-estimate recording when on
         entry.run_fn = _obs_runtime.instrument_entry(entry, self.fn_name)
+        # transform runtime wrappers (outermost): the numerics guard feeds
+        # its poison inputs and peels the health word here. REVERSED so the
+        # first transform's wrapper ends up outermost — wrappers append
+        # their extra inputs outermost-first, which must match the order
+        # the transforms appended their proxies to the trace signature
+        # (and extra_input_avals / the distributed in_specs extension)
+        for tr in reversed(self.transforms):
+            hook = getattr(tr, "wrap_run_fn", None)
+            if hook is not None:
+                entry.run_fn = hook(self, entry, entry.run_fn)
         self._stats.last_traces = traces
         self._stats.last_prologue_traces = [prologue]
         self._stats.last_entry = entry

@@ -441,6 +441,14 @@ class DistributedFunction(ThunderTPUFunction):
         in_specs = [self._plan[i].spec for i in entry.tensor_indices]
         if entry.uses_rng:
             in_specs.append(_P())
+        # transform-threaded extra inputs (the numerics guard's poison
+        # scalars) are replicated — counted via the same extra_input_avals
+        # protocol the driver extends entry.input_avals with, so the two
+        # sites cannot disagree
+        for tr in self.transforms:
+            extra = getattr(tr, "extra_input_avals", None)
+            if extra is not None:
+                in_specs.extend([_P()] * len(extra() or []))
 
         # out_specs by sharding propagation through the execution trace
         # (VERDICT r1 item 4: metadata-driven, replaces local-shape matching)

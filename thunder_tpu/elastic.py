@@ -44,8 +44,10 @@ from thunder_tpu.checkpoint import (load_checkpoint, save_checkpoint,
                                     wait_for_checkpoints)
 from thunder_tpu.observe import registry as _observe
 from thunder_tpu.runtime import retry as _retry
+from thunder_tpu.runtime import sentinel as _sentinel
 from thunder_tpu.runtime.faults import FaultPlan
 from thunder_tpu.runtime.retry import RestartBudget, RetryPolicy
+from thunder_tpu.runtime.sentinel import NumericsPolicy
 
 
 class CheckpointManager:
@@ -328,21 +330,34 @@ class Watchdog:
 
 
 class FaultInjector:
-    """Raise a fault at chosen steps (legacy step-level harness; the layered
-    ``runtime.faults.FaultPlan`` supersedes it for everything below the step
-    loop)."""
+    """Legacy step-level injector, now a thin facade over
+    ``runtime.faults.FaultPlan`` — ONE injection surface for the whole
+    stack. The old constructor signature (``fail_at`` / ``exc`` /
+    ``repeat``) keeps working; under the hood it builds a ``step``-domain
+    :class:`~thunder_tpu.runtime.faults.FaultSpec` (``repeat=True`` maps to
+    ``transient=False``), so schedules, metrics (``runtime.faults_injected``)
+    and events flow through the same machinery as every other domain. New
+    code should pass ``fault_plan=`` to :class:`ElasticTrainer` directly."""
 
     def __init__(self, fail_at: set[int] | None = None, exc=RuntimeError,
                  repeat: bool = False):
+        from thunder_tpu.runtime.faults import FaultSpec
+
         self.fail_at = set(fail_at or ())
         self.exc = exc
         self.repeat = repeat  # True = permanent fault (fires on every replay)
-        self.fired: set[int] = set()
+        self._spec = FaultSpec("step", at_steps=self.fail_at,
+                               transient=not repeat, exc=exc) \
+            if self.fail_at else None
+        self.plan = FaultPlan([self._spec] if self._spec is not None else [])
+
+    @property
+    def fired(self) -> set[int]:
+        """Steps at which this injector has fired (legacy inspection API)."""
+        return set(self._spec._fired_steps) if self._spec is not None else set()
 
     def maybe_fail(self, step: int) -> None:
-        if step in self.fail_at and (self.repeat or step not in self.fired):
-            self.fired.add(step)
-            raise self.exc(f"injected fault at step {step}")
+        self.plan.maybe_fail("step", step=step)
 
 
 class ElasticTrainer:
@@ -370,7 +385,17 @@ class ElasticTrainer:
       heartbeat (escalates through ``on_event("stalled", ...)``),
     - ``compile_cache_dir`` enables the persistent compile cache (and the
       kernel-quarantine set next to it) so the post-restart replay recompiles
-      from disk in seconds.
+      from disk in seconds,
+    - ``numerics_policy`` arms the numerical-integrity response ladder: it
+      is installed process-wide for the duration of ``run()`` so any
+      ``NumericsGuardTransform``-guarded step jitted without an explicit
+      policy follows it. Non-finite steps are skipped *in-graph* by the
+      guard (``runtime.skipped_steps``); a ``LossSpike`` raised by the
+      sentinel is classified retryable and handled as a **rewind** — the
+      trainer restores the last committed checkpoint and replays the data
+      order exactly (``runtime.rewinds``, ``on_event("rewind", ...)``);
+      persistent non-finite output triggers the sentinel's kernel bisection
+      inside the jit driver before anything reaches this loop.
     """
 
     RETRYABLE = (RuntimeError, OSError)  # legacy alias; classification has
@@ -384,6 +409,8 @@ class ElasticTrainer:
                  watchdog_timeout_s: float | None = None,
                  fault_injector: FaultInjector | None = None,
                  fault_plan: FaultPlan | None = None,
+                 numerics_policy: NumericsPolicy | None = None,
+                 numerics_sentinels=(),
                  compile_cache_dir: str | None = None,
                  handle_preemption: bool = True,
                  preempt_signals=(signal.SIGTERM,),
@@ -403,6 +430,13 @@ class ElasticTrainer:
         self.watchdog_timeout_s = watchdog_timeout_s
         self.fault_injector = fault_injector
         self.fault_plan = fault_plan
+        self.numerics_policy = numerics_policy
+        # sentinels whose guarded steps this trainer replays (e.g.
+        # [guard.sentinel]); when given, restart refold-suppression is
+        # delivered to exactly these instead of the process-wide broadcast
+        # (several independent trainers/guards in one process: a broadcast
+        # would freeze the EWMAs of guards this trainer never replays)
+        self.numerics_sentinels = tuple(numerics_sentinels)
         self.compile_cache_dir = compile_cache_dir
         self.handle_preemption = handle_preemption
         self.preempt_signals = tuple(preempt_signals)
@@ -450,9 +484,16 @@ class ElasticTrainer:
                 self.heartbeat.path, self.watchdog_timeout_s,
                 escalate=lambda age: self.on_event("stalled", {"age_s": age}),
             ).start()
+        prev_policy = None
+        if self.numerics_policy is not None:
+            # process-installed for the supervision scope: guards jitted
+            # without an explicit policy follow the trainer's ladder
+            prev_policy = _sentinel.install_policy(self.numerics_policy)
         try:
             return self._run_supervised(state, data_fn, n_steps)
         finally:
+            if self.numerics_policy is not None:
+                _sentinel.install_policy(prev_policy)
             if watchdog is not None:
                 watchdog.stop()
             for sig, old in installed.items():
@@ -498,6 +539,7 @@ class ElasticTrainer:
                 if _retry.classify(e) == _retry.FATAL:
                     raise
                 t_fail = time.perf_counter()
+                failed_step = step
                 self.restarts += 1
                 consecutive_failures += 1
                 self.on_event("failure", {"step": step, "error": repr(e),
@@ -525,6 +567,32 @@ class ElasticTrainer:
                 else:
                     step, state = restored
                     self.on_event("restart", {"step": step})
+                if isinstance(e, _sentinel.LossSpike):
+                    # numerics ladder rung 2: the sentinel judged a finite
+                    # loss anomalous and the restore above just happened —
+                    # only NOW is this a rewind (not before the budget gate:
+                    # an exhausted budget re-raises without ever restoring).
+                    # The deterministic data_fn makes the replay order exact;
+                    # tell the sentinel how many already-folded steps are
+                    # about to replay so it re-judges without re-folding.
+                    _observe.inc("runtime.rewinds")
+                    _observe.event("sentinel_rewind", step=failed_step,
+                                   loss=e.loss, z=e.z)
+                    self.on_event("rewind", {"step": failed_step,
+                                             "loss": e.loss, "z": e.z})
+                    if getattr(e, "sentinel", None) is not None:
+                        e.sentinel.notify_rewind(failed_step - step)
+                elif self.numerics_policy is not None:
+                    # an armed trainer's ORDINARY restart also replays
+                    # already-folded steps — suppress those refolds too, or
+                    # every crash recovery deflates the EWMA variance (no
+                    # exception-carried sentinel here: deliver to the
+                    # explicitly-owned sentinels, else broadcast)
+                    if self.numerics_sentinels:
+                        for s in self.numerics_sentinels:
+                            s.notify_rewind(failed_step - step)
+                    else:
+                        _sentinel.notify_rewind_all(failed_step - step)
                 # time-to-recover: failure -> state restored, replay ready
                 _observe.observe_value("runtime.recovery_ms",
                                        (time.perf_counter() - t_fail) * 1e3)

@@ -44,6 +44,14 @@ def active() -> bool:
     return _sink.get() is not None or _registry.is_enabled()
 
 
+def current_log() -> list | None:
+    """The live per-compile decision list (the object that becomes
+    ``CompileStats.last_decisions`` when the compile finishes) — lets code
+    running DURING a compile hold a stable reference to exactly that
+    compile's log."""
+    return _sink.get()
+
+
 def record(kind: str, op: str, executor: str | None, decision: str,
            reason: str = "", cost: dict | None = None) -> None:
     sink = _sink.get()

@@ -103,6 +103,16 @@ def explain(jfn) -> str:
         mult = f"  x{n}" if n > 1 else ""
         lines.append(f"  {op} -> {decision}{who}{why}{mult}")
 
+    # -- numerics sentinel ---------------------------------------------------
+    for tr in getattr(jfn, "transforms", ()):
+        sent = getattr(tr, "sentinel", None)
+        if sent is None or not hasattr(sent, "summary"):
+            continue
+        lines.append("")
+        lines.append("== numerics sentinel ==")
+        for ln in sent.summary().splitlines():
+            lines.append(f"  {ln}")
+
     # -- step cost estimates ------------------------------------------------
     lines.append("")
     lines.append("== step estimates ==")

@@ -14,7 +14,82 @@ from typing import Any, NamedTuple
 
 from thunder_tpu import ops
 from thunder_tpu.core import dtypes
-from thunder_tpu.core.pytree import tree_map
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.pytree import tree_flatten, tree_map, tree_unflatten
+
+
+def sharded_axis_of(p) -> str | None:
+    """The mesh axis over which proxy ``p`` holds a DISTINCT shard (so its
+    per-rank sum-of-squares must be psum'd over exactly that axis for a
+    global norm), or ``None`` for replicated/unannotated leaves (identical
+    on every rank: summing locally is already global). Shared by
+    :func:`clip_grad_norm` and the numerics guard's grad-norm health
+    reduction so the two cannot diverge."""
+    from thunder_tpu.core.proxies import DistParallelType
+
+    if getattr(p, "distparallel_type", None) in (
+            DistParallelType.FULLY_SHARDED, DistParallelType.EXPERT_SHARDED,
+            DistParallelType.COLUMN_WISE, DistParallelType.ROW_WISE):
+        return getattr(p, "dist_axis", None)
+    return None
+
+
+def clip_grad_norm(grads, max_norm, *, params=None, eps: float = 1e-6):
+    """Global-norm gradient clipping over a grad pytree, in-graph.
+
+    Returns ``(clipped_grads, global_norm)``. The norm is the L2 norm over
+    every float leaf (accumulated in f32); when it exceeds ``max_norm``
+    every grad is scaled by ``max_norm / (norm + eps)`` — torch
+    ``clip_grad_norm_`` semantics, but traced, so ``jit(train_step)``
+    compiles it into the step (the same fused reduction shape the numerics
+    sentinel uses for its grad-norm health word).
+
+    **Distributed-aware:** pass ``params=`` (the step's parameter pytree,
+    leaf-parallel with ``grads``) and leaves whose parameters are sharded
+    (FSDP/ZeRO ``FULLY_SHARDED``, tensor-parallel ``COLUMN_WISE`` /
+    ``ROW_WISE``, ``EXPERT_SHARDED``) contribute a *local* sum of squares
+    that is all-reduced over their mesh axis before the sqrt — each rank
+    clips by the TRUE global norm, not its shard's. Replicated leaves
+    (DDP grads after their all-reduce) are summed locally only.
+    """
+    gleaves, tdef = tree_flatten(grads)
+    refs = gleaves
+    if params is not None:
+        pleaves, _ = tree_flatten(params)
+        check(len(pleaves) == len(gleaves), lambda: (
+            f"clip_grad_norm: params ({len(pleaves)} leaves) is not "
+            f"leaf-parallel with grads ({len(gleaves)} leaves)"))
+        refs = pleaves
+    f32 = dtypes.float32
+    local = ops.full((), 0.0, dtype=f32)
+    shared: dict[str, Any] = {}  # mesh axis -> sharded sum-of-squares
+    for g, r in zip(gleaves, refs):
+        if g is None or not hasattr(g, "dtype"):
+            continue
+        gf = ops.convert_element_type(g, f32)
+        ss = ops.sum(ops.mul(gf, gf))
+        axis = sharded_axis_of(r)
+        if axis is None:
+            local = ops.add(local, ss)
+        else:
+            shared[axis] = ss if axis not in shared else ops.add(shared[axis], ss)
+    total = local
+    if shared:
+        from thunder_tpu.distributed import prims as dist_prims
+
+        for axis in sorted(shared):
+            total = ops.add(total, dist_prims.wait(
+                dist_prims.all_reduce(shared[axis], axis, "sum")))
+    norm = ops.sqrt(total)
+    scale = ops.clamp(ops.true_divide(float(max_norm), ops.add(norm, eps)), max=1.0)
+
+    def clip(g):
+        if g is None or not hasattr(g, "dtype"):
+            return g
+        return ops.convert_element_type(
+            ops.mul(ops.convert_element_type(g, f32), scale), g.dtype)
+
+    return tree_unflatten(tdef, [clip(g) for g in gleaves]), norm
 
 
 class AdamW:

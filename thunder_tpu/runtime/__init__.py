@@ -21,13 +21,23 @@ SURVEY §5 "Failure detection / elastic recovery: Absent" in the reference):
   known-bad kernel. Every fallback lands in ``CompileStats.last_decisions``
   (visible in ``observe.explain()``) and the ``runtime.fallbacks`` counter.
 
+- ``sentinel``: the numerical-integrity side of the fault taxonomy — silent
+  data faults (NaN/Inf grads, loss spikes, numerically corrupt claimed
+  kernels) detected by in-graph health reductions
+  (``thunder_tpu.transforms.NumericsGuardTransform``), skipped in-graph
+  with bit-identical state, and escalated through a response ladder:
+  skip-and-count → EWMA loss-spike rewind → automated bisection that
+  attributes the corruption to one claimed kernel and feeds it into the
+  persisted quarantine.
+
 The supervisor side (SIGTERM-aware checkpoint-and-exit, restart backoff,
-heartbeat watchdog) lives in ``thunder_tpu.elastic`` on top of these.
+heartbeat watchdog, ``numerics_policy=`` rewind wiring) lives in
+``thunder_tpu.elastic`` on top of these.
 """
 
 from __future__ import annotations
 
-from thunder_tpu.runtime import faults, quarantine, retry  # noqa: F401
+from thunder_tpu.runtime import faults, quarantine, retry, sentinel  # noqa: F401
 from thunder_tpu.runtime.faults import (  # noqa: F401
     FaultPlan,
     FaultSpec,
@@ -35,3 +45,11 @@ from thunder_tpu.runtime.faults import (  # noqa: F401
     KernelExecutionError,
 )
 from thunder_tpu.runtime.retry import RestartBudget, RetryPolicy  # noqa: F401
+from thunder_tpu.runtime.sentinel import (  # noqa: F401
+    LossSpike,
+    NumericsAnomaly,
+    NumericsPolicy,
+    NumericsSentinel,
+    PersistentNonFinite,
+    SilentNumericsFault,
+)

@@ -20,6 +20,16 @@ domain              hook point
 ``collective``      the eager lowerings in ``distributed/prims.py``
 ``checkpoint_io``   ``checkpoint.save_checkpoint``
 ``step``            ``ElasticTrainer``'s step loop
+``numerics:*``      silent-data faults — these *corrupt values* instead of
+                    raising. ``numerics:grads`` / ``numerics:loss`` poison
+                    the gradients / loss of a ``NumericsGuardTransform``-ed
+                    step (the guard feeds a NaN poison scalar into the
+                    compiled program, so the corruption flows through the
+                    real graph); ``numerics:kernel:<claim>`` NaN-poisons the
+                    output of that claimed kernel inside ``kernel_guard``
+                    (at trace time under the whole-program jit, so use
+                    ``transient=False`` — the corruption is baked into every
+                    compile while the spec stays live)
 ==================  =========================================================
 
 Schedules are deterministic so chaos tests are reproducible: explicit step
@@ -164,6 +174,40 @@ class FaultPlan:
                 f"fault in domain {domain!r}{where}{at}",
                 domain=domain, step=step, transient=spec.transient)
 
+    def affects_compile(self) -> bool:
+        """True when any spec could fire inside a traced kernel impl
+        (``numerics:kernel:*``): such corruption is baked into the compiled
+        executable, so the dispatch cache key must include the plan's
+        identity — an entry compiled under the plan must never serve after
+        it is cleared. (Crash-domain kernel faults raise at compile time
+        and never produce a cached entry, so they don't need this.)"""
+        target = "numerics:kernel"
+        for spec in self.specs:
+            if spec.domain.endswith("*"):
+                prefix = spec.domain[:-1]
+                if prefix.startswith(target) or target.startswith(prefix):
+                    return True
+            elif spec.domain.startswith(target):
+                return True
+        return False
+
+    def should_corrupt(self, domain: str, *, step: int | None = None,
+                       site: str | None = None) -> bool:
+        """Silent-data variant of :meth:`maybe_fail` for the ``numerics:*``
+        domains: advances the matching specs' schedules and reports whether
+        a corruption fires (the caller poisons values instead of raising)."""
+        for spec in self.specs:
+            if not spec.matches(domain):
+                continue
+            with self._lock:
+                fire = spec.should_fire(step)
+            if fire:
+                _observe.inc("runtime.faults_injected")
+                _observe.event("numeric_fault_injected", domain=domain, step=step,
+                               site=site, transient=spec.transient)
+                return True
+        return False
+
 
 # ---------------------------------------------------------------------------
 # the process-wide active plan (None = zero-cost hooks)
@@ -207,6 +251,35 @@ def maybe_fail(domain: str, *, step: int | None = None,
     _active_plan.maybe_fail(domain, step=step, site=site)
 
 
+def should_corrupt(domain: str, *, step: int | None = None,
+                   site: str | None = None) -> bool:
+    """Hook for the silent-data (``numerics:*``) domains: True when a value
+    corruption should be injected now. One ``is None`` check when no plan is
+    installed."""
+    if _active_plan is None:
+        return False
+    return _active_plan.should_corrupt(domain, step=step, site=site)
+
+
+def poison_tree(tree):
+    """NaN-poison every inexact array leaf of ``tree`` (jax values or
+    tracers — works at trace time inside a jit as well as eagerly). Integer
+    and non-array leaves pass through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    def _p(x):
+        try:
+            dt = jnp.result_type(x)
+        except Exception:
+            return x
+        if jnp.issubdtype(dt, jnp.inexact):
+            return x + jnp.asarray(float("nan"), dt)
+        return x
+
+    return jax.tree_util.tree_map(_p, tree)
+
+
 # ---------------------------------------------------------------------------
 # kernel guard: fault hook + failure attribution for claimed kernels
 # ---------------------------------------------------------------------------
@@ -231,11 +304,22 @@ def kernel_guard(claim_id: str, fn: Callable) -> Callable:
     """
     domain = f"kernel:{claim_id}"
 
+    numerics_domain = f"numerics:{domain}"
+
     @functools.wraps(fn)
     def guarded(*args, **kwargs):
         try:
             maybe_fail(domain, site=claim_id)
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            # silent-data fault: the kernel "succeeds" but returns garbage —
+            # the failure mode the numerics sentinel exists to catch. Under
+            # the whole-program jit this runs at trace time, baking the
+            # corruption into the compiled program (use transient=False so
+            # every recompile, including bisection probes, stays corrupt).
+            if _active_plan is not None and should_corrupt(numerics_domain,
+                                                           site=claim_id):
+                out = poison_tree(out)
+            return out
         except KernelExecutionError:
             raise  # a nested claim already attributed itself
         except Exception as e:

@@ -26,6 +26,8 @@ import json
 import os
 import threading
 import time
+from contextlib import contextmanager
+from contextvars import ContextVar
 
 from thunder_tpu.observe import registry as _observe
 
@@ -175,7 +177,51 @@ def is_quarantined(claim_id: str) -> bool:
     return claim_id in _active
 
 
+# temporary (non-persisted) claim disables: the numerics bisection recompiles
+# with candidate kernel groups disabled to attribute a silent fault — these
+# suppressions gate the claim pass exactly like a quarantine entry but never
+# touch the persisted set. A ContextVar (not a module global): suppression
+# is visible only to the bisection's own call chain — a concurrent compile
+# on another thread never sees an unrelated probe's disables, and two
+# concurrent bisections cannot clobber each other's suppression sets. The
+# stored dict is treated as immutable (each suppress() installs a fresh
+# copy). Cache correctness comes from :func:`suppression_key` joining the
+# dispatch cache key — NOT from bumping the global epoch, which would
+# permanently invalidate every other jitted function's cached entries on
+# each probe enter/exit.
+# the ContextVar holds (reasons_dict, precomputed_frozenset) so the hot
+# dispatch path reads the cache-key component without allocating
+_EMPTY_SUPPRESSION: tuple = ({}, frozenset())
+_suppressed: ContextVar[tuple] = ContextVar("quarantine_suppressed",
+                                            default=_EMPTY_SUPPRESSION)
+
+
+def suppression_key() -> frozenset:
+    """The context's active suppression set — part of the dispatch cache key
+    (an entry compiled under one probe configuration only serves calls made
+    under that same configuration). Precomputed at suppress() time: this is
+    on the per-call dispatch path."""
+    return _suppressed.get()[1]
+
+
+@contextmanager
+def suppress(claim_ids, reason: str = "bisection probe"):
+    """Temporarily treat ``claim_ids`` as quarantined (scoped to this context,
+    never persisted). Nests: inner suppressions stack on top of outer ones."""
+    merged = dict(_suppressed.get()[0])
+    for c in claim_ids:
+        merged[c] = reason
+    tok = _suppressed.set((merged, frozenset(merged)))
+    try:
+        yield
+    finally:
+        _suppressed.reset(tok)
+
+
 def quarantine_reason(claim_id: str) -> str | None:
+    r = _suppressed.get()[0].get(claim_id)
+    if r is not None:
+        return r
     return _active.reason(claim_id)
 
 

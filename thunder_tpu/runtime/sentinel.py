@@ -1,0 +1,518 @@
+"""Numerical integrity sentinel: the host side of in-graph NaN/spike defense.
+
+PR 7 made thunder_tpu survive failures that *raise*; the worse production
+failure mode is silent — NaN/Inf gradients, loss spikes, a numerically
+corrupt claimed kernel returning garbage without an exception — poisoning
+the model until someone eyeballs the loss curve. The defense has two halves:
+
+- **In-graph** (``thunder_tpu.transforms.NumericsGuardTransform``): every
+  compiled training step gets cheap fused health reductions — global grad
+  norm plus non-finite counts over grads/loss/new-state, packed into one
+  small f32 *health word* — and emits ``where(healthy, new_state, old_state)``
+  so a non-finite step is *skipped* with bit-identical state and no host
+  round-trip. Detection costs one health-word fetch per step.
+- **Host-side** (this module): :class:`NumericsSentinel` consumes the health
+  word per step and drives the response ladder of :class:`NumericsPolicy`:
+
+  1. *skip-and-count* — a transient non-finite step was already skipped
+     in-graph; the sentinel counts it (``runtime.nonfinite_steps`` /
+     ``runtime.skipped_steps``) and moves on,
+  2. *rewind* — a finite loss that spikes against its EWMA (z-score over
+     ``spike_zscore``) raises :class:`LossSpike`; ``ElasticTrainer``
+     (``numerics_policy=``) classifies it retryable, restores the last
+     committed checkpoint and replays in data order (``runtime.rewinds``),
+  3. *bisect* — ``bisect_after`` consecutive non-finite steps at the same
+     trace point raise :class:`SilentNumericsFault`; the jit driver runs
+     :func:`bisect_offender` — recompiling with claimed kernel groups
+     disabled (``runtime.quarantine.suppress``) — and feeds the attributed
+     claim id into the persisted kernel quarantine, so silent faults reach
+     the same quarantine + decision-log path as crashes
+     (``runtime.bisections`` / ``runtime.bisection_probes``).
+
+Every anomaly can dump a *replay bundle* (trace hash, step inputs, RNG
+state, decision log) for offline repro: set ``NumericsPolicy.replay_dir``.
+
+Chaos-test the whole ladder with the ``numerics:*`` fault domains of
+``runtime.faults.FaultPlan`` (``numerics:grads``, ``numerics:loss``,
+``numerics:kernel:<claim>``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import time
+import weakref
+from contextlib import contextmanager
+
+from thunder_tpu.observe import registry as _observe
+
+
+class NumericsAnomaly(RuntimeError):
+    """Base for sentinel-detected anomalies (classified retryable)."""
+
+
+class LossSpike(NumericsAnomaly):
+    """Finite loss spiked against its EWMA: rewind to the last committed
+    checkpoint and replay in data order."""
+
+    def __init__(self, *, step: int, loss: float, ewma: float, z: float):
+        super().__init__(f"loss spike at sentinel step {step}: loss={loss:.6g} "
+                         f"vs ewma={ewma:.6g} (z={z:.2f})")
+        self.step = step
+        self.loss = loss
+        self.ewma = ewma
+        self.z = z
+        self.sentinel = None  # set by the raising NumericsSentinel so the
+        # supervisor can notify_rewind() with the replay length
+
+
+class PersistentNonFinite(NumericsAnomaly):
+    """Non-finite steps persisted and bisection could not attribute them to
+    a claimed kernel (or was disabled): the corruption is upstream of the
+    custom kernels (model divergence, data poisoning, chip fault)."""
+
+
+class SilentNumericsFault(NumericsAnomaly):
+    """Internal control flow: repeated non-finite at one trace point — the
+    jit driver catches this and runs the bisection (it holds the original
+    call arguments needed to recompile and re-run probes)."""
+
+    def __init__(self, verdict: "Verdict", message: str = ""):
+        super().__init__(message or f"persistent non-finite step: {verdict}")
+        self.verdict = verdict
+        self.transform = None  # set by the guard wrapper (bisection needs it)
+        self.entry = None
+
+
+class NumericsPolicy:
+    """Configuration for the response ladder.
+
+    - ``spike_zscore`` / ``ewma_alpha`` / ``warmup_steps``: a finite loss
+      whose z-score against the running EWMA (updated with ``ewma_alpha``)
+      exceeds ``spike_zscore`` — after ``warmup_steps`` healthy steps — is a
+      spike.
+    - ``max_rewinds``: total :class:`LossSpike` raises; past the budget a
+      spike is *accepted* (folded into the EWMA) so a deterministic replay
+      that re-hits the same spike cannot rewind forever.
+    - ``bisect_after`` consecutive non-finite steps trigger bisection;
+      ``bisect=False`` raises :class:`PersistentNonFinite` instead.
+    - ``replay_dir``: where anomaly replay bundles are dumped (``None`` =
+      no dumps); ``dump_inputs=False`` keeps the step inputs out of the
+      bundle (they can be model-sized).
+    """
+
+    def __init__(self, *, spike_zscore: float = 6.0, ewma_alpha: float = 0.05,
+                 warmup_steps: int = 10, max_rewinds: int = 2,
+                 bisect_after: int = 3, bisect: bool = True,
+                 replay_dir: str | None = None, dump_inputs: bool = True):
+        self.spike_zscore = spike_zscore
+        self.ewma_alpha = ewma_alpha
+        self.warmup_steps = warmup_steps
+        self.max_rewinds = max_rewinds
+        self.bisect_after = bisect_after
+        self.bisect = bisect
+        self.replay_dir = replay_dir
+        self.dump_inputs = dump_inputs
+
+
+# process-installed policy: ElasticTrainer(numerics_policy=...) installs it
+# here so guards jitted without an explicit policy pick up the trainer's
+_installed_policy: NumericsPolicy | None = None
+
+
+def install_policy(policy: NumericsPolicy | None) -> NumericsPolicy | None:
+    """Install ``policy`` process-wide; returns the previous one (restore it
+    when a supervision scope ends)."""
+    global _installed_policy
+    prev = _installed_policy
+    _installed_policy = policy
+    return prev
+
+
+def installed_policy() -> NumericsPolicy | None:
+    return _installed_policy
+
+
+# health-word layout (f32 vector emitted by NumericsGuardTransform)
+IDX_NONFINITE_GRADS = 0
+IDX_NONFINITE_LOSS = 1
+IDX_NONFINITE_STATE = 2
+IDX_GRAD_NORM = 3
+IDX_LOSS = 4
+HEALTH_SIZE = 5
+
+
+class Verdict:
+    """One step's parsed health word."""
+
+    __slots__ = ("step", "nonfinite_grads", "nonfinite_loss", "nonfinite_state",
+                 "grad_norm", "loss", "healthy", "skipped", "probe")
+
+    def __init__(self, word, *, step: int = 0, probe: bool = False):
+        import numpy as np
+
+        w = np.asarray(word, dtype=np.float64).reshape(-1)
+        self.step = step
+        self.nonfinite_grads = float(w[IDX_NONFINITE_GRADS])
+        self.nonfinite_loss = float(w[IDX_NONFINITE_LOSS])
+        self.nonfinite_state = float(w[IDX_NONFINITE_STATE])
+        self.grad_norm = float(w[IDX_GRAD_NORM])
+        self.loss = float(w[IDX_LOSS])
+        total = self.nonfinite_grads + self.nonfinite_loss + self.nonfinite_state
+        # a NaN count (the reductions themselves corrupted) is unhealthy too
+        self.healthy = math.isfinite(total) and total == 0.0
+        self.skipped = not self.healthy
+        self.probe = probe
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"<Verdict step={self.step} healthy={self.healthy} "
+                f"nonfinite=(g={self.nonfinite_grads:.0f} l={self.nonfinite_loss:.0f} "
+                f"s={self.nonfinite_state:.0f}) grad_norm={self.grad_norm:.4g} "
+                f"loss={self.loss:.6g}>")
+
+
+# every live sentinel, weakly held: a supervisor restoring a checkpoint for
+# a NON-spike failure (crash, preemption replay) must also suppress EWMA
+# refolds on whatever guards its step function carries — it has no exception
+# object pointing at them, so it broadcasts via notify_rewind_all
+_live_sentinels: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def notify_rewind_all(replay_steps: int) -> None:
+    """Broadcast :meth:`NumericsSentinel.notify_rewind` to every live
+    sentinel. Called by ``ElasticTrainer`` (when ``numerics_policy`` is
+    armed) on any restore-and-replay; with several independent trainers in
+    one process, prefer per-exception delivery where available."""
+    for s in list(_live_sentinels):
+        s.notify_rewind(replay_steps)
+
+
+class NumericsSentinel:
+    """Per-guard host state machine: consumes health words, keeps the loss
+    EWMA and skip counters, raises the ladder's anomalies."""
+
+    def __init__(self, policy: NumericsPolicy | None = None):
+        self._policy = policy
+        self.steps = 0              # health words ingested (non-probe)
+        self.healthy_steps = 0
+        self.nonfinite_steps = 0
+        self.skipped_steps = 0
+        self.consecutive_nonfinite = 0
+        self.rewind_raises = 0      # LossSpike raises (the trainer rewinds)
+        self.spikes_accepted = 0    # spikes past the rewind budget
+        self.ewma_mean: float | None = None
+        self.ewma_var = 0.0
+        self.last_verdict: Verdict | None = None
+        self._probing = 0
+        self._fold_suppress = 0  # healthy losses to re-judge but NOT re-fold
+        # (set via notify_rewind: the rewind's replayed steps were already
+        # folded once; folding them again would deflate the EWMA variance)
+        _live_sentinels.add(self)
+        self._replay_source = None  # (fn_name, entry, inps) set per call by
+        # the guard wrapper so bundles can include the exact step inputs
+
+    @property
+    def policy(self) -> NumericsPolicy:
+        if self._policy is not None:
+            return self._policy
+        return _installed_policy or _DEFAULT_POLICY
+
+    # -- probe mode (bisection) ---------------------------------------------
+    @contextmanager
+    def probing(self):
+        """Bisection probes parse health words (``last_verdict``) without
+        counting, EWMA updates, or anomaly raises."""
+        self._probing += 1
+        try:
+            yield
+        finally:
+            self._probing -= 1
+
+    def reset_episode(self) -> None:
+        """Called after a successful containment (e.g. the bisected kernel
+        was quarantined) so the re-run doesn't immediately re-escalate."""
+        self.consecutive_nonfinite = 0
+
+    def notify_rewind(self, replay_steps: int) -> None:
+        """The supervisor restored a checkpoint and is about to replay
+        ``replay_steps`` steps this sentinel has already seen. Replayed
+        healthy losses are re-*judged* against the frozen pre-spike
+        statistics but not re-*folded* — re-folding near-identical values
+        shrinks the variance each rewind, making ordinary post-rewind
+        wiggles look like spikes. Every replayed ingest (healthy or
+        in-graph-skipped) consumes one slot of the window, mirroring
+        whether it folded in its first life."""
+        self._fold_suppress += max(int(replay_steps), 0)
+
+    # -- ingestion ----------------------------------------------------------
+    def ingest(self, health_word, *, has_state_select: bool = True) -> Verdict:
+        if self._probing:
+            v = Verdict(health_word, step=self.steps, probe=True)
+            self.last_verdict = v
+            return v
+        pol = self.policy
+        self.steps += 1
+        v = Verdict(health_word, step=self.steps)
+        self.last_verdict = v
+        if not v.healthy:
+            if self._fold_suppress > 0:
+                # a replayed SKIPPED step: it never folded in its first life
+                # either, but it still occupies one slot of the replay window
+                self._fold_suppress -= 1
+            self.nonfinite_steps += 1
+            self.consecutive_nonfinite += 1
+            _observe.inc("runtime.nonfinite_steps")
+            if has_state_select:
+                self.skipped_steps += 1
+                _observe.inc("runtime.skipped_steps")
+            _observe.event("sentinel_skip", step=v.step,
+                           nonfinite_grads=v.nonfinite_grads,
+                           nonfinite_loss=v.nonfinite_loss,
+                           nonfinite_state=v.nonfinite_state,
+                           consecutive=self.consecutive_nonfinite)
+            if self.consecutive_nonfinite == 1:
+                self.maybe_dump("skip", v)
+            if self.consecutive_nonfinite >= pol.bisect_after:
+                self.maybe_dump("persistent_nonfinite", v)
+                if pol.bisect:
+                    raise SilentNumericsFault(v)
+                raise PersistentNonFinite(
+                    f"{self.consecutive_nonfinite} consecutive non-finite "
+                    f"steps at the same trace point (bisection disabled)")
+            return v
+        # healthy step
+        self.consecutive_nonfinite = 0
+        self.healthy_steps += 1
+        if math.isfinite(v.grad_norm):
+            # an f32 sumsq can overflow to inf on finite-but-huge grads; a
+            # non-finite sample would permanently corrupt the histogram sum
+            _observe.observe_value("runtime.grad_norm", v.grad_norm)
+        if math.isfinite(v.loss):
+            self._check_spike_and_fold(v, pol)
+        return v
+
+    def _check_spike_and_fold(self, v: Verdict, pol: NumericsPolicy) -> None:
+        if self.ewma_mean is None:
+            self.ewma_mean = v.loss
+            self.ewma_var = 0.0
+            _observe.set_gauge("runtime.loss_ewma", self.ewma_mean)
+            return
+        std = math.sqrt(max(self.ewma_var, 0.0))
+        # floor: relative to the mean so a flat early loss curve doesn't make
+        # every wiggle an infinite-z spike
+        floor = 1e-3 * abs(self.ewma_mean) + 1e-8
+        z = (v.loss - self.ewma_mean) / max(std, floor)
+        if self.healthy_steps > pol.warmup_steps and z > pol.spike_zscore:
+            if self.rewind_raises < pol.max_rewinds:
+                self.rewind_raises += 1
+                _observe.event("sentinel_spike", step=v.step, loss=v.loss,
+                               ewma=self.ewma_mean, z=z)
+                self.maybe_dump("spike", v)
+                # NOT folded into the EWMA: the replay re-judges this loss
+                # against the pre-spike statistics. The exception carries the
+                # sentinel so the supervisor can notify_rewind() with the
+                # replay length once the restore actually happens.
+                err = LossSpike(step=v.step, loss=v.loss, ewma=self.ewma_mean, z=z)
+                err.sentinel = self
+                raise err
+            self.spikes_accepted += 1
+            _observe.event("sentinel_spike_accepted", step=v.step, loss=v.loss,
+                           z=z, rewinds_spent=self.rewind_raises)
+        if self._fold_suppress > 0:
+            # a replayed step after a rewind: judged above, already folded
+            # in its first life — skip the refold
+            self._fold_suppress -= 1
+            return
+        d = v.loss - self.ewma_mean
+        a = pol.ewma_alpha
+        self.ewma_mean += a * d
+        self.ewma_var = (1.0 - a) * (self.ewma_var + a * d * d)
+        _observe.set_gauge("runtime.loss_ewma", self.ewma_mean)
+
+    # -- replay bundles ------------------------------------------------------
+    def maybe_dump(self, kind: str, verdict: Verdict) -> str | None:
+        pol = self.policy
+        if pol.replay_dir is None:
+            return None
+        try:
+            fn_name, entry, inps, decisions = \
+                self._replay_source or ("fn", None, None, None)
+            return dump_replay_bundle(
+                pol.replay_dir, kind=kind, verdict=verdict, fn_name=fn_name,
+                entry=entry, inputs=inps if pol.dump_inputs else None,
+                decisions=decisions)
+        except Exception:
+            return None  # diagnostics must never take the step down
+
+    # -- reporting -----------------------------------------------------------
+    def summary(self) -> str:
+        lines = [f"steps={self.steps} healthy={self.healthy_steps} "
+                 f"nonfinite={self.nonfinite_steps} skipped={self.skipped_steps}",
+                 f"rewind_raises={self.rewind_raises} "
+                 f"spikes_accepted={self.spikes_accepted}"]
+        if self.ewma_mean is not None:
+            lines.append(f"loss ewma={self.ewma_mean:.6g} "
+                         f"std={math.sqrt(max(self.ewma_var, 0.0)):.4g}")
+        if self.last_verdict is not None:
+            lines.append(f"last: {self.last_verdict!r}")
+        return "\n".join(lines)
+
+
+_DEFAULT_POLICY = NumericsPolicy()
+
+
+# ---------------------------------------------------------------------------
+# bisection: attribute persistent non-finite output to one claimed kernel
+# ---------------------------------------------------------------------------
+
+def claimed_kernel_ids(exec_trc) -> list[str]:
+    """Claim ids of the custom (operator-executor) kernels in an execution
+    trace — the bisection candidate set. Fusion regions (XLA) are the
+    fallback, not candidates — but claimed kernels *absorbed into* an XLA
+    region (``xla_absorb_claimed``) live in its subsymbols, so the walk
+    recurses."""
+    from thunder_tpu.executors import FusionExecutor
+
+    ids: set[str] = set()
+
+    def walk(bsyms):
+        for b in bsyms:
+            ex = b.sym.executor
+            if ex is None:
+                continue
+            if isinstance(ex, FusionExecutor):
+                walk(b.subsymbols)
+            else:
+                ids.add(str(b.sym.id))
+
+    walk(exec_trc.bound_symbols)
+    return sorted(ids)
+
+
+def inputs_alive(tree) -> bool:
+    """False when any jax array leaf of ``tree`` has been donated/deleted —
+    such inputs cannot be re-run by bisection probes (the failing call's
+    ``donate_argnums`` consumed their buffers)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                if leaf.is_deleted():
+                    return False
+            except Exception:
+                continue
+    return True
+
+
+def _memoized_probe(probe):
+    last = {"set": None, "healthy": None}
+
+    def _probe(disabled):
+        key = frozenset(disabled)
+        if key == last["set"]:
+            return last["healthy"]  # a probe is a full recompile+run — never
+            # repeat the identical configuration (e.g. the final confirm
+            # after the search already ended on that exact set)
+        _observe.inc("runtime.bisection_probes")
+        last["set"], last["healthy"] = key, bool(probe(key))
+        return last["healthy"]
+
+    return _probe
+
+
+def attribute_offenders(candidates, probe) -> list[str]:
+    """Attribute persistent non-finite output to claimed kernels.
+
+    ``probe(disabled: frozenset[str]) -> bool`` must recompile the step with
+    those claim ids disabled, re-run it on the failing inputs, and report
+    whether the health word came back healthy. Fast path: binary search for
+    the single offender (log2 probes — the overwhelmingly common case).
+    When the search fails but disabling EVERY candidate was healthy, the
+    fault is provably kernel-borne with multiple simultaneous offenders —
+    fall back to a linear leave-one-enabled sweep (each candidate enabled
+    alone against the rest disabled; unhealthy means it corrupts by
+    itself). Returns ``[]`` when disabling everything still yields
+    non-finite output (the corruption is upstream of the custom kernels)."""
+    cands = sorted(candidates)
+    if not cands:
+        return []
+    _probe = _memoized_probe(probe)
+    if not _probe(cands):
+        return []  # all custom kernels off, still corrupt: not kernel-borne
+    search = list(cands)
+    while len(search) > 1:
+        half = search[:len(search) // 2]
+        if _probe(half):
+            search = half  # disabling this group removed the corruption
+        else:
+            search = search[len(search) // 2:]
+    if _probe(search):
+        return [search[0]]
+    # multiple simultaneous offenders: x is one iff the step stays corrupt
+    # with ONLY x enabled (every other candidate disabled)
+    offenders = [x for x in cands if not _probe(set(cands) - {x})]
+    if offenders and _probe(offenders):
+        return offenders
+    return []
+
+
+def bisect_offender(candidates, probe) -> str | None:
+    """Single-offender form of :func:`attribute_offenders` (``None`` for
+    upstream corruption or multi-offender attribution)."""
+    offs = attribute_offenders(candidates, probe)
+    return offs[0] if len(offs) == 1 else None
+
+
+# ---------------------------------------------------------------------------
+# replay bundles
+# ---------------------------------------------------------------------------
+
+def dump_replay_bundle(directory: str, *, kind: str, verdict: Verdict,
+                       fn_name: str = "fn", entry=None, inputs=None,
+                       decisions=None) -> str:
+    """Write an offline-repro bundle for an anomaly: ``meta.json`` (verdict,
+    trace hash, decision log, RNG state, time) plus ``inputs.npz`` (the
+    exact step inputs, when provided). Returns the bundle directory."""
+    import numpy as np
+
+    bundle = os.path.join(
+        os.path.abspath(directory),
+        f"{fn_name}-step{verdict.step}-{kind}-{int(time.time() * 1e3)}")
+    os.makedirs(bundle, exist_ok=True)
+    meta: dict = {"kind": kind, "fn": fn_name, "time": time.time(),
+                  "verdict": verdict.to_dict()}
+    if entry is not None and getattr(entry, "traces", None):
+        src = str(entry.traces[-1])
+        meta["trace_hash"] = hashlib.sha1(src.encode()).hexdigest()
+        with open(os.path.join(bundle, "execution_trace.py"), "w") as f:
+            f.write(src)
+    try:
+        import thunder_tpu as tt
+
+        key = tt._rng_state.get("key")
+        if key is not None:
+            meta["rng_key"] = [int(x) for x in np.asarray(key).reshape(-1)]
+    except Exception:
+        pass
+    if decisions is not None:
+        meta["decisions"] = decisions
+    with open(os.path.join(bundle, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    if inputs is not None:
+        arrays = {}
+        for i, x in enumerate(inputs):
+            try:
+                arrays[f"arg{i}"] = np.asarray(x)
+            except Exception:
+                continue
+        if arrays:
+            np.savez(os.path.join(bundle, "inputs.npz"), **arrays)
+    _observe.event("replay_bundle", kind=kind, path=bundle)
+    return bundle

@@ -19,3 +19,7 @@ from thunder_tpu.transforms.materialization import (  # noqa: F401
     deferred_like,
     materialize,
 )
+from thunder_tpu.transforms.numerics_guard import (  # noqa: F401
+    NumericsGuardTransform,
+    observe_grads,
+)
