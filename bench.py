@@ -94,7 +94,12 @@ def main():
     state_dtype = {"f32": _dt.float32, "bf16": _dt.bfloat16,
                    "bf16_all": _dt.bfloat16}[opt_state_kind]
     v_dtype = _dt.bfloat16 if opt_state_kind == "bf16_all" else _dt.float32
-    opt = AdamW(lr=1e-4, state_dtype=state_dtype, v_dtype=v_dtype)
+    # BENCH_SLAB_STATE=1: m/v live packed in per-dtype (rows,128) slabs
+    # between steps (optim.AdamW slab_persistent) — the layout that makes
+    # the fused-AdamW pack/unpack risk moot by construction (PERF_R6 §1)
+    slab_persistent = os.environ.get("BENCH_SLAB_STATE") == "1"
+    opt = AdamW(lr=1e-4, state_dtype=state_dtype, v_dtype=v_dtype,
+                slab_persistent=slab_persistent)
 
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
@@ -203,6 +208,7 @@ def main():
     qkv_merges = int(snap["counters"].get("fusion.horizontal_merges", 0))
     epilogue_fusions = int(snap["counters"].get("fusion.epilogue_fusions", 0))
     optimizer_fusions = int(snap["counters"].get("fusion.optimizer_buckets", 0))
+    block_fusions = int(snap["counters"].get("fusion.block_fusions", 0))
     trace_pass_ms = snap["gauges"].get("compile.transform_ms", 0.0)
     exec_trc = tt.last_execution_trace(jstep)
     regions = [b for b in exec_trc.bound_symbols if str(b.sym.id).startswith("xla.fusion")]
@@ -213,7 +219,8 @@ def main():
         1 for b in regions if cost_model.is_memory_bound(*cost_model.region_cost(b.subsymbols)))
     print(f"fused_region_count={fused_region_count} (memory_bound={mem_bound_regions}) "
           f"horizontal_merges={qkv_merges} epilogue_fusions={epilogue_fusions} "
-          f"optimizer_fusions={optimizer_fusions} "
+          f"optimizer_fusions={optimizer_fusions} block_fusions={block_fusions} "
+          f"slab_persistent={slab_persistent} "
           f"trace_pass_ms={trace_pass_ms:.1f}", file=sys.stderr)
 
     # ---- numerics-sentinel overhead (guarded step, same trace) --------------
@@ -326,9 +333,13 @@ def main():
         newv = jax.tree_util.tree_map(lambda t: t[2], triples, is_leaf=lambda x: isinstance(x, tuple))
         return loss, newp, {"m": newm, "v": newv, "step": step}
 
-    # fresh state: the thunder run donated (consumed) the first copy's buffers
+    # fresh state: the thunder run donated (consumed) the first copy's buffers.
+    # The hand-written baseline always uses the per-parameter m/v tree layout
+    # (it is an independent implementation; slab persistence is the thunder
+    # side's layout choice, not part of the arithmetic being compared)
     params = llama.init_params(cfg, seed=0, scale_layers=n_layers)
-    t_ref, loss_ref = time_steps(jax_step, params, opt.init(params))
+    baseline_opt = AdamW(lr=1e-4, state_dtype=state_dtype, v_dtype=v_dtype)
+    t_ref, loss_ref = time_steps(jax_step, params, baseline_opt.init(params))
     print(f"jax.jit ref: {t_ref*1e3:.1f} ms/step loss={loss_ref:.3f}", file=sys.stderr)
 
     if os.environ.get("BENCH_BREAKDOWN") == "1" and not use_fp8:
@@ -352,9 +363,11 @@ def main():
           file=sys.stderr)
 
     print(json.dumps({
-        # metrics_schema 2: fusion counters come from the thunder_tpu.observe
-        # registry (schema 1 grepped trace source for markers)
-        "metrics_schema": 2,
+        # metrics_schema 3: adds block_fusions (Fusion 3.0 sub-block
+        # megakernel planner) and slab_persistent (optimizer state layout);
+        # schema 2 introduced registry-sourced fusion counters (schema 1
+        # grepped trace source for markers)
+        "metrics_schema": 3,
         "metric": f"{model.replace('-bench', '')}-geometry({n_layers}L,b{batch}"
                   + (",fp8" if use_fp8 else "") + (",remat" if use_remat else "")
                   + ") train tokens/sec/chip",
@@ -365,6 +378,8 @@ def main():
         "horizontal_merges": qkv_merges,
         "epilogue_fusions": epilogue_fusions,
         "optimizer_fusions": optimizer_fusions,
+        "block_fusions": block_fusions,
+        "slab_persistent": slab_persistent,
         "trace_pass_ms": round(trace_pass_ms, 1),
         # supervision/warm-restart health: compile wall time of the thunder
         # step (seconds when the persistent cache is warm) + cache status

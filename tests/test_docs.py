@@ -7,6 +7,7 @@ import re
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(REPO, "docs", "zero_to_thunder_tpu.md")
+KERNELS_DOC = os.path.join(REPO, "KERNELS.md")
 
 
 def test_tutorial_blocks_execute():
@@ -53,3 +54,21 @@ def test_runtime_metric_names_documented():
     assert not missing, (
         "runtime metrics emitted by the code but missing from the docs "
         f"metrics table (docs/zero_to_thunder_tpu.md): {missing}")
+
+
+def test_block_planner_decision_kinds_documented():
+    """Every verdict kind the block planner can emit must appear in the
+    KERNELS.md "Reading planner decisions" table — the decision log is an
+    ops surface (dashboards / triage scripts key on the kinds), and a new
+    kind landing in code without its documented meaning fails tier-1 here
+    rather than drifting silently. The reverse direction (planner records
+    only registered kinds) is asserted in tests/test_block_planner.py."""
+    from thunder_tpu.core.fusion_passes import BLOCK_DECISION_KINDS
+
+    assert BLOCK_DECISION_KINDS, "planner lost its decision vocabulary"
+    with open(KERNELS_DOC) as f:
+        doc = f.read()
+    missing = [k for k in sorted(BLOCK_DECISION_KINDS) if f"`{k}`" not in doc]
+    assert not missing, (
+        "block-planner decision kinds emitted by the code but missing from "
+        f"the KERNELS.md planner-decisions table: {missing}")

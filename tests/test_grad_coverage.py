@@ -117,6 +117,14 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
     "optim.fused_adamw": "built POST-autodiff by the optimizer fusion pass "
                          "(core/fusion_passes.py) — autodiff never sees it; "
                          "never differentiated",
+    "optim.fused_adamw_slab": "slab-persistent optimizer update — emitted by "
+                              "AdamW(slab_persistent=True) on detached "
+                              "grads/state strictly after the backward; "
+                              "never differentiated",
+    "nn.mlp_subblock_bwd": "backward half of the block planner's megakernel "
+                           "pair (emitted by the nn.mlp_subblock VJP rule); "
+                           "differentiating it is second-order autodiff, "
+                           "like nn.sdpa_bwd",
     "sentinel.observe_grads": "identity marker tagging grads for the numerics "
                               "guard — consumes DETACHED grads strictly after "
                               "the backward; stripped by the guard transform "

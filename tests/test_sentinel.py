@@ -147,6 +147,38 @@ def test_injected_nan_grads_skip_step_bit_identical():
 
 
 @pytest.mark.chaos
+def test_injected_nan_grads_skip_with_slab_persistent_optimizer():
+    """Grad auto-detection covers the slab-persistent optimizer layout too:
+    ``optim.fused_adamw_slab`` carries (params, grads, ...) like the other
+    AdamW composites, so a slab-state run keeps the PR8 containment
+    contract — NaN grads are counted and the step skips bit-identically."""
+    opt = AdamW(lr=0.1, slab_persistent=True)
+
+    def step(params, opt_state, x):
+        loss, grads = tt.value_and_grad(
+            lambda p: ops.mean(ops.mul(ops.sub(p["w"], x), ops.sub(p["w"], x))))(params)
+        new_p, new_s = opt.update(params, grads, opt_state)
+        return loss, new_p, new_s
+
+    p0 = {"w": np.linspace(0.0, 1.0, 8).astype(np.float32)}
+    s0 = opt.init(p0)
+    x = np.full((8,), 0.5, np.float32)
+    guard = NumericsGuardTransform()
+    jg = tt.jit(step, transforms=[guard])
+    observe.enable(clear=True)
+    l1, p1, s1 = jg(p0, s0, x)
+    with faults.active(FaultPlan([FaultSpec("numerics:grads", at_steps={2})])):
+        l2, p2, s2 = jg(p1, s1, x)
+    _bit_identical((p1, s1), (p2, s2))
+    snap = observe.snapshot()
+    assert snap["counters"]["runtime.nonfinite_steps"] == 1
+    assert snap["counters"]["runtime.skipped_steps"] == 1
+    l3, p3, s3 = jg(p2, s2, x)  # healthy step really updates again
+    for a, b in zip(_leaves(p2), _leaves(p3)):
+        assert not np.array_equal(a, b)
+
+
+@pytest.mark.chaos
 def test_injected_nan_loss_is_detected_and_visible():
     step, p0, s0, x = _adamw_setup()
     guard = NumericsGuardTransform()

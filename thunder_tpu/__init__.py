@@ -618,7 +618,8 @@ class ThunderTPUFunction:
     def _compile(self, flat, treedef, args, kwargs) -> CacheEntry:
         from thunder_tpu.core.compile_data import CompileContext, compile_context
 
-        self._compile_ctx = CompileContext(self.compile_options)
+        self._compile_ctx = CompileContext(self.compile_options,
+                                           executors=self.executors)
         with compile_context(self._compile_ctx):
             return self._compile_inner(flat, treedef, args, kwargs)
 

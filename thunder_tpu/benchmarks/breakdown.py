@@ -104,6 +104,32 @@ def run_breakdown(*, cfg, n_layers, params, tokens, targets,
     jce = tt.jit(lambda a: tt.value_and_grad(ce_loss)(a))
     t_ce = time_fn(jce, (h, w), steps=steps)
 
+    # MLP sub-block fwd+bwd at the bench shape (per layer, x n_layers),
+    # compiled with the block planner FORCED on so the chain runs as the
+    # claimed nn.mlp_subblock megakernel — the isolated number the Fusion 3.0
+    # planner is accountable to against the linears_norms_rest residual
+    # (PERF_R7). block_fusion=True (not the cost-model default) because this
+    # row measures the planned kernel, not the planning decision.
+    layer0 = params["layers"][0]
+    hres = jax.device_put((rng.randn(B, T, cfg.dim).astype(np.float32) * 0.1)
+                          .astype(cfg.dtype.jax))
+    xattn = jax.device_put((rng.randn(B, T, cfg.dim).astype(np.float32) * 0.1)
+                           .astype(cfg.dtype.jax))
+    sub_w = jax.device_put({k: layer0[k] for k in
+                            ("mlp_norm", "w_gate", "w_up", "w_down")})
+
+    def sub_loss(args):
+        hh, xx, w = args
+        h2 = ops.add(hh, xx)
+        n = ops.rms_norm(h2, w["mlp_norm"], eps=cfg.norm_eps)
+        gate = ops.silu(ops.linear(n, w["w_gate"]))
+        up = ops.linear(n, w["w_up"])
+        out = ops.add(h2, ops.linear(ops.mul(gate, up), w["w_down"]))
+        return ops.sum(out)
+
+    jsub = tt.jit(lambda a: tt.value_and_grad(sub_loss)(a), block_fusion=True)
+    t_sub = time_fn(jsub, (hres, xattn, sub_w), steps=steps) * n_layers
+
     t_att = t_att1 * n_layers
     t_bwd = max(0.0, t_fb - t_fwd)
     t_opt = max(0.0, t_full - t_fb)
@@ -117,6 +143,9 @@ def run_breakdown(*, cfg, n_layers, params, tokens, targets,
         "attention_fwdbwd_ms(isolated x layers)": t_att * 1e3,
         "lmhead_ce_fwdbwd_ms(isolated)": t_ce * 1e3,
         "linears_norms_rest_ms(residual)": t_rest * 1e3,
+        # planned MLP sub-block megakernel, fwd+bwd, x n_layers — compare
+        # against linears_norms_rest_ms: the planner's target chain
+        "subblock_fused_ms(isolated)": t_sub * 1e3,
     }
 
     # isolated optimizer update fed by REAL gradients: the knockout delta

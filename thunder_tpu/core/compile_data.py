@@ -17,13 +17,17 @@ _compile_ctx: ContextVar = ContextVar("thunder_tpu_compile_ctx", default=None)
 
 class CompileContext:
     """Holds the options passed to ``jit`` plus the registry of queries made
-    by passes during compilation."""
+    by passes during compilation. ``executors`` is the compiling function's
+    resolved executor stack — trace-time passes that must probe claimability
+    BEFORE ``transform_for_execution`` (the pre-autodiff block planner)
+    read it from here."""
 
-    __slots__ = ("options", "queried")
+    __slots__ = ("options", "queried", "executors")
 
-    def __init__(self, options: dict[str, Any]):
+    def __init__(self, options: dict[str, Any], executors: Any = None):
         self.options = dict(options)
         self.queried: dict[str, str] = {}  # name -> description
+        self.executors = executors
 
 
 class compile_context:

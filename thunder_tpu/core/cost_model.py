@@ -34,6 +34,15 @@ from thunder_tpu.core.utils import consumed_vars, produced_vars
 # v5e bf16 peak over HBM bandwidth; the ridge point of the roofline.
 TPU_RIDGE_FLOPS_PER_BYTE = 240.0
 
+# v5e scoped-VMEM budget a single Pallas kernel invocation can stage (the
+# chip holds ~16 MiB usable after Mosaic's own reservations; the r5 combined
+# attention backward measured the hard error at ~17.6 MB). Block-planner
+# feasibility checks model against this.
+VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+# v5e peak matmul rate (bf16) — shared by the sub-block model below.
+TPU_PEAK_FLOPS = 197e12
+
 # Below this many bytes of traffic a dedicated kernel launch can't amortize
 # its dispatch + pipeline-fill overhead against XLA's fused code (~1 MiB is
 # roughly 1.2 us of HBM time on v5e, the same order as kernel launch).
@@ -160,7 +169,8 @@ ADAMW_CHAIN_EFFICIENCY = 0.45    # measured: per-param fused pointwise chains
 ADAMW_FUSED_EFFICIENCY = 0.85    # modeled: one contiguous slab per operand
 
 
-def fused_adamw_cost(n_tensors: int, total_bytes: int) -> dict:
+def fused_adamw_cost(n_tensors: int, total_bytes: int,
+                     slab_persistent: bool = False) -> dict:
     """Bytes-moved model for one optimizer dtype bucket: estimated µs for the
     per-parameter chains vs one flattened multi-tensor launch.
     ``total_bytes`` is the update's moved bytes (g,p,m,v reads + p,m,v
@@ -181,15 +191,37 @@ def fused_adamw_cost(n_tensors: int, total_bytes: int) -> dict:
     residency transiently grows by the bucket size during the update —
     time, not residency, is what this model ranks; near the HBM capacity
     limit pass ``fused_optimizer=False`` (or rely on the depth configs'
-    remat headroom) until slab-persistent state lands."""
+    remat headroom) until slab-persistent state lands.
+
+    ``slab_persistent=True`` (``optim.AdamW(slab_persistent=True)``): m/v
+    live packed in per-dtype-bucket ``(rows, 128)`` slabs BETWEEN steps —
+    the m/v pack/unpack around the kernel no longer exists (the kernel
+    reads and writes the persistent slabs directly), so the
+    ``pack_bytes_if_unabsorbed`` downside is zero BY CONSTRUCTION for the
+    state streams (only the p/g pack remains exposed to XLA's concatenate
+    fusion, ~1/3 of the staging risk the r6 note recorded). The dict says
+    which layout the verdict was computed under so the decision log and
+    PERF_R6's risk note can never silently disagree."""
     stream_us = total_bytes / (ADAMW_HBM_GBPS * 1e3)
     unfused = stream_us / ADAMW_CHAIN_EFFICIENCY + n_tensors * ADAMW_LAUNCH_OVERHEAD_US
     fused = stream_us / ADAMW_FUSED_EFFICIENCY + ADAMW_LAUNCH_OVERHEAD_US
-    return {"tensors": n_tensors, "total_bytes": total_bytes,
+    # the exposed staging traffic if XLA does NOT absorb the packs: one
+    # read+write per staged stream, ~2x the update bytes when all 7 streams
+    # (g,p,m,v in + p,m,v out) stage. Slab-persistent m/v never stage — the
+    # downside term is ZERO by construction; the p/g packs that remain
+    # exposed to XLA's concatenate fusion (~5/12 of the old figure: p+g is
+    # half the reads, p a third of the writes) are surfaced separately as
+    # ``pg_pack_bytes_if_unabsorbed`` so the residual risk stays visible
+    # without re-inflating the term the layout removed.
+    cost = {"tensors": n_tensors, "total_bytes": total_bytes,
             "saved_launches": max(n_tensors - 1, 0),
-            "pack_bytes_if_unabsorbed": 2 * total_bytes,
+            "slab_persistent": bool(slab_persistent),
+            "pack_bytes_if_unabsorbed": 0 if slab_persistent else 2 * total_bytes,
             "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
             "est_saved_us": round(unfused - fused, 3)}
+    if slab_persistent:
+        cost["pg_pack_bytes_if_unabsorbed"] = (2 * total_bytes) * 5 // 12
+    return cost
 
 
 def fused_adamw_profitable(n_tensors: int, total_bytes: int) -> bool:
@@ -203,6 +235,88 @@ def fused_adamw_profitable(n_tensors: int, total_bytes: int) -> bool:
         return False
     c = fused_adamw_cost(n_tensors, total_bytes)
     return c["est_fused_us"] < c["est_unfused_us"]
+
+
+# --- block-level (sub-block megakernel) model ------------------------------
+# The block planner (core/fusion_passes.block_fusion_pass) rewrites a whole
+# transformer MLP sub-block chain — residual add → rms_norm → gate/up GEMMs →
+# act → mul → down GEMM → residual add — into ONE claimable composite
+# (nn.mlp_subblock). Two questions gate every candidate, mirroring the
+# fused_adamw modeled-vs-measured-efficiency structure:
+#
+# 1. VMEM residency: can the megakernel's per-grid-step staging (row tiles +
+#    f32 scratch + double-buffered weight tiles) fit the scoped-VMEM budget?
+#    Infeasible chains are never planned — a claim that compiles then dies on
+#    chip would cost a quarantine round-trip for nothing.
+# 2. Saved boundary bytes: the chain's interior values (normed activations,
+#    gate/up pre-activations, the SwiGLU product, the down-projection) each
+#    round-trip HBM once between XLA kernels in the unfused program; the
+#    megakernel keeps them in VMEM. The byte saving must beat the fused
+#    path's launch overhead and its (modeled) MXU-efficiency handicap vs
+#    XLA's own GEMM scheduling.
+SUBBLOCK_XLA_EFFICIENCY = 0.84    # measured-class: 251.8 ms dense region vs
+                                  # its 210.5 ms roofline (BENCH_BREAKDOWN r5)
+SUBBLOCK_FUSED_EFFICIENCY = 0.80  # modeled: hand tiling concedes a little
+                                  # MXU scheduling to XLA; the win is bytes
+SUBBLOCK_LAUNCH_OVERHEAD_US = 8.0  # dispatch + pipeline fill (v5e, as adamw)
+# kernel tile budgets — the SINGLE source of truth: executors/pallasex.py
+# imports these for the megakernel's actual block picks, so the feasibility
+# model above and the kernel's real staging can never drift apart
+SUBBLOCK_ROW_BLOCK = 128
+SUBBLOCK_FF_BLOCK = 128
+
+
+def subblock_vmem_bytes(d_model: int, d_ff: int, dtype_bytes: int,
+                        n_tokens: int | None = None) -> int:
+    """Modeled per-grid-step VMEM staging of the sub-block megakernel:
+    3 f32 row scratches (h, normed, accumulator) + 3 streamed row tiles
+    (residual, x, out) + 3 double-buffered weight tiles (gate, up, down
+    slices of ``SUBBLOCK_FF_BLOCK`` rows/cols)."""
+    bn = min(SUBBLOCK_ROW_BLOCK, n_tokens) if n_tokens else SUBBLOCK_ROW_BLOCK
+    bf = min(SUBBLOCK_FF_BLOCK, d_ff)
+    return (3 * bn * d_model * 4            # h / normed / acc scratch (f32)
+            + 3 * bn * d_model * dtype_bytes   # residual, x, out row tiles
+            + 2 * 3 * bf * d_model * dtype_bytes)  # wg/wu/wd tiles, 2x buffered
+
+
+def subblock_cost(n_tokens: int, d_model: int, d_ff: int,
+                  dtype_bytes: int) -> dict:
+    """Score one MLP sub-block chain for megakernel planning. Returns the
+    decision-log dict: VMEM feasibility, the saved-boundary-bytes objective,
+    and est_unfused/fused_us under the efficiency constants above."""
+    flops = 3 * 2 * n_tokens * d_model * d_ff  # gate + up + down GEMMs
+    # interior values written+read once each between kernels in the unfused
+    # program: normed (N*D), gate pre-act (N*F), up (N*F), swiglu product
+    # (N*F), down projection (N*D), plus the residual stream h (N*D) which
+    # round-trips between the add and the norm
+    interior_bytes = 2 * n_tokens * (3 * d_model + 3 * d_ff) * dtype_bytes
+    # boundary traffic both variants pay: inputs (residual, x, weights) +
+    # the block output
+    boundary_bytes = (3 * n_tokens * d_model * dtype_bytes
+                      + 3 * d_model * d_ff * dtype_bytes)
+    flop_us = flops / TPU_PEAK_FLOPS * 1e6
+    bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
+    unfused = (flop_us / SUBBLOCK_XLA_EFFICIENCY
+               + (boundary_bytes + interior_bytes) * bw_us_per_byte)
+    fused = (flop_us / SUBBLOCK_FUSED_EFFICIENCY
+             + boundary_bytes * bw_us_per_byte + SUBBLOCK_LAUNCH_OVERHEAD_US)
+    vmem = subblock_vmem_bytes(d_model, d_ff, dtype_bytes, n_tokens)
+    return {"n_tokens": n_tokens, "d_model": d_model, "d_ff": d_ff,
+            "flops": flops,
+            "saved_boundary_bytes": interior_bytes,
+            "vmem_bytes_per_step": vmem,
+            "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
+            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+            "est_saved_us": round(unfused - fused, 3)}
+
+
+def subblock_profitable(cost: dict) -> bool:
+    """Plan the chain? VMEM-infeasible never plans; otherwise the byte
+    saving must beat the launch overhead + modeled efficiency handicap
+    (tiny traces lose on the 8 µs term alone, bench-geometry chains win on
+    megabytes of interior traffic). ``block_fusion=True/False`` overrides
+    per-compile."""
+    return bool(cost["vmem_feasible"]) and cost["est_saved_us"] > 0.0
 
 
 def horizontal_merge_profitable(m_tokens: int, out_features) -> bool:

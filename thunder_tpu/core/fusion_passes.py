@@ -54,6 +54,29 @@ from thunder_tpu.observe import registry as _observe
 HORIZONTAL_MARKER = "horizontal-fusion"
 EPILOGUE_MARKER = "epilogue-fusion"
 OPTIMIZER_MARKER = "optimizer-fusion"
+BLOCK_MARKER = "block-fusion"
+
+# Every verdict the block planner can emit, with its meaning. The planner
+# records ONLY these kinds (``_record_block`` asserts it), and the docs
+# contract (tests/test_docs.py::test_block_planner_decision_kinds_documented)
+# fails tier-1 when a kind exists here but is missing from the KERNELS.md
+# "Reading planner decisions" table — the decision log is an ops surface,
+# and silent vocabulary drift breaks anyone parsing it.
+BLOCK_DECISION_KINDS = {
+    "planned": "chain rewritten into one claimed nn.mlp_subblock megakernel",
+    "interior-escapes": "an interior value of the chain is consumed outside "
+                        "it (or is a trace output); fusing would hide a "
+                        "value someone still reads",
+    "dist-annotated": "an operand carries distributed-parallel metadata; "
+                      "sub-block chains are never planned across shards",
+    "vmem-infeasible": "the megakernel's per-grid-step staging exceeds the "
+                       "scoped-VMEM budget at this shape",
+    "cost-rejected": "the saved-boundary-bytes objective loses to the launch "
+                     "overhead + modeled MXU-efficiency handicap",
+    "unclaimed": "no executor claims the fused composite (checker refused)",
+    "rebuild-mismatch": "the composite retrace produced different output "
+                        "metadata than the original chain (kept unfused)",
+}
 
 
 # ---------------------------------------------------------------------------
@@ -561,6 +584,316 @@ def optimizer_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
     new.bound_symbols = out
     new.set_provenance(f"Optimizer fusion ({n_fused} multi-tensor buckets)")
     return new
+
+
+# ---------------------------------------------------------------------------
+# block-level fusion planner (Fusion 3.0): whole transformer sub-block chains
+# -> one claimed Pallas megakernel
+# ---------------------------------------------------------------------------
+
+_ADD_IDS = (PrimIDs.ADD, "ops.add")
+_MUL_IDS = (PrimIDs.MUL, "ops.mul")
+
+
+def _record_block(decision: str, reason: str, cost: dict | None) -> None:
+    assert decision in BLOCK_DECISION_KINDS, decision
+    _decisions.record("block", "nn.mlp_subblock", None, decision, reason, cost=cost)
+
+
+def _plain_linear(b: BoundSymbol):
+    """(input, weight) for a bias-free single-GEMM ``nn.linear``, else None.
+    A bias add, TP collective, or fp8 path adds subsymbols; such linears are
+    not absorbed into a megakernel (the kernel would drop their extras)."""
+    if b.sym.id != "nn.linear" or len(b.subsymbols) != 1:
+        return None
+    if b.subsymbols[0].sym.id is not PrimIDs.DOT_GENERAL:
+        return None
+    a, w = b.args[0], b.args[1]
+    if len(b.args) > 2 and b.args[2] is not None:
+        return None
+    if not (isinstance(a, TensorProxy) and isinstance(w, TensorProxy) and w.ndim == 2):
+        return None
+    return a, w
+
+
+def _chain_act(b: BoundSymbol) -> str | None:
+    act = _ACT_IDS.get(b.sym.id)
+    if act == "gelu":
+        approx = b.kwargs.get("approximate", b.args[1] if len(b.args) > 1 else "none")
+        act = "gelu_tanh" if approx == "tanh" else "gelu"
+    return act
+
+
+def block_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
+    """The block-level megakernel planner (ROADMAP item 3 / FlashFuser-class
+    fusion scale): walk the trace's dataflow for whole transformer MLP
+    sub-block chains —
+
+        add(residual, attn_out) → rms_norm → {linear→act, linear} → mul
+        → linear → add
+
+    — score each candidate with ``cost_model.subblock_cost`` (VMEM-residency
+    feasibility + the saved-boundary-bytes objective) and rewrite accepted
+    chains into ONE ``nn.mlp_subblock`` composite claimed by the Pallas
+    executor as a single streamed-weight megakernel. Runs at two points:
+
+    - pre-autodiff on the loss sub-trace (``core.transforms``
+      ``inline_value_and_grad``), so the composite's VJP rule keeps BOTH
+      directions claimable in training traces (backward emits the
+      equally-claimable ``nn.mlp_subblock_bwd``);
+    - in ``transform_for_execution`` for inference traces (no autodiff, so
+      the composite-level chain survives to the execution pipeline).
+
+    Every verdict — chain found, boundary chosen, VMEM-infeasible,
+    cost-rejected, escape-blocked — lands in ``CompileStats.last_decisions``
+    with the cost-model numbers (``observe.explain()``'s "block planner"
+    section); the kinds are enumerated in :data:`BLOCK_DECISION_KINDS`.
+    ``block_fusion=True`` forces planning past the cost/VMEM gates (test and
+    interpret-mode use), ``False`` disables the pass, unset lets the cost
+    model decide. Dist-annotated operands are never planned across shards.
+    """
+    enabled = get_compile_option(
+        "block_fusion",
+        "plan whole transformer MLP sub-block chains into single claimed "
+        "megakernels (nn.mlp_subblock): True = always (skips the cost/VMEM "
+        "gates), False = never, unset = cost-model decision",
+        None)
+    if enabled is False or not executors:
+        return trc
+    bsyms = trc.bound_symbols
+    # cheap anchor scan: the chain needs a composite-level rms_norm AND
+    # composite-level linears (post-autodiff traces are prim-level for the
+    # linears, and their chains were already planned pre-autodiff)
+    ids = {b.sym.id for b in bsyms}
+    if "nn.rms_norm" not in ids or "nn.linear" not in ids:
+        return trc
+    from thunder_tpu.core.pytree import tree_flatten
+
+    producer: dict[str, int] = {}
+    consumers: dict[str, list[int]] = {}
+    for i, b in enumerate(bsyms):
+        for p in b.flat_proxy_args():
+            consumers.setdefault(p.name, []).append(i)
+        for o in b.flat_proxy_outs():
+            producer.setdefault(o.name, i)
+    out_names = {o.name for o in tree_flatten(trc.output)[0] if isinstance(o, Proxy)}
+
+    def single_proxy_out(b):
+        outs = b.flat_proxy_outs()
+        return outs[0] if len(outs) == 1 else None
+
+    replacements: dict[int, list[BoundSymbol]] = {}  # final-add index -> bsyms
+    dropped: set[int] = set()
+    used: set[int] = set()
+    n_planned = 0
+    for ri, rb in enumerate(bsyms):
+        if rb.sym.id != "nn.rms_norm" or ri in used:
+            continue
+        # --- structure discovery (phase 1: ignore exclusivity) -------------
+        h = rb.args[0] if rb.args else None
+        if not isinstance(h, TensorProxy) or h.name not in producer:
+            continue
+        dim = rb.kwargs.get("dim", rb.args[3] if len(rb.args) > 3 else -1)
+        if dim not in (-1, h.ndim - 1):
+            continue
+        w_norm = rb.args[1] if len(rb.args) > 1 else rb.kwargs.get("weight")
+        if not isinstance(w_norm, TensorProxy):
+            continue
+        eps = rb.kwargs.get("eps", rb.args[2] if len(rb.args) > 2 else 1e-5)
+        ai = producer[h.name]
+        ab = bsyms[ai]
+        if ab.sym.id not in _ADD_IDS or len(ab.args) != 2:
+            continue
+        residual, xx = ab.args
+        if not (isinstance(residual, TensorProxy) and isinstance(xx, TensorProxy)):
+            continue
+        if tuple(residual.shape) != tuple(xx.shape) or residual.dtype != xx.dtype:
+            continue
+        n = single_proxy_out(rb)
+        if n is None:
+            continue
+        # gate path: a plain linear over n whose output feeds an activation
+        # whose output feeds a mul; up path: another plain linear over n
+        # feeding the SAME mul
+        lin_consumers = []
+        for ci in consumers.get(n.name, ()):
+            if ci in used:
+                continue
+            facts = _plain_linear(bsyms[ci])
+            if facts is not None and facts[0].name == n.name:
+                lin_consumers.append(ci)
+        found = None
+        for gi in lin_consumers:
+            gout = single_proxy_out(bsyms[gi])
+            if gout is None:
+                continue
+            gcons = consumers.get(gout.name, ())
+            if len(gcons) != 1:
+                continue
+            actb = bsyms[gcons[0]]
+            act = _chain_act(actb)
+            if act is None or not actb.args \
+                    or getattr(actb.args[0], "name", None) != gout.name:
+                continue
+            aout = single_proxy_out(actb)
+            if aout is None:
+                continue
+            acons = consumers.get(aout.name, ())
+            if len(acons) != 1 or bsyms[acons[0]].sym.id not in _MUL_IDS:
+                continue
+            mi = acons[0]
+            mb = bsyms[mi]
+            if len(mb.args) != 2 or not all(isinstance(a, TensorProxy)
+                                            for a in mb.args):
+                continue
+            other = mb.args[1] if mb.args[0].name == aout.name else mb.args[0]
+            ui = next((j for j in lin_consumers
+                       if j != gi and single_proxy_out(bsyms[j]) is not None
+                       and single_proxy_out(bsyms[j]).name == getattr(other, "name", None)),
+                      None)
+            if ui is None:
+                continue
+            mout = single_proxy_out(mb)
+            if mout is None:
+                continue
+            mcons = consumers.get(mout.name, ())
+            if len(mcons) != 1:
+                continue
+            dfacts = _plain_linear(bsyms[mcons[0]])
+            if dfacts is None or dfacts[0].name != mout.name:
+                continue
+            di = mcons[0]
+            dout = single_proxy_out(bsyms[di])
+            if dout is None:
+                continue
+            dcons = consumers.get(dout.name, ())
+            if len(dcons) != 1:
+                continue
+            fb = bsyms[dcons[0]]
+            if fb.sym.id not in _ADD_IDS or len(fb.args) != 2 \
+                    or not all(isinstance(a, TensorProxy) for a in fb.args):
+                continue
+            names = {fb.args[0].name, fb.args[1].name}
+            if names != {h.name, dout.name}:
+                continue
+            found = (gi, gcons[0], act, ui, mi, di, dcons[0])
+            break
+        if found is None:
+            continue
+        gi, acti, act, ui, mi, di, fi = found
+        chain = {ai, ri, gi, acti, ui, mi, di, fi}
+        if chain & used:
+            continue
+        fout = single_proxy_out(bsyms[fi])
+        if fout is None:
+            continue
+        w_gate = _plain_linear(bsyms[gi])[1]
+        w_up = _plain_linear(bsyms[ui])[1]
+        w_down = _plain_linear(bsyms[di])[1]
+        if tuple(w_up.shape) != tuple(w_gate.shape) \
+                or tuple(w_down.shape) != (w_gate.shape[1], w_gate.shape[0]):
+            continue
+        n_tokens = 1
+        for d in h.shape[:-1]:
+            n_tokens *= int(d)
+        cost = dict(cost_model.subblock_cost(
+            n_tokens, int(w_gate.shape[1]), int(w_gate.shape[0]),
+            h.dtype.bytes), chain=h.name, act=act, ops=len(chain))
+        # --- verdicts (phase 2) --------------------------------------------
+        # exclusivity: every interior value must be consumed ONLY inside the
+        # chain and must not be a trace output — the megakernel does not
+        # produce it
+        escaped = None
+        for p, owners in ((h, {ri, fi}), (n, {gi, ui}),
+                          (single_proxy_out(bsyms[gi]), {acti}),
+                          (single_proxy_out(bsyms[acti]), {mi}),
+                          (single_proxy_out(bsyms[ui]), {mi}),
+                          (single_proxy_out(bsyms[mi]), {di}),
+                          (single_proxy_out(bsyms[di]), {fi})):
+            if p.name in out_names or set(consumers.get(p.name, ())) - owners:
+                escaped = p.name
+                break
+        if escaped is not None:
+            _record_block("interior-escapes",
+                          f"{escaped} is consumed outside the chain", cost)
+            continue
+        if any(_dist_annotated(p) for p in
+               (residual, xx, w_norm, w_gate, w_up, w_down)):
+            _record_block("dist-annotated",
+                          "operands carry distributed-parallel metadata; "
+                          "never planned across shards", cost)
+            continue
+        if enabled is not True and not cost["vmem_feasible"]:
+            _record_block("vmem-infeasible",
+                          "per-grid-step staging exceeds the scoped-VMEM "
+                          "budget", cost)
+            continue
+        if enabled is not True and not cost_model.subblock_profitable(cost):
+            _record_block("cost-rejected",
+                          "saved boundary bytes lose to launch overhead + "
+                          "modeled MXU-efficiency handicap "
+                          "(need est_saved_us > 0)", cost)
+            continue
+        comp_args = (residual, xx, w_norm, w_gate, w_up, w_down)
+        comp_kwargs = {"act": act, "eps": eps}
+        if not _some_executor_claims(executors, "nn.mlp_subblock",
+                                     comp_args, comp_kwargs, (fout,)):
+            _record_block("unclaimed",
+                          "no executor claims the fused composite "
+                          "(checker refused)", cost)
+            continue
+        from thunder_tpu.ops import nn as tnn
+
+        repl = _build_composite(trc, tnn.mlp_subblock, comp_args, comp_kwargs,
+                                [fout])
+        if not repl:
+            _record_block("rebuild-mismatch",
+                          "composite retrace changed output metadata", cost)
+            continue
+        repl[-1].header = (f"{BLOCK_MARKER}: {len(chain)}-op MLP sub-block "
+                           f"chain planned as one megakernel "
+                           f"({cost['saved_boundary_bytes'] >> 10} KiB of "
+                           f"interior traffic kept in VMEM)")
+        _record_block("planned",
+                      "forced by block_fusion=True" if enabled is True
+                      else "cost model: interior-byte saving beats the "
+                           "fused-path overheads", cost)
+        _observe.inc("fusion.block_fusions")
+        replacements[fi] = repl
+        dropped.update(chain - {fi})
+        used |= chain
+        n_planned += 1
+
+    if not replacements:
+        return trc
+    new = from_trace(trc)
+    out: list[BoundSymbol] = []
+    for i, b in enumerate(bsyms):
+        if i in replacements:
+            out.extend(replacements[i])
+        elif i not in dropped:
+            out.append(b)
+    new.bound_symbols = out
+    new.set_provenance(f"Block fusion planner ({n_planned} sub-block megakernels)")
+    return new
+
+
+def plan_blocks_for_autodiff(trc: TraceCtx) -> TraceCtx:
+    """Pre-autodiff planner entry (called by ``inline_value_and_grad`` /
+    ``forward_and_backward_from_trace`` on the loss sub-trace, BEFORE the
+    pullback replay): resolves the compiling function's executor stack from
+    the compile context and runs :func:`block_fusion_pass`, so planned
+    composites hit their VJP rule and stay claimable in both directions.
+    Outside a compile (no context, e.g. direct trace manipulation in tests)
+    this is a no-op."""
+    from thunder_tpu.core.compile_data import get_compile_data
+
+    ctx = get_compile_data()
+    executors = getattr(ctx, "executors", None) if ctx is not None else None
+    if not executors:
+        return trc
+    with _observe.span("block_fusion_pre_autodiff"):
+        return block_fusion_pass(trc, executors)
 
 
 def epilogue_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:

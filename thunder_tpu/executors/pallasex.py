@@ -953,6 +953,307 @@ def _linear_act_checker(a, w, bias=None, act: str = "relu"):
 
 
 # ---------------------------------------------------------------------------
+# transformer MLP sub-block megakernel (Fusion 3.0: claimed from the
+# nn.mlp_subblock composite built by core.fusion_passes.block_fusion_pass).
+# One launch computes the whole chain
+#     h = residual + x; n = rms_norm(h, w_norm);
+#     out = h + (act(n @ wg^T) * (n @ wu^T)) @ wd^T
+# with the weights STREAMED through the grid in d_ff blocks — h/n/acc live
+# in VMEM scratch for the row block, so none of the chain's interior values
+# (n, gate/up pre-activations, the SwiGLU product, the down projection)
+# ever round-trips HBM. The backward pair below applies the same recipe to
+# nn.mlp_subblock_bwd: recompute the interiors per tile (the flash-attention
+# memory contract), one pass producing dh (+ the normed rows for reuse), a
+# second accumulating the weight grads across the row grid dimension.
+# ---------------------------------------------------------------------------
+
+# tile budgets are owned by core/cost_model.py: the planner's
+# VMEM-feasibility gate and this kernel's actual staging must be computed
+# from the SAME numbers, or the gate validates a kernel with a different
+# footprint than the one that runs (the compiles-then-dies-on-chip failure
+# the rule exists to prevent)
+from thunder_tpu.core.cost_model import (  # noqa: E402
+    SUBBLOCK_FF_BLOCK as _SUBBLOCK_FF_BUDGET,
+    SUBBLOCK_ROW_BLOCK as _SUBBLOCK_ROW_BUDGET,
+)
+
+
+def _act_grad_f32(act: str, a):
+    """d act(a)/da on an f32 tile (closed forms; mirrors ops.nn._act_grad)."""
+    if act == "relu":
+        return (a > 0).astype(jnp.float32)
+    if act == "silu":
+        sig = jax.nn.sigmoid(a)
+        return sig * (1.0 + a * (1.0 - sig))
+    if act == "gelu":
+        cdf = 0.5 * (1.0 + jax.lax.erf(a / math.sqrt(2.0)))
+        pdf = jnp.exp(-0.5 * a * a) / math.sqrt(2.0 * math.pi)
+        return cdf + a * pdf
+    c = math.sqrt(2.0 / math.pi)  # gelu_tanh
+    u = c * (a + 0.044715 * a * a * a)
+    t = jnp.tanh(u)
+    du = c * (1.0 + 3.0 * 0.044715 * a * a)
+    return 0.5 * (1.0 + t) + 0.5 * a * (1.0 - t * t) * du
+
+
+def _mlp_subblock_kernel(r_ref, x_ref, wn_ref, wg_ref, wu_ref, wd_ref, o_ref,
+                         h_ref, n_ref, acc_ref, *, act: str, eps: float, nf: int,
+                         cast):
+    """Forward megakernel body. Grid (row_blocks, ff_blocks), ff innermost:
+    at f == 0 the row block's h and normed rows are computed once into
+    scratch; every f step runs the gate/up GEMM slices against the streamed
+    weight tiles and accumulates the down-projection into f32 scratch; the
+    final f step adds the residual back and stores."""
+    f = pl.program_id(1)
+
+    @pl.when(f == 0)
+    def _init():
+        h = r_ref[...] + x_ref[...]                 # input dtype, as unfused
+        h_ref[...] = h
+        x32 = h.astype(jnp.float32)
+        ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        nh = (x32 * jax.lax.rsqrt(ms + eps)).astype(cast)
+        n_ref[...] = nh * wn_ref[...]
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n = n_ref[...]
+    gpre = jax.lax.dot_general(n, wg_ref[...], (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    ga = _ACT_IMPLS[act](gpre).astype(cast)
+    u = jax.lax.dot_general(n, wu_ref[...], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32).astype(cast)
+    acc_ref[...] += jax.lax.dot_general(ga * u, wd_ref[...], (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(f == nf - 1)
+    def _finalize():
+        o_ref[...] = (h_ref[...] + acc_ref[...].astype(cast)).astype(o_ref.dtype)
+
+
+def _subblock_grid(N: int, D: int, F: int):
+    bn = _pick_block(N, _SUBBLOCK_ROW_BUDGET)
+    bf = _pick_block(F, _SUBBLOCK_FF_BUDGET)
+    return bn, bf
+
+
+def pallas_mlp_subblock(residual, x, w_norm, w_gate, w_up, w_down,
+                        act: str = "silu", eps: float = 1e-5):
+    orig_shape = x.shape
+    D = x.shape[-1]
+    N = x.size // D
+    F = w_gate.shape[0]
+    r2 = residual.reshape(N, D)
+    x2 = x.reshape(N, D)
+    bn, bf = _subblock_grid(N, D, F)
+    grid = (N // bn, F // bf)
+    row = pl.BlockSpec((bn, D), lambda i, f: (i, 0))
+    wrow = pl.BlockSpec((bf, D), lambda i, f: (f, 0))
+    out = pl.pallas_call(
+        functools.partial(_mlp_subblock_kernel, act=act, eps=eps, nf=grid[1],
+                          cast=x.dtype),
+        grid=grid,
+        in_specs=[row, row,
+                  pl.BlockSpec((D,), lambda i, f: (0,)),
+                  wrow, wrow,
+                  pl.BlockSpec((D, bf), lambda i, f: (0, f))],
+        out_specs=row,
+        out_shape=jax.ShapeDtypeStruct((N, D), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, D), x.dtype),
+                        pltpu.VMEM((bn, D), x.dtype),
+                        pltpu.VMEM((bn, D), jnp.float32)],
+        interpret=_interpret(),
+    )(r2, x2, w_norm, w_gate, w_up, w_down)
+    return out.reshape(orig_shape)
+
+
+def _mlp_subblock_bwd_dx_kernel(g_ref, r_ref, x_ref, wn_ref, wg_ref, wu_ref,
+                                wd_ref, dh_ref, n_ref, dwn_ref,
+                                xhat_ref, rr_ref, dn_ref, *, act: str,
+                                eps: float, nf: int, cast):
+    """Backward pass 1: dh for the row block (plus the recomputed normed
+    rows, written out once for pass 2, and per-row-block partials of the
+    norm-weight grad). The inner ff grid dimension accumulates
+    dn = dgpre @ wg + dup @ wu into scratch; the final step runs the
+    rms-norm backward — which needs the WHOLE dn row — and emits dh."""
+    f = pl.program_id(1)
+
+    @pl.when(f == 0)
+    def _init():
+        h32 = (r_ref[...] + x_ref[...]).astype(jnp.float32)
+        ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
+        rr = jax.lax.rsqrt(ms + eps)
+        xhat = h32 * rr
+        xhat_ref[...] = xhat
+        rr_ref[...] = rr
+        n_ref[...] = (xhat.astype(cast) * wn_ref[...]).astype(n_ref.dtype)
+        dn_ref[...] = jnp.zeros_like(dn_ref)
+
+    n = n_ref[...]
+    gpre = jax.lax.dot_general(n, wg_ref[...], (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    u = jax.lax.dot_general(n, wu_ref[...], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    dy = jax.lax.dot_general(g_ref[...], wd_ref[...], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dga = dy * u
+    dup = (dy * _ACT_IMPLS[act](gpre)).astype(cast)
+    dgpre = (dga * _act_grad_f32(act, gpre)).astype(cast)
+    dn_ref[...] += (
+        jax.lax.dot_general(dgpre, wg_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(dup, wu_ref[...], (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32))
+
+    @pl.when(f == nf - 1)
+    def _finalize():
+        dn = dn_ref[...]
+        xhat = xhat_ref[...]
+        dwn_ref[...] = jnp.sum(dn * xhat, axis=0, keepdims=True)
+        gxhat = dn * wn_ref[...].astype(jnp.float32)
+        proj = jnp.mean(gxhat * xhat, axis=-1, keepdims=True)
+        dh = g_ref[...].astype(jnp.float32) + rr_ref[...] * (gxhat - xhat * proj)
+        dh_ref[...] = dh.astype(dh_ref.dtype)
+
+
+def _mlp_subblock_bwd_dw_kernel(g_ref, n_ref, wg_ref, wu_ref, wd_ref,
+                                dwg_ref, dwu_ref, dwd_ref,
+                                dwg_acc, dwu_acc, dwd_acc, *, act: str,
+                                nr: int, cast):
+    """Backward pass 2: weight grads. Grid (ff_blocks, row_blocks), rows
+    innermost — each ff block's dwg/dwu/dwd slices accumulate across the
+    row stream in f32 scratch (the interiors are recomputed per tile from
+    the normed rows pass 1 wrote out)."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dwg_acc[...] = jnp.zeros_like(dwg_acc)
+        dwu_acc[...] = jnp.zeros_like(dwu_acc)
+        dwd_acc[...] = jnp.zeros_like(dwd_acc)
+
+    n = n_ref[...]
+    g = g_ref[...]
+    gpre = jax.lax.dot_general(n, wg_ref[...], (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    ga = _ACT_IMPLS[act](gpre)
+    u = jax.lax.dot_general(n, wu_ref[...], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    dy = jax.lax.dot_general(g, wd_ref[...], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dga = dy * u
+    dup = (dy * ga).astype(cast)
+    dgpre = (dga * _act_grad_f32(act, gpre)).astype(cast)
+    y = (ga.astype(cast) * u.astype(cast))
+    dwg_acc[...] += jax.lax.dot_general(dgpre, n, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+    dwu_acc[...] += jax.lax.dot_general(dup, n, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+    dwd_acc[...] += jax.lax.dot_general(g, y, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(i == nr - 1)
+    def _finalize():
+        dwg_ref[...] = dwg_acc[...].astype(dwg_ref.dtype)
+        dwu_ref[...] = dwu_acc[...].astype(dwu_ref.dtype)
+        dwd_ref[...] = dwd_acc[...].astype(dwd_ref.dtype)
+
+
+def pallas_mlp_subblock_bwd(g, residual, x, w_norm, w_gate, w_up, w_down,
+                            act: str = "silu", eps: float = 1e-5):
+    orig_shape = x.shape
+    D = x.shape[-1]
+    N = x.size // D
+    F = w_gate.shape[0]
+    g2 = g.reshape(N, D)
+    r2 = residual.reshape(N, D)
+    x2 = x.reshape(N, D)
+    bn, bf = _subblock_grid(N, D, F)
+    grid1 = (N // bn, F // bf)
+    row1 = pl.BlockSpec((bn, D), lambda i, f: (i, 0))
+    wrow1 = pl.BlockSpec((bf, D), lambda i, f: (f, 0))
+    dh, n2, dwn_parts = pl.pallas_call(
+        functools.partial(_mlp_subblock_bwd_dx_kernel, act=act, eps=eps,
+                          nf=grid1[1], cast=x.dtype),
+        grid=grid1,
+        in_specs=[row1, row1, row1,
+                  pl.BlockSpec((D,), lambda i, f: (0,)),
+                  wrow1, wrow1,
+                  pl.BlockSpec((D, bf), lambda i, f: (0, f))],
+        out_specs=[row1, row1, pl.BlockSpec((1, D), lambda i, f: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((N, D), x.dtype),
+                   jax.ShapeDtypeStruct((N, D), x.dtype),
+                   jax.ShapeDtypeStruct((N // bn, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bn, D), jnp.float32),
+                        pltpu.VMEM((bn, 1), jnp.float32),
+                        pltpu.VMEM((bn, D), jnp.float32)],
+        interpret=_interpret(),
+    )(g2, r2, x2, w_norm, w_gate, w_up, w_down)
+    dwn = jnp.sum(dwn_parts, axis=0).astype(w_norm.dtype)
+
+    grid2 = (F // bf, N // bn)
+    row2 = pl.BlockSpec((bn, D), lambda f, i: (i, 0))
+    wrow2 = pl.BlockSpec((bf, D), lambda f, i: (f, 0))
+    dwg, dwu, dwd = pl.pallas_call(
+        functools.partial(_mlp_subblock_bwd_dw_kernel, act=act, nr=grid2[1],
+                          cast=x.dtype),
+        grid=grid2,
+        in_specs=[row2, row2, wrow2, wrow2,
+                  pl.BlockSpec((D, bf), lambda f, i: (0, f))],
+        out_specs=[wrow2, wrow2, pl.BlockSpec((D, bf), lambda f, i: (0, f))],
+        out_shape=[jax.ShapeDtypeStruct((F, D), w_gate.dtype),
+                   jax.ShapeDtypeStruct((F, D), w_up.dtype),
+                   jax.ShapeDtypeStruct((D, F), w_down.dtype)],
+        scratch_shapes=[pltpu.VMEM((bf, D), jnp.float32),
+                        pltpu.VMEM((bf, D), jnp.float32),
+                        pltpu.VMEM((D, bf), jnp.float32)],
+        interpret=_interpret(),
+    )(g2, n2, w_gate, w_up, w_down)
+    return dh.reshape(orig_shape), dwn, dwg, dwu, dwd
+
+
+def _mlp_subblock_checker(residual, x, w_norm, w_gate, w_up, w_down,
+                          act: str = "silu", eps: float = 1e-5):
+    if not _enabled() or act not in _ACT_IMPLS:
+        return False
+    if w_norm is None or getattr(w_norm, "ndim", 0) != 1:
+        return False
+    if tuple(residual.shape) != tuple(x.shape) or residual.dtype != x.dtype:
+        return False
+    D = x.shape[-1]
+    if w_norm.shape[0] != D:
+        return False
+    # the kernel computes norm stats + GEMM accumulation in f32; f64 (x64
+    # mode) composites would silently narrow — reject, keep the decomposition
+    if not x.dtype.is_float or x.dtype.bytes > 4:
+        return False
+    if any(w.dtype != x.dtype for w in (w_norm, w_gate, w_up, w_down)):
+        return False
+    if w_gate.ndim != 2 or tuple(w_up.shape) != tuple(w_gate.shape):
+        return False
+    F = w_gate.shape[0]
+    if w_gate.shape[1] != D or tuple(w_down.shape) != (D, F):
+        return False
+    if _interpret():
+        return True
+    from thunder_tpu.core.cost_model import VMEM_BUDGET_BYTES, subblock_vmem_bytes
+
+    N = 1
+    for d in x.shape[:-1]:
+        N *= int(d)
+    return (D % 128 == 0 and F % 128 == 0 and N % 8 == 0
+            and subblock_vmem_bytes(int(D), int(F), x.dtype.bytes, N)
+            <= VMEM_BUDGET_BYTES)
+
+
+def _mlp_subblock_bwd_checker(g, residual, x, w_norm, w_gate, w_up, w_down,
+                              act: str = "silu", eps: float = 1e-5):
+    if tuple(g.shape) != tuple(x.shape) or g.dtype != x.dtype:
+        return False
+    return _mlp_subblock_checker(residual, x, w_norm, w_gate, w_up, w_down,
+                                 act, eps)
+
+
+# ---------------------------------------------------------------------------
 # fused multi-tensor AdamW (one kernel launch per dtype bucket: the
 # apex-multi_tensor_apply / torch-"foreach" analog, claimed from the
 # optim.fused_adamw composite built by core.fusion_passes.
@@ -962,8 +1263,11 @@ def _linear_act_checker(a, w, bias=None, act: str = "relu"):
 # 7-stream pointwise fusion per parameter.
 # ---------------------------------------------------------------------------
 
-_ADAMW_LANE = 128        # last-dim tile width (v5e lane count)
-_ADAMW_ROW_BLOCK = 512   # rows per grid step: (512, 128) f32 = 256 KiB/stream
+# slab geometry (lane width + row-block) is owned by ops/optim.py::
+# slab_geometry — ONE source of truth shared with the slab-persistent
+# optimizer state, so the kernel tiles can never drift from the persistent
+# layout (that identity is what the bit-identity tests pin)
+from thunder_tpu.ops.optim import SLAB_LANE as _ADAMW_LANE  # noqa: E402
 
 
 def _fused_adamw_kernel(g_ref, p_ref, m_ref, v_ref, bc1_ref, bc2_ref,
@@ -991,6 +1295,53 @@ def _fused_adamw_kernel(g_ref, p_ref, m_ref, v_ref, bc1_ref, bc2_ref,
     vn_ref[...] = v_new.astype(vn_ref.dtype)
 
 
+def _slab_pack(ts, sizes, rows_pad):
+    """Flatten+concat a tensor list into a zero-tail-padded (rows, 128) slab."""
+    total = sum(sizes)
+    n_pad = rows_pad * _ADAMW_LANE
+    flat = [jnp.ravel(t) for t in ts]
+    cat = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+    if n_pad != total:
+        cat = jnp.concatenate([cat, jnp.zeros((n_pad - total,), cat.dtype)])
+    return cat.reshape(rows_pad, _ADAMW_LANE)
+
+
+def _slab_unpack(slab, like, sizes):
+    flat = slab.reshape(-1)
+    outs, off = [], 0
+    for t, s in zip(like, sizes):
+        outs.append(flat[off:off + s].reshape(t.shape))
+        off += s
+    return tuple(outs)
+
+
+def _adamw_slab_call(g_slab, p_slab, m_slab, v_slab, bc1, bc2, *, bn,
+                     m_dtype, v_dtype, **hyper):
+    """The shared one-launch kernel call over (rows, 128) slabs — used by
+    both the pack-per-step ``optim.fused_adamw`` claim and the
+    slab-persistent ``optim.fused_adamw_slab`` claim, so the two paths run
+    the IDENTICAL kernel on identical layouts (that is what makes their
+    parameter updates bit-identical)."""
+    rows_pad = p_slab.shape[0]
+    row_spec = pl.BlockSpec((bn, _ADAMW_LANE), lambda i: (i, 0))
+    scalar_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    return pl.pallas_call(
+        functools.partial(_fused_adamw_kernel, **hyper),
+        grid=(rows_pad // bn,),
+        in_specs=[row_spec, row_spec, row_spec, row_spec, scalar_spec, scalar_spec],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE), p_slab.dtype),
+            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE), m_dtype),
+            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE), v_dtype),
+        ],
+        interpret=_interpret(),
+        **_grid_params("parallel"),
+    )(g_slab, p_slab, m_slab, v_slab,
+      jnp.asarray(bc1, jnp.float32).reshape(1, 1),
+      jnp.asarray(bc2, jnp.float32).reshape(1, 1))
+
+
 def pallas_fused_adamw(params, grads, ms, vs, bc1, bc2, *, lr: float = 1e-3,
                        beta1: float = 0.9, beta2: float = 0.999,
                        eps: float = 1e-8, weight_decay: float = 0.0,
@@ -998,52 +1349,40 @@ def pallas_fused_adamw(params, grads, ms, vs, bc1, bc2, *, lr: float = 1e-3,
     """One launch for the whole dtype bucket. Zero-padding the slab tail is
     benign: padded lanes compute 0/(sqrt(0)+eps) = 0 (no NaNs) and are
     sliced off on unpack."""
+    from thunder_tpu.ops.optim import slab_geometry
+
     sizes = [int(math.prod(p.shape)) for p in params]  # () -> prod=1
-    total = sum(sizes)
-    rows = max(-(-total // _ADAMW_LANE), 1)
-    bn = min(_ADAMW_ROW_BLOCK, -(-rows // 8) * 8)
-    rows_pad = -(-rows // bn) * bn
-    n_pad = rows_pad * _ADAMW_LANE
+    rows_pad, bn = slab_geometry(sum(sizes))
+    pn, mn, vn = _adamw_slab_call(
+        _slab_pack(grads, sizes, rows_pad), _slab_pack(params, sizes, rows_pad),
+        _slab_pack(ms, sizes, rows_pad), _slab_pack(vs, sizes, rows_pad),
+        bc1, bc2, bn=bn,
+        m_dtype=state_dtype.jax if state_dtype is not None else ms[0].dtype,
+        v_dtype=v_dtype.jax if v_dtype is not None else vs[0].dtype,
+        lr=lr, beta1=beta1, beta2=beta2, eps=eps, weight_decay=weight_decay)
+    return (_slab_unpack(pn, params, sizes), _slab_unpack(mn, ms, sizes),
+            _slab_unpack(vn, vs, sizes))
 
-    def pack(ts):
-        flat = [jnp.ravel(t) for t in ts]
-        cat = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
-        if n_pad != total:
-            cat = jnp.concatenate([cat, jnp.zeros((n_pad - total,), cat.dtype)])
-        return cat.reshape(rows_pad, _ADAMW_LANE)
 
-    row_spec = pl.BlockSpec((bn, _ADAMW_LANE), lambda i: (i, 0))
-    scalar_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
-    pn, mn, vn = pl.pallas_call(
-        functools.partial(_fused_adamw_kernel, lr=lr, beta1=beta1, beta2=beta2,
-                          eps=eps, weight_decay=weight_decay),
-        grid=(rows_pad // bn,),
-        in_specs=[row_spec, row_spec, row_spec, row_spec, scalar_spec, scalar_spec],
-        out_specs=[row_spec, row_spec, row_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE), params[0].dtype),
-            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE),
-                                 state_dtype.jax if state_dtype is not None
-                                 else ms[0].dtype),
-            jax.ShapeDtypeStruct((rows_pad, _ADAMW_LANE),
-                                 v_dtype.jax if v_dtype is not None
-                                 else vs[0].dtype),
-        ],
-        interpret=_interpret(),
-        **_grid_params("parallel"),
-    )(pack(grads), pack(params), pack(ms), pack(vs),
-      jnp.asarray(bc1, jnp.float32).reshape(1, 1),
-      jnp.asarray(bc2, jnp.float32).reshape(1, 1))
+def pallas_fused_adamw_slab(params, grads, m_slab, v_slab, bc1, bc2, *,
+                            sizes, lr: float = 1e-3, beta1: float = 0.9,
+                            beta2: float = 0.999, eps: float = 1e-8,
+                            weight_decay: float = 0.0):
+    """Slab-persistent claim: m/v arrive AS the persistent (rows, 128)
+    slabs and leave the same way — no pack/unpack of the state streams
+    exists on this path (the ``pack_bytes_if_unabsorbed`` risk is moot by
+    construction); only p/g are packed, and the p update unpacked, per
+    step."""
+    from thunder_tpu.ops.optim import slab_geometry
 
-    def unpack(slab, like):
-        flat = slab.reshape(-1)
-        outs, off = [], 0
-        for t, s in zip(like, sizes):
-            outs.append(flat[off:off + s].reshape(t.shape))
-            off += s
-        return tuple(outs)
-
-    return unpack(pn, params), unpack(mn, ms), unpack(vn, vs)
+    sizes = [int(s) for s in sizes]
+    rows_pad, bn = slab_geometry(sum(sizes))
+    pn, mn, vn = _adamw_slab_call(
+        _slab_pack(grads, sizes, rows_pad), _slab_pack(params, sizes, rows_pad),
+        m_slab, v_slab, bc1, bc2, bn=bn,
+        m_dtype=m_slab.dtype, v_dtype=v_slab.dtype,
+        lr=lr, beta1=beta1, beta2=beta2, eps=eps, weight_decay=weight_decay)
+    return _slab_unpack(pn, params, sizes), mn, vn
 
 
 def _fused_adamw_checker(params, grads, ms, vs, bc1, bc2, **hyper):
@@ -1066,6 +1405,28 @@ def _fused_adamw_checker(params, grads, ms, vs, bc1, bc2, **hyper):
         if dt is not None and (not dt.is_float or dt.bytes > 4):
             return False
     return True
+
+
+def _fused_adamw_slab_checker(params, grads, m_slab, v_slab, bc1, bc2, *,
+                              sizes, **hyper):
+    if not _enabled():
+        return False
+    from thunder_tpu.ops.optim import SLAB_LANE, slab_geometry
+
+    params, grads = tuple(params), tuple(grads)
+    sizes = tuple(int(s) for s in sizes)
+    if not params or len(grads) != len(params) or len(sizes) != len(params):
+        return False
+    for group in (params, grads):
+        d0 = group[0].dtype
+        if any(t.dtype != d0 for t in group) or not d0.is_float or d0.bytes > 4:
+            return False
+    for slab in (m_slab, v_slab):
+        if not slab.dtype.is_float or slab.dtype.bytes > 4 or slab.ndim != 2:
+            return False
+    rows_pad, _ = slab_geometry(sum(sizes))
+    return (tuple(m_slab.shape) == (rows_pad, SLAB_LANE)
+            and tuple(v_slab.shape) == (rows_pad, SLAB_LANE))
 
 
 def _pallas_claim_profitable(bsym):
@@ -1122,6 +1483,31 @@ if PALLAS_AVAILABLE:
     # bucket, so a second claim-time gate would just re-ask the same question
     ex.register_implementation("optim.fused_adamw", fused_adamw_op,
                                checker=_fused_adamw_checker)
+
+    # slab-persistent variant: emitted directly by AdamW(slab_persistent=True)
+    # with the bucket layout already decided (same reasoning: no second gate)
+    _fused_adamw_slab_sym = get_op("optim.fused_adamw_slab")
+    fused_adamw_slab_op = ex.register_operator(
+        "fused_adamw_slab", meta=_fused_adamw_slab_sym.meta,
+        fn=pallas_fused_adamw_slab)
+    ex.register_implementation("optim.fused_adamw_slab", fused_adamw_slab_op,
+                               checker=_fused_adamw_slab_checker)
+
+    # block-planner megakernels: the whole MLP sub-block forward, and its
+    # recompute-based backward pair (claimed from the composites the planner
+    # / the nn.mlp_subblock VJP rule emit; no `profitable` hook — the
+    # planner's cost model already decided)
+    _mlp_sub_sym = get_op("nn.mlp_subblock")
+    _mlp_sub_bwd_sym = get_op("nn.mlp_subblock_bwd")
+    mlp_subblock_op = ex.register_operator(
+        "mlp_subblock", meta=_mlp_sub_sym.meta, fn=pallas_mlp_subblock)
+    mlp_subblock_bwd_op = ex.register_operator(
+        "mlp_subblock_bwd", meta=_mlp_sub_bwd_sym.meta,
+        fn=pallas_mlp_subblock_bwd)
+    ex.register_implementation("nn.mlp_subblock", mlp_subblock_op,
+                               checker=_mlp_subblock_checker)
+    ex.register_implementation("nn.mlp_subblock_bwd", mlp_subblock_bwd_op,
+                               checker=_mlp_subblock_bwd_checker)
 
     _rms_res_sym = get_op("nn.rms_norm_residual")
     _linear_act_sym = get_op("nn.linear_act")

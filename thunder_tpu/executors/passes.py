@@ -151,14 +151,22 @@ def transform_for_execution(trc: TraceCtx, executors) -> TraceCtx:
     """Fusion-prep passes + claim pass + fusion passes + DCE (reference
     ``passes.py:136``, extended with the Fusion 2.0 rewrites)."""
     from thunder_tpu.core.fusion_passes import (
+        block_fusion_pass,
         epilogue_fusion_pass,
         horizontal_fusion_pass,
         optimizer_fusion_pass,
     )
 
     # run BEFORE claiming: horizontal merging works on unclaimed dot_generals,
-    # and the epilogue/optimizer rewrites build composites for the claim walk
-    # to offer
+    # and the block/epilogue/optimizer rewrites build composites for the
+    # claim walk to offer. The block planner goes FIRST — it wants whole
+    # sub-block chains, which horizontal merging (gate+up GEMMs share the
+    # normed activation) and epilogue fusion (add→rms_norm) would otherwise
+    # carve up. Training traces were already planned pre-autodiff (the chain
+    # is prim-level here and the anchor scan early-outs); this entry serves
+    # inference traces, whose composite-level chains survive to this pass.
+    with _observe.span("block_fusion"):
+        trc = block_fusion_pass(trc, executors)
     with _observe.span("horizontal_fusion"):
         trc = horizontal_fusion_pass(trc)
     with _observe.span("epilogue_fusion"):

@@ -74,6 +74,29 @@ def explain(jfn) -> str:
     decisions = stats.last_decisions
     fusion_dec = [d for d in decisions if d["kind"] == "fusion"]
     claim_dec = [d for d in decisions if d["kind"] == "claim"]
+    block_dec = [d for d in decisions if d["kind"] == "block"]
+
+    # block planner first: one line per candidate sub-block chain with its
+    # verdict and the two numbers the objective compares (saved boundary
+    # bytes vs the fused path's overheads)
+    lines.append("")
+    lines.append(f"== block planner ({len(block_dec)} candidate chains) ==")
+    for d in block_dec:
+        cost = d.get("cost") or {}
+        chain = cost.get("chain", "?")
+        detail = []
+        if "saved_boundary_bytes" in cost:
+            detail.append(f"saved_boundary_bytes={cost['saved_boundary_bytes']}")
+        if "est_saved_us" in cost:
+            detail.append(f"est_saved_us={cost['est_saved_us']}")
+        if "vmem_bytes_per_step" in cost:
+            detail.append(f"vmem_bytes_per_step={cost['vmem_bytes_per_step']}")
+        suffix = f" ({', '.join(detail)})" if detail else ""
+        lines.append(f"  chain@{chain} -> {d['decision']}: {d.get('reason', '')}"
+                     f"{suffix}")
+    if not block_dec:
+        lines.append("  (none — no sub-block chains found in this trace)")
+
     lines.append("")
     lines.append(f"== fusion decisions ({len(fusion_dec)}) ==")
     for d in fusion_dec:

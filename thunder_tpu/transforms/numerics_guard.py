@@ -27,7 +27,8 @@ their updated values in the step's returned tuple — the default
 Each arg subtree must mirror its output subtree leaf-for-leaf.
 
 Gradients are auto-detected from the optimizer composites
-(``optim.adamw_step`` / ``optim.fused_adamw``); steps without them (inline
+(``optim.adamw_step`` / ``optim.fused_adamw`` /
+``optim.fused_adamw_slab``); steps without them (inline
 SGD, custom updates) can mark grads explicitly with
 :func:`observe_grads`. With no grads found the guard still protects via
 the loss and new-state counts (grad norm reports 0).
@@ -184,7 +185,10 @@ class NumericsGuardTransform(Transform):
                 sid = str(b.sym.id)
                 if sid == "optim.adamw_step":
                     _take(b.args[1], b.args[0])
-                elif sid == "optim.fused_adamw":
+                elif sid in ("optim.fused_adamw", "optim.fused_adamw_slab"):
+                    # both multi-tensor forms carry (params, grads, ...) as
+                    # their first two args — the slab variant differs only in
+                    # how the MOMENTS are stored, not where the grads are
                     for p_ref, g in zip(b.args[0], b.args[1]):
                         _take(g, p_ref)
 
