@@ -42,6 +42,13 @@ import os
 import sys
 import time
 
+# The shared bench JSON-line contract version, stamped by every bench in the
+# repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
+# them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 3: adds block_fusions (Fusion 3.0) + slab_persistent; 2 introduced
+# registry-sourced fusion counters; 1 grepped trace source for markers.
+METRICS_SCHEMA = 3
+
 
 def main():
     import jax
@@ -363,11 +370,7 @@ def main():
           file=sys.stderr)
 
     print(json.dumps({
-        # metrics_schema 3: adds block_fusions (Fusion 3.0 sub-block
-        # megakernel planner) and slab_persistent (optimizer state layout);
-        # schema 2 introduced registry-sourced fusion counters (schema 1
-        # grepped trace source for markers)
-        "metrics_schema": 3,
+        "metrics_schema": METRICS_SCHEMA,
         "metric": f"{model.replace('-bench', '')}-geometry({n_layers}L,b{batch}"
                   + (",fp8" if use_fp8 else "") + (",remat" if use_remat else "")
                   + ") train tokens/sec/chip",

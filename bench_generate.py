@@ -224,23 +224,34 @@ def main():
         print(f"fused-loop decode skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # metrics_schema matches bench.py's current version: every bench in this
+    # repo emits JSON lines of {metrics_schema, metric, value, unit,
+    # vs_baseline, ...extras} so CI parses all of them with one reader
+    # (previously these lines were unversioned). --smoke emits the same
+    # schema — only the geometry in the metric name differs.
+    from bench import METRICS_SCHEMA
+
     print(json.dumps({
+        "metrics_schema": METRICS_SCHEMA,
         "metric": f"{model.replace('-bench','')}-geometry({n_layers}L,b{batch}) "
                   f"prefill latency Tp={t_prompt}",
         "value": round(pre_ours * 1e3, 2), "unit": "ms",
         "vs_baseline": round(pre_ref / pre_ours, 4)}))
     print(json.dumps({
+        "metrics_schema": METRICS_SCHEMA,
         "metric": f"{model.replace('-bench','')}-geometry({n_layers}L,b{batch}) "
                   f"decode tokens/s",
         "value": round(batch / dec_ours, 1), "unit": "tokens/s",
         "vs_baseline": round(dec_ref / dec_ours, 4)}))
     print(json.dumps({
+        "metrics_schema": METRICS_SCHEMA,
         "metric": f"{model.replace('-bench','')}-geometry({n_layers}L,b{batch}) "
                   f"decode tokens/s (bound fast path)",
         "value": round(batch / dec_bound, 1), "unit": "tokens/s",
         "vs_baseline": round(dec_ref / dec_bound, 4)}))
     if dec_fused is not None:
         print(json.dumps({
+            "metrics_schema": METRICS_SCHEMA,
             "metric": f"{model.replace('-bench','')}-geometry({n_layers}L,b{batch}) "
                       f"decode tokens/s (fused loop)",
             "value": round(batch / dec_fused, 1), "unit": "tokens/s",

@@ -190,3 +190,46 @@ class TestGeneratePrefillBuckets:
         _, pfn = llama._get_step_fns(cfg, None)
         assert tt.cache_misses(pfn) <= 2  # 8 distinct lengths, 2 buckets
         llama._step_fns.clear()
+
+
+class TestLengthBucketerEdgeCases:
+    """Direct unit contract of the bucketer the serving scheduler's chunk
+    ladder and the jit seq_buckets guard both build on."""
+
+    def test_exact_boundary_lengths_map_to_themselves(self):
+        from thunder_tpu.data import LengthBucketer
+
+        b = LengthBucketer([128, 512, 2048])
+        for edge in (128, 512, 2048):
+            assert b.bucket_for(edge) == edge
+        # one past an edge rolls to the NEXT bucket
+        assert b.bucket_for(129) == 512
+        assert b.bucket_for(513) == 2048
+
+    def test_above_largest_bucket_error_contract(self):
+        from thunder_tpu.data import LengthBucketer
+
+        b = LengthBucketer([16, 64])
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            b.bucket_for(65)
+        # pad_batch applies the same contract through its max-length path
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            b.pad_batch([np.arange(65)])
+
+    def test_single_bucket_degenerate_ladder(self):
+        from thunder_tpu.data import LengthBucketer
+
+        b = LengthBucketer([32])
+        assert b.buckets == [32]
+        assert b.bucket_for(1) == 32 and b.bucket_for(32) == 32
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            b.bucket_for(33)
+        tokens, mask = b.pad_batch([np.arange(5), np.arange(32)], pad_id=0)
+        assert tokens.shape == (2, 32) and mask[0].sum() == 5 and mask[1].all()
+
+    def test_empty_ladder_rejected_and_unsorted_normalized(self):
+        from thunder_tpu.data import LengthBucketer
+
+        with pytest.raises(ValueError, match="at least one bucket"):
+            LengthBucketer([])
+        assert LengthBucketer([512, 128, 2048]).buckets == [128, 512, 2048]

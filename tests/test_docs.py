@@ -56,6 +56,35 @@ def test_runtime_metric_names_documented():
         f"metrics table (docs/zero_to_thunder_tpu.md): {missing}")
 
 
+def test_serving_metric_names_documented():
+    """Every ``serving.*`` metric name the code emits must appear in the
+    docs' serving metrics table — same contract pattern as the runtime
+    metrics table above: the names are what dashboards and SLO alerts key
+    on, so a new serving metric can't ship undocumented."""
+    import glob
+
+    import thunder_tpu
+
+    pkg_root = os.path.dirname(thunder_tpu.__file__)
+    sources = glob.glob(os.path.join(pkg_root, "**", "*.py"), recursive=True)
+    names: set = set()
+    for path in sources:
+        with open(path) as f:
+            names |= set(re.findall(r"[\"'](serving\.[a-z0-9_]+)[\"']", f.read()))
+    # the scheduler's core metric families must all be present (a refactor
+    # that stops emitting them should fail loudly here)
+    for required in ("serving.queue_depth", "serving.active_requests",
+                     "serving.kv_pages_free", "serving.ttft_ms",
+                     "serving.decode_ms", "serving.preempted_requests"):
+        assert required in names, f"code no longer emits {required}"
+    with open(DOC) as f:
+        doc = f.read()
+    missing = [n for n in sorted(names) if f"`{n}`" not in doc]
+    assert not missing, (
+        "serving metrics emitted by the code but missing from the docs "
+        f"serving metrics table (docs/zero_to_thunder_tpu.md): {missing}")
+
+
 def test_block_planner_decision_kinds_documented():
     """Every verdict kind the block planner can emit must appear in the
     KERNELS.md "Reading planner decisions" table — the decision log is an

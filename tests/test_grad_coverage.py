@@ -85,6 +85,9 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
     "nn.linear_act": "built POST-autodiff by the epilogue fusion pass — autodiff "
                      "never sees it; linear and the activations carry the grad story",
     "nn.sdpa_fwd": "internal fwd half of SDPA; nn.scaled_dot_product_attention has a rule",
+    "nn.paged_decode_attention": "inference-only serving decode path "
+                                 "(thunder_tpu/serving/) — training traces use "
+                                 "nn.scaled_dot_product_attention, which has a rule",
     "nn.sdpa_bwd": "backward half; differentiating it is second-order autodiff",
     "ops.fmod": "prim classified non-differentiable (matches reference: grads stop)",
     "ops.remainder": "prim classified non-differentiable (matches reference)",

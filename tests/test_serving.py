@@ -1,0 +1,409 @@
+"""Serving engine tests: paged KV allocator, ragged paged decode attention
+parity (kernel + decomposition vs the dense full-cache path), continuous
+batching correctness vs ``generate()``, preemption, and chaos (step-domain
+fault injection, quarantine fallback)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import observe
+from thunder_tpu.models import llama
+from thunder_tpu.ops import nn as tnn
+from thunder_tpu.runtime import faults, quarantine
+from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+from thunder_tpu.serving import (
+    OutOfPages,
+    PagedKVCache,
+    PageGeometry,
+    ServingEngine,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_quarantine():
+    quarantine.reset()
+    yield
+    quarantine.reset()
+    faults.clear()
+
+
+def _geometry(**kw):
+    defaults = dict(n_layers=1, kv_heads=2, head_dim=16, page_size=8,
+                    num_pages=12, pages_per_request=4)
+    defaults.update(kw)
+    return PageGeometry(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+class TestPagedKVCache:
+    def test_alloc_free_reuse(self):
+        import jax.numpy as jnp
+
+        cache = PagedKVCache(_geometry(), jnp.float32)
+        assert cache.pages_total == 11          # page 0 reserved
+        a = cache.alloc(3)
+        assert len(a) == 3 and 0 not in a
+        assert cache.pages_free == 8
+        cache.free(a)
+        assert cache.pages_free == 11
+        b = cache.alloc(11)                     # whole pool allocatable
+        assert sorted(b) == list(range(1, 12))
+        cache.free(b)
+
+    def test_out_of_pages_and_peak(self):
+        import jax.numpy as jnp
+
+        cache = PagedKVCache(_geometry(), jnp.float32)
+        a = cache.alloc(10)
+        with pytest.raises(OutOfPages):
+            cache.alloc(2)
+        assert cache.peak_pages_used == 10
+        cache.free(a)
+        assert cache.peak_pages_used == 10      # high-water sticks
+        cache.reset_peak()
+        assert cache.peak_pages_used == 0
+
+    def test_double_free_and_bad_page_rejected(self):
+        import jax.numpy as jnp
+
+        cache = PagedKVCache(_geometry(), jnp.float32)
+        a = cache.alloc(2)
+        cache.free(a)
+        with pytest.raises(ValueError, match="double free"):
+            cache.free([a[0]])
+        with pytest.raises(ValueError, match="invalid page"):
+            cache.free([0])                     # the reserved scratch page
+
+    def test_pool_shapes(self):
+        import jax.numpy as jnp
+
+        g = _geometry(n_layers=3)
+        cache = PagedKVCache(g, jnp.bfloat16)
+        assert len(cache.pools) == 3
+        assert cache.pools[0]["k"].shape == (2, 12, 8, 16)
+        assert cache.pools[0]["v"].dtype == jnp.bfloat16
+        assert g.pages_for(1) == 1 and g.pages_for(8) == 1
+        assert g.pages_for(9) == 2 and g.max_context == 32
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention parity vs the dense full-cache path
+# ---------------------------------------------------------------------------
+
+def _dense_reference(q, k_pages, v_pages, bt, lengths):
+    """Dense full-cache masked attention over the gathered context —
+    numerically the ``forward_step`` attention path the engine replaces."""
+    B, H, T, hd = q.shape
+    KV, P, ps, _ = k_pages.shape
+    n_rep = H // KV
+    L = bt.shape[1] * ps
+    out = np.zeros((B, H, T, hd), np.float32)
+    for b in range(B):
+        kctx = k_pages[:, bt[b]].reshape(KV, L, hd).astype(np.float32)
+        vctx = v_pages[:, bt[b]].reshape(KV, L, hd).astype(np.float32)
+        for h in range(H):
+            s = (q[b, h].astype(np.float32) @ kctx[h // n_rep].T
+                 / math.sqrt(hd))
+            for r in range(T):
+                s[r, int(lengths[b]) - T + r + 1:] = -np.inf
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[b, h] = p @ vctx[h // n_rep]
+    return out
+
+
+def _paged_inputs(dtype, seed=0, B=3, H=4, KV=2, hd=16, ps=8, P=12, npg=4):
+    rng = np.random.RandomState(seed)
+    q = (rng.rand(B, H, 1, hd) - 0.5).astype(dtype)
+    kp = (rng.rand(KV, P, ps, hd) - 0.5).astype(dtype)
+    vp = (rng.rand(KV, P, ps, hd) - 0.5).astype(dtype)
+    bt = np.stack([rng.permutation(np.arange(1, P))[:npg]
+                   for _ in range(B)]).astype(np.int32)
+    lengths = np.asarray([1, 13, npg * ps], np.int32)  # ragged incl. len-1
+    return q, kp, vp, bt, lengths
+
+
+def _paged_fn(q, k, v, bt, ln):
+    return tnn.paged_decode_attention(q, k, v, bt, ln)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_paged_decode_decomposition_matches_dense(dtype):
+    import jax.numpy as jnp
+
+    np_dtype = np.float32 if dtype == "float32" else np.dtype(jnp.bfloat16)
+    q, kp, vp, bt, ln = _paged_inputs(np_dtype)
+    out = np.asarray(tt.jit(_paged_fn)(q, kp, vp, bt, ln))
+    ref = _dense_reference(np.asarray(q, np.float32),
+                           np.asarray(kp, np.float32),
+                           np.asarray(vp, np.float32), bt, ln)
+    tol = 2e-2 if dtype == "bfloat16" else 1e-5
+    np.testing.assert_allclose(out.astype(np.float32), ref, atol=tol, rtol=tol)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_paged_decode_kernel_matches_dense(dtype, monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    np_dtype = np.float32 if dtype == "float32" else np.dtype(jnp.bfloat16)
+    q, kp, vp, bt, ln = _paged_inputs(np_dtype, seed=1)
+    jf = tt.jit(_paged_fn)
+    out = np.asarray(jf(q, kp, vp, bt, ln))
+    # the Pallas scalar-prefetch kernel claimed the composite
+    names = _symbol_names(tt.last_execution_trace(jf))
+    assert "pallas_paged_decode_attention" in names
+    ref = _dense_reference(np.asarray(q, np.float32),
+                           np.asarray(kp, np.float32),
+                           np.asarray(vp, np.float32), bt, ln)
+    tol = 2e-2 if dtype == "bfloat16" else 1e-5
+    np.testing.assert_allclose(out.astype(np.float32), ref, atol=tol, rtol=tol)
+
+
+def test_paged_prefill_rows_masked_per_row(monkeypatch):
+    """T > 1 (chunked prefill): per-row ragged causal masking, and the
+    kernel checker must NOT claim (decode-only kernel)."""
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.RandomState(2)
+    B, H, KV, hd, ps, P, npg, T = 1, 4, 2, 16, 8, 12, 4, 8
+    q = (rng.rand(B, H, T, hd) - 0.5).astype(np.float32)
+    kp = (rng.rand(KV, P, ps, hd) - 0.5).astype(np.float32)
+    vp = (rng.rand(KV, P, ps, hd) - 0.5).astype(np.float32)
+    bt = np.asarray([[1, 2, 3, 4]], np.int32)
+    ln = np.asarray([19], np.int32)             # rows at positions 11..18
+    jf = tt.jit(_paged_fn)
+    out = np.asarray(jf(q, kp, vp, bt, ln))
+    assert "pallas_paged_decode_attention" not in \
+        _symbol_names(tt.last_execution_trace(jf))
+    ref = _dense_reference(q, kp, vp, bt, ln)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def _symbol_names(trc):
+    names = set()
+
+    def walk(bsyms):
+        for b in bsyms:
+            names.add(b.sym.codegen_name())
+            walk(b.subsymbols)
+
+    walk(trc.bound_symbols)
+    return names
+
+
+@pytest.mark.chaos
+def test_paged_decode_quarantine_falls_back_per_op(monkeypatch):
+    """A dying paged-decode kernel quarantines and recompiles to the XLA
+    decomposition with equal numerics (the PR7 containment contract)."""
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    q, kp, vp, bt, ln = _paged_inputs(np.float32, seed=3)
+    ref = np.asarray(tt.jit(_paged_fn, executors=["xla"])(q, kp, vp, bt, ln))
+    jf = tt.jit(_paged_fn)
+    with faults.active(FaultPlan(
+            [FaultSpec("kernel:pallas.paged_decode_attention")])):
+        out = jf(q, kp, vp, bt, ln)             # dies -> quarantine -> XLA
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6, rtol=1e-6)
+    assert quarantine.is_quarantined("pallas.paged_decode_attention")
+    assert "pallas_paged_decode_attention" not in \
+        _symbol_names(tt.last_execution_trace(jf))
+    np.testing.assert_allclose(np.asarray(jf(q, kp, vp, bt, ln)), ref,
+                               atol=1e-6, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine: continuous batching correctness
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(params, cfg, **kw):
+    defaults = dict(max_slots=3, page_size=16, max_context=64, n_layers=1,
+                    prefill_chunk=32)
+    defaults.update(kw)
+    return ServingEngine(params, cfg, **defaults)
+
+
+class TestServingEngine:
+    @pytest.fixture(scope="class")
+    def model(self):
+        cfg = llama.CONFIGS["tiny-gqa"]
+        return cfg, llama.init_params(cfg, seed=0, scale_layers=1)
+
+    def _references(self, params, cfg, prompts, max_new):
+        return [np.asarray(llama.generate(params, cfg, p[None], max_new,
+                                          n_layers=1))[0]
+                for p in prompts]
+
+    def test_engine_matches_generate_mixed_lengths(self, model):
+        """5 mixed-length requests (incl. a 1-token prompt and a chunked
+        33-token prompt) through 3 slots: continuous batching, chunked
+        prefill, and page growth produce generate()'s exact greedy tokens."""
+        cfg, params = model
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, cfg.vocab_size, size=L).astype(np.int32)
+                   for L in (1, 7, 16, 33, 24)]
+        refs = self._references(params, cfg, prompts, 6)
+        eng = _tiny_engine(params, cfg)
+        reqs = [eng.submit(p, 6) for p in prompts]
+        eng.drain()
+        for r, ref in zip(reqs, refs):
+            assert r.done
+            np.testing.assert_array_equal(r.output(), ref)
+        # completion returned every page to the free list
+        assert eng.cache.pages_free == eng.cache.pages_total
+        assert eng.cache.peak_pages_used > 0
+
+    def test_preemption_recomputes_and_frees_pages(self, model):
+        """With a pool too small for full residency, requests get preempted
+        (pages freed immediately) and still finish with exact outputs."""
+        cfg, params = model
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, cfg.vocab_size, size=L).astype(np.int32)
+                   for L in (30, 28, 20)]
+        refs = self._references(params, cfg, prompts, 8)
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg, max_slots=3, page_size=8,
+                               num_pages=10, prefill_chunk=16)
+            reqs = [eng.submit(p, 8) for p in prompts]
+            eng.drain()
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        assert snap["counters"].get("serving.preempted_requests", 0) >= 1
+        assert any(r.preemptions for r in reqs)
+        for r, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(r.output(), ref)
+        assert eng.cache.pages_free == eng.cache.pages_total
+
+    def test_eos_stops_early_and_frees_slot(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(2)
+        p = rng.randint(1, cfg.vocab_size, size=5).astype(np.int32)
+        ref = self._references(params, cfg, [p], 8)[0]
+        # "eos" = the first token value whose FIRST occurrence is not at
+        # position 0 (so the request must decode past the first step)
+        j = next(i for i in range(1, len(ref))
+                 if int(ref[i]) not in [int(t) for t in ref[:i]])
+        eng = _tiny_engine(params, cfg)
+        req = eng.submit(p, 8, eos_id=int(ref[j]))
+        eng.drain()
+        assert req.done and len(req.generated) == j + 1
+        np.testing.assert_array_equal(req.output(), ref[:j + 1])
+        assert eng.cache.pages_free == eng.cache.pages_total
+
+    def test_submit_capacity_contract(self, model):
+        cfg, params = model
+        eng = _tiny_engine(params, cfg)
+        with pytest.raises(ValueError, match="context window"):
+            eng.submit(np.ones(60, np.int32), 10)
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.submit(np.ones(0, np.int32), 1)
+        small = _tiny_engine(params, cfg, num_pages=3)
+        with pytest.raises(ValueError, match="KV pages"):
+            small.submit(np.ones(40, np.int32), 20)
+
+    def test_serving_metrics_emitted(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg)
+            eng.submit(rng.randint(1, cfg.vocab_size, size=9).astype(np.int32), 3)
+            eng.drain()
+            snap = observe.snapshot()
+            rep = observe.explain(eng.runner.decode_jit)
+        finally:
+            observe.disable()
+        for g in ("serving.queue_depth", "serving.active_requests",
+                  "serving.kv_pages_free"):
+            assert g in snap["gauges"], g
+        for h in ("serving.ttft_ms", "serving.decode_ms", "serving.prefill_ms"):
+            assert snap["histograms"][h]["count"] >= 1, h
+        assert "== serving ==" in rep and "serving.kv_pages_free" in rep
+
+    @pytest.mark.chaos
+    def test_request_survives_retried_step(self, model):
+        """`step`-domain fault injection: the decode dispatch retries and
+        the request completes with the SAME tokens as a fault-free run."""
+        cfg, params = model
+        rng = np.random.RandomState(4)
+        p = rng.randint(1, cfg.vocab_size, size=9).astype(np.int32)
+        ref = self._references(params, cfg, [p], 5)[0]
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg)
+            req = eng.submit(p, 5)
+            with faults.active(FaultPlan(
+                    [FaultSpec("step", every_n=2, max_fires=2)])):
+                eng.drain()
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        assert snap["counters"].get("runtime.retries", 0) >= 2
+        assert req.done
+        np.testing.assert_array_equal(req.output(), ref)
+
+    @pytest.mark.chaos
+    def test_kernel_quarantine_rebinds_once(self, model, monkeypatch):
+        """A dying paged-decode kernel inside the BOUND decode step
+        quarantines, and the scheduler re-binds on the epoch bump — the
+        engine falls back to the XLA decomposition ONCE instead of
+        re-entering containment (cache clear + recompile) every step."""
+        monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+        cfg, params = model
+        rng = np.random.RandomState(6)
+        p = rng.randint(1, cfg.vocab_size, size=9).astype(np.int32)
+        ref = self._references(params, cfg, [p], 6)[0]
+        eng = _tiny_engine(params, cfg)
+        req = eng.submit(p, 6)
+        with faults.active(FaultPlan(
+                [FaultSpec("kernel:pallas.paged_decode_attention")])):
+            eng.drain()
+        assert req.done
+        np.testing.assert_array_equal(req.output(), ref)
+        assert quarantine.is_quarantined("pallas.paged_decode_attention")
+        # bounded compiles: claimed entry + containment recompile + one
+        # re-bind of the fallback — NOT one recompile per decoded token
+        assert tt.compile_stats(eng.runner.decode_jit).cache_misses <= 3
+
+    @pytest.mark.chaos
+    def test_eviction_returns_pages_under_faults(self, model):
+        """Preemption (eviction) under an active step-fault plan still
+        returns every page to the free list (the chaos-marked half of the
+        scheduler fault contract)."""
+        cfg, params = model
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(1, cfg.vocab_size, size=L).astype(np.int32)
+                   for L in (30, 28, 20)]
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg, max_slots=3, page_size=8,
+                               num_pages=10, prefill_chunk=16)
+            reqs = [eng.submit(p, 8) for p in prompts]
+            with faults.active(FaultPlan(
+                    [FaultSpec("step", every_n=5, max_fires=2)])):
+                eng.drain()
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        assert all(r.done for r in reqs)
+        assert eng.cache.pages_free == eng.cache.pages_total
+        assert snap["counters"].get("serving.preempted_requests", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# bind() + seq_buckets error names the serving path
+# ---------------------------------------------------------------------------
+
+def test_bind_seq_buckets_error_names_serving_engine():
+    from thunder_tpu import ops
+
+    jfn = tt.jit(lambda a: ops.sum(a, None), seq_buckets=(8, 16))
+    with pytest.raises(RuntimeError,
+                       match=r"serving\.ServingEngine"):
+        jfn.bind(np.ones((2, 5), np.float32))

@@ -534,7 +534,10 @@ class ThunderTPUFunction:
         re-``bind`` (the stale bound entry would re-contain every call)."""
         check(self.seq_buckets is None,
               "bind() does not compose with seq_buckets: the bound callable "
-              "skips the guard path that pads inputs to the bucket — call "
+              "skips the guard path that pads inputs to the bucket. For "
+              "ragged-length serving use thunder_tpu.serving.ServingEngine "
+              "— its scheduler owns the bucketing (LengthBucketer prefill "
+              "chunks) and binds a fixed-shape decode step. Otherwise call "
               "the jitted function directly, or bind a fn without buckets")
         entry, _ = self._entry_for(args, kwargs)
         tensor_indices = entry.tensor_indices
@@ -1019,3 +1022,4 @@ from thunder_tpu.executors import (  # noqa: E402,F401
     get_default_executors,
     get_executor,
 )
+from thunder_tpu import serving  # noqa: E402,F401  (thunder_tpu.serving.*)

@@ -1254,6 +1254,142 @@ def _mlp_subblock_bwd_checker(g, residual, x, w_norm, w_gate, w_up, w_down,
 
 
 # ---------------------------------------------------------------------------
+# paged decode attention (serving engine): one launch computes ragged-batch
+# decode attention over the block-allocated paged KV cache. The grid is
+# (request, kv_head, page); the block table and per-request context lengths
+# ride as SCALAR-PREFETCH operands, so each grid step's K/V page is selected
+# by block-table lookup in the BlockSpec index map — the kernel never sees a
+# gathered contiguous cache (that materialization is exactly what the XLA
+# decomposition of nn.paged_decode_attention pays per step). Pages past a
+# request's length skip their compute via pl.when; masking inside the last
+# partial page is ragged per-request (col < length). Claims the T == 1
+# decode case only — prefill chunks (T > 1 rows over the paged context)
+# take the decomposition, whose gather XLA fuses into the surrounding
+# region once per chunk rather than per token.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(bt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, scale: float, ps: int):
+    """Online-softmax accumulation over one request's pages (innermost grid
+    dim sequential). q block: (G, hd) where G = n_heads // kv_heads grouped
+    rows of the single decode position; k/v block: one (ps, hd) page picked
+    by the index map from the scalar-prefetched block table."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ln = ln_ref[b]
+
+    @pl.when(p * ps < ln)
+    def _compute():
+        q = q_ref[0, 0]                                # (G, hd) input dtype
+        k = k_ref[0, 0]                                # (ps, hd)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        col = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < ln, s, -jnp.inf)           # ragged tail mask
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(p == npg - 1)
+    def _finalize():
+        l = l_ref[...]
+        lsafe = jnp.where(l == 0.0, 1.0, l)            # unreachable rows
+        o_ref[0, 0] = (acc_ref[...] / lsafe).astype(o_ref.dtype)
+
+
+def pallas_paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
+                                  scale=None):
+    B, H, T, hd = q.shape
+    if T != 1:
+        # the kernel's single ragged mask (col < length) is only the causal
+        # mask when every grouped row sits at the SAME position — direct
+        # callers must not rely on the claim-time checker to reject T > 1
+        raise ValueError(
+            f"pallas_paged_decode_attention is decode-only (T == 1); got "
+            f"T={T} — prefill chunks take the nn.paged_decode_attention "
+            f"decomposition, which masks per row")
+    KV, P, ps, _ = k_pages.shape
+    npg = block_tables.shape[1]
+    G = (H // KV) * T                                  # grouped decode rows
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(hd)
+    q4 = q.reshape(B, KV, G, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                         # block_tables, lengths
+        grid=(B, KV, npg),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, h, p, bt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, ps, hd),
+                         lambda b, h, p, bt, ln: (h, bt[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, ps, hd),
+                         lambda b, h, p, bt, ln: (h, bt[b, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd),
+                               lambda b, h, p, bt, ln: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((G, hd), jnp.float32),
+                        pltpu.VMEM((G, 1), jnp.float32),
+                        pltpu.VMEM((G, 1), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale_v, ps=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_pages, v_pages)
+    return out.reshape(B, H, T, hd)
+
+
+def _paged_decode_checker(q, k_pages, v_pages, block_tables, lengths,
+                          scale=None):
+    if not _enabled():
+        return False
+    if q.ndim != 4 or k_pages.ndim != 4 or v_pages.ndim != 4:
+        return False
+    B, H, T, hd = q.shape
+    KV, P, ps, hd2 = k_pages.shape
+    if T != 1:
+        return False  # ragged DECODE kernel; prefill chunks decompose
+    if hd2 != hd or tuple(v_pages.shape) != tuple(k_pages.shape):
+        return False
+    if H % KV != 0:
+        return False
+    # f32 accumulation: reject f64 (x64 mode) rather than silently narrow;
+    # store dtype must match q (the kernel emits q.dtype)
+    if q.dtype != k_pages.dtype or v_pages.dtype != k_pages.dtype:
+        return False
+    if not q.dtype.is_float or q.dtype.bytes > 4:
+        return False
+    if (block_tables.ndim != 2 or block_tables.shape[0] != B
+            or lengths.ndim != 1 or lengths.shape[0] != B):
+        return False
+    if not block_tables.dtype.is_int or not lengths.dtype.is_int:
+        return False
+    if _interpret():
+        return True
+    # real-TPU tiling: lane-aligned head dim, sublane-aligned page rows.
+    # The on-chip interleaved A/B vs the gathered-decomposition fallback is
+    # specified in the serving section of KERNELS.md (PERF_R6-style, next
+    # tunnel session); the claim stays cost-model gated either way.
+    return hd % 128 == 0 and ps % 8 == 0
+
+
+# ---------------------------------------------------------------------------
 # fused multi-tensor AdamW (one kernel launch per dtype bucket: the
 # apex-multi_tensor_apply / torch-"foreach" analog, claimed from the
 # optim.fused_adamw composite built by core.fusion_passes.
@@ -1520,6 +1656,18 @@ if PALLAS_AVAILABLE:
                                profitable=_pallas_claim_profitable)
     ex.register_implementation("nn.linear_act", linear_act_op,
                                checker=_linear_act_checker,
+                               profitable=_pallas_claim_profitable)
+
+    # serving: ragged paged decode attention (claimed from the composite the
+    # serving runner emits; prefill chunks fail the T==1 checker and take
+    # the XLA decomposition). Cost-model gated like the other memory-bound
+    # claims — a tiny pool gather can stay inside the XLA region.
+    _paged_sym = get_op("nn.paged_decode_attention")
+    paged_decode_op = ex.register_operator(
+        "paged_decode_attention", meta=_paged_sym.meta,
+        fn=pallas_paged_decode_attention)
+    ex.register_implementation("nn.paged_decode_attention", paged_decode_op,
+                               checker=_paged_decode_checker,
                                profitable=_pallas_claim_profitable)
 
     # inference-path SDPA (no lse output needed)

@@ -105,19 +105,30 @@ def init_params(cfg: LlamaConfig, seed: int = 0, scale_layers: int | None = None
     return params
 
 
-def _rope_cos_sin(cfg: LlamaConfig, T: int, dtype, pos_offset=None):
-    """cos/sin tables built from iota (fully fusible, no host constants).
-    ``pos_offset`` shifts positions (context parallelism: local chunk start)."""
+def _rope_tables(cfg: LlamaConfig, pos, dtype):
+    """cos/sin for an arbitrary POSITION TENSOR: ``pos`` (any shape, any
+    numeric dtype) -> tables of shape ``pos.shape + (hd/2,)``. The ONE
+    owner of the rope frequency math — `_rope_cos_sin` (contiguous ranges)
+    and the serving runner's per-request decode positions both build on it,
+    so a future rope change (scaling, theta handling) cannot diverge
+    between training, prefill, and paged decode."""
     hd = cfg.head_dim
-    pos = ops.convert_element_type(ops.arange(T), dtypes.float32)  # (T,)
-    if pos_offset is not None:
-        pos = ops.add(pos, ops.convert_element_type(pos_offset, dtypes.float32))
+    posf = ops.convert_element_type(pos, dtypes.float32)
     idx = ops.convert_element_type(ops.arange(hd // 2), dtypes.float32)  # (hd/2,)
     inv_freq = ops.pow(cfg.rope_theta, ops.true_divide(ops.mul(idx, -2.0), float(hd)))
-    angles = ops.mul(ops.unsqueeze(pos, 1), ops.unsqueeze(inv_freq, 0))  # (T, hd/2)
+    angles = ops.mul(ops.unsqueeze(posf, -1), inv_freq)  # pos.shape + (hd/2,)
     cos = ops.convert_element_type(ops.cos(angles), dtype)
     sin = ops.convert_element_type(ops.sin(angles), dtype)
     return cos, sin
+
+
+def _rope_cos_sin(cfg: LlamaConfig, T: int, dtype, pos_offset=None):
+    """cos/sin tables built from iota (fully fusible, no host constants).
+    ``pos_offset`` shifts positions (context parallelism: local chunk start)."""
+    pos = ops.convert_element_type(ops.arange(T), dtypes.float32)  # (T,)
+    if pos_offset is not None:
+        pos = ops.add(pos, ops.convert_element_type(pos_offset, dtypes.float32))
+    return _rope_tables(cfg, pos, dtype)
 
 
 def _apply_rope(x, cos, sin):

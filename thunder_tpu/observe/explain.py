@@ -136,6 +136,29 @@ def explain(jfn) -> str:
         for ln in sent.summary().splitlines():
             lines.append(f"  {ln}")
 
+    # -- serving ------------------------------------------------------------
+    # rendered when the process has serving metrics (the engine's gauges /
+    # histograms live in the process-wide registry, not per-compile state)
+    from thunder_tpu.observe import registry as _registry
+
+    if _registry.is_enabled():
+        snap = _registry.snapshot()
+        sv_g = {k: v for k, v in snap["gauges"].items() if k.startswith("serving.")}
+        sv_c = {k: v for k, v in snap["counters"].items() if k.startswith("serving.")}
+        sv_h = {k: v for k, v in snap["histograms"].items() if k.startswith("serving.")}
+        if sv_g or sv_c or sv_h:
+            lines.append("")
+            lines.append("== serving ==")
+            for k, v in sorted(sv_g.items()):
+                lines.append(f"  {k}: {v:g}")
+            for k, v in sorted(sv_c.items()):
+                lines.append(f"  {k}: {v:g} (counter)")
+            for k, h in sorted(sv_h.items()):
+                if h["count"]:
+                    lines.append(f"  {k}: n={h['count']} "
+                                 f"mean={h['sum'] / h['count']:.2f} "
+                                 f"min={h['min']:.2f} max={h['max']:.2f}")
+
     # -- step cost estimates ------------------------------------------------
     lines.append("")
     lines.append("== step estimates ==")

@@ -363,6 +363,87 @@ def sdpa_bwd(g, q, k, v, out, lse, is_causal: bool = False, scale: float | None 
             ops.convert_element_type(dv, v.dtype))
 
 
+@opsymbol(id="nn.paged_decode_attention")
+def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
+                           scale: float | None = None):
+    """Ragged-batch attention over a block-allocated paged KV cache — the
+    serving engine's decode attention (``thunder_tpu/serving/``): every
+    request in the batch reads its OWN context length through its OWN block
+    table, in one launch, from one shared page pool.
+
+    - ``q``: ``(B, n_heads, T, hd)`` — the T newest positions per request
+      (decode T=1; chunked prefill passes the whole chunk).
+    - ``k_pages`` / ``v_pages``: ``(kv_heads, num_pages, page_size, hd)`` —
+      the shared per-layer page pools.
+    - ``block_tables``: ``(B, pages_per_request)`` int32 page ids; entries
+      beyond a request's allocation must still be valid pool indices (the
+      allocator reserves page 0 as the never-read scratch page).
+    - ``lengths``: ``(B,)`` int32 context length per request INCLUDING the
+      T new rows — row ``r`` sits at absolute position ``lengths - T + r``
+      and attends keys ``j <= lengths - T + r`` (ragged causal masking).
+
+    Head grouping is GQA-contiguous, matching ``models/llama.forward_step``:
+    query head ``h`` reads kv head ``h // (n_heads // kv_heads)``.
+
+    The decomposition below (gather pages through the block table, mask,
+    softmax) is the always-available XLA fallback — the Pallas executor
+    claims the T==1 decode case as a single scalar-prefetch kernel that
+    streams each request's pages by block-table lookup, and the kernel
+    quarantine / bisection machinery falls back here per-op with equal
+    numerics.
+    """
+    _tensor_like(q, "paged_decode_attention")
+    check(q.ndim == 4 and k_pages.ndim == 4 and v_pages.ndim == 4,
+          lambda: f"paged_decode_attention: q must be (B, H, T, hd) and pages "
+                  f"(kv_heads, P, page, hd); got q {tuple(q.shape)}, "
+                  f"k_pages {tuple(k_pages.shape)}")
+    B, H, T, hd = q.shape
+    KV, P, ps, hd2 = k_pages.shape
+    check(hd2 == hd and tuple(v_pages.shape) == tuple(k_pages.shape),
+          lambda: f"paged_decode_attention: page pools {tuple(k_pages.shape)} / "
+                  f"{tuple(v_pages.shape)} do not match head_dim {hd}")
+    check(H % KV == 0,
+          lambda: f"paged_decode_attention: n_heads {H} not divisible by "
+                  f"kv_heads {KV}")
+    check(block_tables.ndim == 2 and block_tables.shape[0] == B
+          and lengths.ndim == 1 and lengths.shape[0] == B,
+          lambda: f"paged_decode_attention: block_tables {tuple(block_tables.shape)}"
+                  f" / lengths {tuple(lengths.shape)} do not match batch {B}")
+    n_rep = H // KV
+    npg = block_tables.shape[1]
+    L = npg * ps
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    # gather each request's context from the shared pools via its block
+    # table: (KV, P, ps, hd) indexed along the page dim by the flattened
+    # (B*npg,) table -> (KV, B, npg*ps, hd) -> (B, KV, L, hd)
+    idx = ops.reshape(block_tables, (B * npg,))
+    k = ops.transpose(ops.reshape(prims.take(k_pages, idx, 1),
+                                  (KV, B, L, hd)), (1, 0, 2, 3))
+    v = ops.transpose(ops.reshape(prims.take(v_pages, idx, 1),
+                                  (KV, B, L, hd)), (1, 0, 2, 3))
+    # grouped-query attention without materializing the expanded cache:
+    # fold the group dim into q's row dim (forward_step's GQA recipe)
+    qg = ops.reshape(q, (B, KV, n_rep * T, hd))
+    qf = ops.convert_element_type(qg, dtypes.float32)
+    kf = ops.convert_element_type(k, dtypes.float32)
+    vf = ops.convert_element_type(v, dtypes.float32)
+    scores = ops.mul(ops.matmul(qf, kf.mT), scale)        # (B, KV, n_rep*T, L)
+    scores = ops.reshape(scores, (B, H, T, L))
+    # ragged causal mask: key j valid for row r iff j <= lengths - T + r
+    col = ops.arange(L)                                   # (L,)
+    row_pos = ops.add(ops.unsqueeze(ops.sub(lengths, T), 1),
+                      ops.unsqueeze(ops.arange(T), 0))    # (B, T)
+    valid = ops.le(ops.unsqueeze(ops.unsqueeze(col, 0), 0),
+                   ops.unsqueeze(row_pos, 2))             # (B, T, L)
+    neg = ops.full((), float("-inf"), dtype=dtypes.float32)
+    scores = ops.where(ops.expand_to(ops.unsqueeze(valid, 1), scores.shape),
+                       scores, neg)
+    probs = ops.softmax(scores, -1)
+    attn = ops.matmul(ops.reshape(probs, (B, KV, n_rep * T, L)), vf)
+    return ops.convert_element_type(ops.reshape(attn, (B, H, T, hd)), q.dtype)
+
+
 @opsymbol(id="nn.fp8_linear")
 def fp8_linear(a, w, x_scale=None, w_scale=None, bias=None, slot: int = -1):
     """FP8 linear (TransformerEngine analog, reference

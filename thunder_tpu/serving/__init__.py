@@ -1,0 +1,33 @@
+"""Production serving runtime: continuous batching over a paged KV cache.
+
+The three layers (ROADMAP item 1):
+
+- :mod:`thunder_tpu.serving.kv_cache` — block-allocated page pool +
+  free-list + per-request block tables (requests at any mix of sequence
+  lengths share one device allocation, one compiled decode shape).
+- :mod:`thunder_tpu.serving.runner` — the compiled paged prefill/decode
+  step programs (``bind()``-dispatched decode; ``LengthBucketer``-laddered
+  prefill chunks; ragged attention via ``nn.paged_decode_attention``,
+  Pallas-claimed on TPU).
+- :mod:`thunder_tpu.serving.scheduler` — admission, decode-first
+  continuous batching with chunked prefill interleaving, mid-flight
+  join/evict, page-pressure preemption, ``step``-domain retry, and the
+  ``serving.*`` observe metrics.
+
+>>> from thunder_tpu.serving import ServingEngine
+>>> eng = ServingEngine(params, cfg, max_slots=8, page_size=16,
+...                     max_context=256, n_layers=2)
+>>> req = eng.submit(prompt_ids, max_new_tokens=32)
+>>> eng.drain(); req.output()
+
+``bench_serve.py`` at the repo root is the committed throughput benchmark
+(requests/s and aggregate decode tokens/s at a latency SLO).
+"""
+
+from thunder_tpu.serving.kv_cache import (  # noqa: F401
+    OutOfPages,
+    PagedKVCache,
+    PageGeometry,
+)
+from thunder_tpu.serving.runner import PagedLlamaRunner  # noqa: F401
+from thunder_tpu.serving.scheduler import Request, ServingEngine  # noqa: F401

@@ -1,0 +1,124 @@
+"""Block-allocated paged KV cache for the serving engine.
+
+One shared pool of fixed-size pages per layer (``(kv_heads, num_pages,
+page_size, head_dim)`` K and V arrays) plus a host-side free list and
+per-request block tables — the vLLM PagedAttention memory model, TPU-first:
+requests at wildly different sequence lengths share one device allocation,
+so the compiled decode step has ONE shape regardless of who is resident
+(no per-request recompiles, no per-request max_len buffers).
+
+Page 0 is reserved as the scratch page: it is never allocated, inactive
+decode slots write their (discarded) K/V there, and unallocated block-table
+entries point at it — every table entry is always a valid pool index, which
+is what lets the Pallas kernel's scalar-prefetch index map run unguarded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PageGeometry:
+    """Static pool geometry; everything the compiled step's shapes depend on."""
+
+    n_layers: int
+    kv_heads: int
+    head_dim: int
+    page_size: int       # tokens per page
+    num_pages: int       # pool pages per layer, INCLUDING the reserved page 0
+    pages_per_request: int  # block-table width (max context / page_size)
+
+    @property
+    def max_context(self) -> int:
+        return self.pages_per_request * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` of context."""
+        return -(-n_tokens // self.page_size)
+
+
+class PagedKVCache:
+    """Device page pools + host free list.
+
+    ``pools`` is a list (per layer) of ``{"k": array, "v": array}`` with
+    shape ``(kv_heads, num_pages, page_size, head_dim)``. The arrays are
+    functional: the engine passes them into the compiled step (donated) and
+    stores the returned updated pools back via :meth:`update_pools`.
+    """
+
+    def __init__(self, geometry: PageGeometry, dtype):
+        import jax.numpy as jnp
+
+        g = geometry
+        if g.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        self.geometry = g
+        self.dtype = dtype
+        shape = (g.kv_heads, g.num_pages, g.page_size, g.head_dim)
+        self.pools = [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                      for _ in range(g.n_layers)]
+        # LIFO free list: recently-freed pages are re-served first (their
+        # pool region is likeliest still warm in any cache hierarchy); the
+        # mirror set keeps free()'s double-free check O(1) per page (a list
+        # scan is O(pool) — quadratic on the completion/eviction hot path)
+        self._free: list[int] = list(range(g.num_pages - 1, 0, -1))
+        self._free_set: set[int] = set(self._free)
+        self._min_free = len(self._free)  # high-water tracking (peak usage)
+
+    # -- allocation ---------------------------------------------------------
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_total(self) -> int:
+        """Allocatable pages (the reserved scratch page doesn't count)."""
+        return self.geometry.num_pages - 1
+
+    @property
+    def peak_pages_used(self) -> int:
+        return self.pages_total - self._min_free
+
+    def utilization(self) -> float:
+        return 1.0 - self.pages_free / self.pages_total
+
+    def reset_peak(self) -> None:
+        """Restart high-water tracking (benchmarks: exclude warmup)."""
+        self._min_free = len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Pop ``n`` pages off the free list. Raises ``OutOfPages`` when the
+        pool can't satisfy the request — the scheduler turns that into
+        admission back-pressure or preemption, never a crash."""
+        if n > len(self._free):
+            raise OutOfPages(
+                f"requested {n} KV pages with {len(self._free)} free "
+                f"(pool: {self.pages_total}); admission should have "
+                f"back-pressured or preempted first")
+        pages = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(pages)
+        self._min_free = min(self._min_free, len(self._free))
+        return pages
+
+    def free(self, pages) -> None:
+        """Return pages to the free list (eviction / completion path)."""
+        for p in pages:
+            if not (0 < p < self.geometry.num_pages):
+                raise ValueError(f"freeing invalid page id {p}")
+            if p in self._free_set:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+        self._free_set.update(pages)
+
+    def update_pools(self, new_pools) -> None:
+        """Store the updated pools returned by a compiled step (the step
+        donates the old buffers, so the engine must never reuse them)."""
+        self.pools = list(new_pools)
+
+
+class OutOfPages(RuntimeError):
+    """The page pool cannot satisfy an allocation; scheduler-level signal."""

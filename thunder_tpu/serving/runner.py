@@ -1,0 +1,194 @@
+"""Paged model runner: the compiled prefill/decode step functions the
+serving engine dispatches.
+
+Two traced programs per engine, both shape-stable for the life of the
+process:
+
+- ``decode``: ONE batched step over every slot — ``(S, 1)`` tokens against
+  the shared page pools, ragged per-slot context lengths handled in-graph
+  by ``nn.paged_decode_attention`` (claimed by the Pallas scalar-prefetch
+  kernel on TPU; XLA decomposition otherwise). Dispatched through
+  ``bind()`` — the serving fast path pays zero guard cost per step.
+- ``prefill``: one CHUNK of one request's prompt — ``(1, C)`` tokens with
+  ``C`` drawn from a ``LengthBucketer`` ladder (multiples of the page
+  size), writing the chunk's K/V into the request's pages and attending
+  the paged context so far. Ragged prompt lengths compile at most
+  ``len(ladder)`` prefill programs, ever.
+
+K/V writes address the pools through host-computed flat positions
+(``page_id * page_size + offset``) — the host owns the block tables, so the
+traced program never does page arithmetic; it just ``dynamic_update_slice``s
+at traced scalar positions, which keeps one compiled decode program valid
+for every allocation pattern.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu import ops
+from thunder_tpu.ops import nn as tnn
+
+
+def _rope_tables_at(cfg, positions, dtype):
+    """Per-request rotary tables: ``positions`` (S,) int32 -> cos/sin
+    ``(S, 1, 1, hd/2)``, broadcasting over heads and the single decode row.
+    The frequency math lives in ``models.llama._rope_tables`` — ONE owner
+    shared with training and prefill, so rope changes can't silently break
+    the engine's token-identity with ``generate()``."""
+    from thunder_tpu.models.llama import _rope_tables
+
+    cos, sin = _rope_tables(cfg, positions, dtype)     # (S, hd/2)
+    shape = (positions.shape[0], 1, 1, cfg.head_dim // 2)
+    return ops.reshape(cos, shape), ops.reshape(sin, shape)
+
+
+def _write_rows(pool, rows, flat_positions):
+    """Scatter every slot's K/V row into a flattened page pool in ONE
+    scatter op.
+
+    ``pool``: (KV, P*ps, hd); ``rows``: (S, KV, 1, hd); ``flat_positions``:
+    (S,) int32 of page*ps+offset. Replace semantics (``prims.scatter``) —
+    freed pages hold stale values, so add-style scatters would corrupt.
+    Idle slots all target position 0 (the reserved scratch page); duplicate
+    indices there are benign (any write wins, nobody reads it). One scatter
+    beats S chained dynamic_update_slices: XLA copies the input pool once
+    either way, but the chain pays S update kernels."""
+    S = rows.shape[0]
+    hd = pool.shape[-1]
+    src = ops.transpose(ops.squeeze(rows, 2), (1, 0, 2))       # (KV, S, hd)
+    idx = ops.expand_to(ops.reshape(flat_positions, (1, S, 1)), src.shape)
+    return prims.scatter(pool, idx, src, 1)
+
+
+def _write_pages(pool, rows, page_positions, ps: int):
+    """Scatter a prefill chunk's K/V into its pages. ``rows``: (KV, C, hd)
+    with C a multiple of ps; ``page_positions``: (C//ps,) int32 flat
+    positions (page*ps) — chunks start page-aligned by construction."""
+    zero = ops.full((), 0, dtype=dtypes.int32)
+    C = rows.shape[1]
+    for i in range(C // ps):
+        pos = ops.getitem(page_positions, i)
+        pool = prims.dynamic_update_slice(pool, ops.narrow(rows, 1, i * ps, ps),
+                                          (zero, pos, zero))
+    return pool
+
+
+class PagedLlamaRunner:
+    """Builds + owns the compiled paged step functions for one engine."""
+
+    def __init__(self, cfg, geometry, *, n_layers: int | None = None,
+                 executors=None):
+        import thunder_tpu as tt
+
+        self.cfg = cfg
+        self.geom = geometry
+        self.n_layers = n_layers if n_layers is not None else cfg.n_layers
+        # one jitted fn each; distinct chunk shapes become distinct cache
+        # entries inside the ThunderTPUFunction (bounded by the ladder)
+        self.decode_jit = tt.jit(self._decode_fn, executors=executors,
+                                 fn_name="serving_decode", donate_argnums=(5,))
+        self.prefill_jit = tt.jit(self._prefill_fn, executors=executors,
+                                  fn_name="serving_prefill", donate_argnums=(6,))
+
+    # -- traced bodies ------------------------------------------------------
+    def _attn_block(self, h, layer, q, block_tables, lengths, pools_kv):
+        """Shared attention tail: this step's K/V rows are already written
+        into the pools; run paged attention and the residual + MLP."""
+        cfg = self.cfg
+        B, T = h.shape[0], h.shape[1]
+        attn = tnn.paged_decode_attention(q, pools_kv["k"], pools_kv["v"],
+                                          block_tables, lengths)
+        attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)),
+                           (B, T, cfg.n_heads * cfg.head_dim))
+        h = ops.add(h, ops.linear(attn, layer["wo"]))
+        from thunder_tpu.models.llama import _mlp
+
+        return _mlp(h, layer, cfg)
+
+    def _decode_fn(self, params, tokens, block_tables, lengths, write_pos, pools):
+        """One continuous-batching decode step for every slot.
+
+        tokens (S, 1) int32; block_tables (S, npg) int32; lengths (S,) int32
+        context length INCLUDING this token; write_pos (S,) int32 flat pool
+        position of this token's K/V row. Returns (logits (S, V), pools)."""
+        cfg = self.cfg
+        g = self.geom
+        h = ops.embedding(tokens, params["tok_embedding"])             # (S,1,D)
+        cos, sin = _rope_tables_at(cfg, ops.sub(lengths, 1), h.dtype)
+        new_pools = []
+        flat = (g.kv_heads, g.num_pages * g.page_size, g.head_dim)
+        paged = (g.kv_heads, g.num_pages, g.page_size, g.head_dim)
+        for layer, kv in zip(params["layers"], pools):
+            x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+            q, k, v = self._qkv(x, layer, cos, sin)
+            kp = _write_rows(ops.reshape(kv["k"], flat), k, write_pos)
+            vp = _write_rows(ops.reshape(kv["v"], flat), v, write_pos)
+            kv = {"k": ops.reshape(kp, paged), "v": ops.reshape(vp, paged)}
+            new_pools.append(kv)
+            h = self._attn_block(h, layer, q, block_tables, lengths, kv)
+        h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+        logits = ops.linear(h, params["lm_head"])                      # (S,1,V)
+        return ops.squeeze(logits, 1), new_pools
+
+    def _qkv(self, x, layer, cos, sin):
+        """RoPE'd q/k/v heads (decode layout: T == x.shape[1])."""
+        from thunder_tpu.models.llama import _apply_rope
+
+        cfg = self.cfg
+        B, T = x.shape[0], x.shape[1]
+        hd = cfg.head_dim
+        q = ops.transpose(ops.reshape(ops.linear(x, layer["wq"]),
+                                      (B, T, cfg.n_heads, hd)), (0, 2, 1, 3))
+        k = ops.transpose(ops.reshape(ops.linear(x, layer["wk"]),
+                                      (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        v = ops.transpose(ops.reshape(ops.linear(x, layer["wv"]),
+                                      (B, T, cfg.kv_heads, hd)), (0, 2, 1, 3))
+        return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
+
+    def _prefill_fn(self, params, tokens, block_tables, lengths, page_writes,
+                    last_idx, pools):
+        """One prefill chunk of one request.
+
+        tokens (1, C) int32 (C from the bucket ladder, multiple of the page
+        size; padded past the prompt tail); block_tables (1, npg); lengths
+        (1,) int32 = chunk_start + C (context including the padded chunk);
+        page_writes (C//ps,) int32 flat positions of the chunk's pages;
+        last_idx 0-d int32 row of the final REAL token within the chunk
+        (meaningful on the last chunk; earlier chunks' logits are ignored).
+        Returns (logits (1, V) at last_idx, pools)."""
+        cfg = self.cfg
+        g = self.geom
+        C = tokens.shape[1]
+        from thunder_tpu.models.llama import _project_qkv, _rope_cos_sin
+
+        h = ops.embedding(tokens, params["tok_embedding"])             # (1,C,D)
+        pos0 = ops.sub(ops.getitem(lengths, 0), C)
+        cos, sin = _rope_cos_sin(cfg, C, h.dtype, pos_offset=pos0)
+        new_pools = []
+        flat = (g.kv_heads, g.num_pages * g.page_size, g.head_dim)
+        paged = (g.kv_heads, g.num_pages, g.page_size, g.head_dim)
+        zero = ops.full((), 0, dtype=dtypes.int32)
+        for layer, kv in zip(params["layers"], pools):
+            x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
+            q, k, v = _project_qkv(x, layer, cfg, cos, sin)
+            kp = _write_pages(ops.reshape(kv["k"], flat), ops.squeeze(k, 0),
+                              page_writes, g.page_size)
+            vp = _write_pages(ops.reshape(kv["v"], flat), ops.squeeze(v, 0),
+                              page_writes, g.page_size)
+            kv = {"k": ops.reshape(kp, paged), "v": ops.reshape(vp, paged)}
+            new_pools.append(kv)
+            h = self._attn_block(h, layer, q, block_tables, lengths, kv)
+        h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
+        # logits only at the final real row (pre-lm_head slice: the r4
+        # prefill lesson — never materialize (1, C, vocab))
+        h = prims.dynamic_slice(h, (zero, last_idx, zero), (1, 1, cfg.dim))
+        logits = ops.linear(h, params["lm_head"])                      # (1,1,V)
+        return ops.squeeze(logits, 1), new_pools
+
+    # -- dispatch -----------------------------------------------------------
+    def bind_decode(self, *args):
+        """Compile the decode step for these inputs and bind it (zero-guard
+        dispatch). The scheduler owns the bound callable and re-binds when
+        the quarantine epoch moves (a containment event recompiled under a
+        new cache entry; the stale binding would re-contain every call)."""
+        return self.decode_jit.bind(*args)

@@ -1,0 +1,458 @@
+"""Continuous (in-flight) batching scheduler over the paged KV cache.
+
+The serving runtime ROADMAP item 1 calls for: many concurrent streams at
+different sequence lengths served by ONE compiled decode program.
+
+- **Admission**: queued requests join a free decode slot when the page pool
+  can cover their first prefill chunk; otherwise the queue back-pressures
+  (nothing crashes — pages are the capacity unit).
+- **Decode-first with chunked prefill interleaving**: every engine
+  iteration runs one batched decode step over all resident requests, plus
+  at most ONE prefill chunk of the head-of-line prefilling request — long
+  prompts cannot stall in-flight decodes for more than a chunk.
+- **Continuous batching**: requests join and leave the decode batch
+  mid-flight. Completion (or EOS) frees the request's pages immediately;
+  the slot admits the next queued request on the same compiled program.
+- **Preemption**: when the pool runs dry mid-decode, the newest resident
+  request is evicted back to the queue (recompute-on-resume: its generated
+  tokens re-prefill as prompt) — ``serving.preempted_requests`` counts
+  these.
+- **Dispatch**: the decode step is bound (``bind()``, zero-guard) and runs
+  under the ``step`` fault domain with retry — a transient injected or XLA
+  fault re-runs the same step; kernel crashes still take the normal
+  quarantine path inside the bound call.
+
+Greedy sampling (argmax) — the engine is a throughput/latency runtime, not
+a sampling library; temperature sampling stays in ``models.llama.generate``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from thunder_tpu.observe import registry as _observe
+from thunder_tpu.runtime import faults as _faults
+from thunder_tpu.runtime import quarantine as _quarantine
+from thunder_tpu.runtime import retry as _retry
+from thunder_tpu.serving.kv_cache import PagedKVCache, PageGeometry
+from thunder_tpu.serving.runner import PagedLlamaRunner
+
+QUEUED, PREFILL, DECODE, DONE = "queued", "prefill", "decode", "done"
+
+
+@dataclass(eq=False)  # identity semantics: requests live in slot lists
+class Request:
+    """One generation request and its full lifecycle state."""
+
+    prompt: np.ndarray                  # original prompt token ids (1-D int32)
+    max_new_tokens: int
+    request_id: int
+    eos_id: int | None = None
+    submitted_s: float = 0.0
+    state: str = QUEUED
+    pages: list = field(default_factory=list)   # allocated page ids, in order
+    prefilled: int = 0                  # work-prompt tokens written so far
+    length: int = 0                     # context tokens written into the cache
+    next_token: int | None = None       # sampled, not yet fed to decode
+    generated: list = field(default_factory=list)
+    ttft_s: float | None = None
+    finished_s: float | None = None
+    decode_start_s: float | None = None
+    preemptions: int = 0
+    admit_seq: int = -1                 # admission order (preemption victim pick)
+    pages_version: int = 0              # bumped when ``pages`` changes
+
+    @property
+    def work_prompt(self) -> np.ndarray:
+        """What prefill must write: the original prompt plus any tokens
+        generated before a preemption (recompute-on-resume)."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    @property
+    def done(self) -> bool:
+        return self.state == DONE
+
+    def output(self) -> np.ndarray:
+        return np.asarray(self.generated, np.int32)
+
+
+class ServingEngine:
+    """Continuous-batching serving runtime for a Llama-family model.
+
+    >>> eng = ServingEngine(params, cfg, max_slots=8, page_size=16,
+    ...                     max_context=256, n_layers=2)
+    >>> r = eng.submit([1, 2, 3], max_new_tokens=16)
+    >>> eng.drain()
+    >>> r.output()
+
+    ``max_slots`` is the compiled decode batch width; ``num_pages`` sizes
+    the shared pool (default: full residency for every slot — shrink it to
+    exercise admission back-pressure and preemption).
+    """
+
+    def __init__(self, params, cfg, *, max_slots: int = 8, page_size: int = 16,
+                 num_pages: int | None = None, max_context: int | None = None,
+                 prefill_chunk: int | None = None, n_layers: int | None = None,
+                 executors=None, retry_policy=None):
+        self.params = params
+        self.cfg = cfg
+        n_layers_eff = n_layers if n_layers is not None else cfg.n_layers
+        max_context = int(max_context or cfg.max_seq_len)
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        # prefill chunk ladder: powers-of-two multiples of the page size —
+        # chunk starts stay page-aligned by construction, and ragged prompt
+        # lengths compile at most len(ladder) prefill programs
+        cap = int(prefill_chunk or min(max_context, 512))
+        cap = max(page_size, (cap // page_size) * page_size)
+        ladder, b = [], page_size
+        while b < cap:
+            ladder.append(b)
+            b *= 2
+        ladder.append(cap)
+        from thunder_tpu.data import LengthBucketer
+
+        self.chunker = LengthBucketer(ladder)
+        self.max_chunk = ladder[-1]
+        # align the context window to the chunk ladder top so a fully
+        # chunk-padded prefill can never outrun the block table
+        max_context = -(-max_context // self.max_chunk) * self.max_chunk
+        self.max_context = max_context
+        pages_per_req = -(-max_context // page_size)
+        if num_pages is None:
+            num_pages = max_slots * pages_per_req + 1  # + reserved page 0
+        geometry = PageGeometry(
+            n_layers=n_layers_eff, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            page_size=page_size, num_pages=int(num_pages),
+            pages_per_request=pages_per_req)
+        self.geom = geometry
+        self.cache = PagedKVCache(geometry, cfg.dtype.jax)
+        self.runner = PagedLlamaRunner(cfg, geometry, n_layers=n_layers,
+                                       executors=executors)
+        self.max_slots = int(max_slots)
+        self.slots: list[Request | None] = [None] * self.max_slots
+        self.queue: deque[Request] = deque()
+        self.completed: list[Request] = []
+        self._ids = itertools.count()
+        self._admits = itertools.count()
+        self._step_count = 0
+        # serving is latency-sensitive: quick retries, no long backoff
+        self._retry_policy = retry_policy or _retry.RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
+        self._decode_bound = None
+        self._bound_epoch = -1
+        # persistent decode-step input buffers: rebuilt rows only for slots
+        # whose state changed (the block-table row is cached per request) —
+        # per-step host work stays O(active), not O(slots * table width)
+        S = self.max_slots
+        self._np_tokens = np.zeros((S, 1), np.int32)
+        self._np_bt = np.zeros((S, pages_per_req), np.int32)
+        self._np_len = np.ones(S, np.int32)
+        self._np_wp = np.zeros(S, np.int32)
+        self._bt_slot_version: list = [None] * S
+
+    # -- public API ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *,
+               eos_id: int | None = None) -> Request:
+        """Enqueue a request. Raises if it could never fit the context
+        window or the page pool (capacity contract, checked up front)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = int(prompt.size) + int(max_new_tokens)
+        if total > self.max_context:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine context window ({self.max_context})")
+        # worst-case page footprint: the larger of the final context and the
+        # chunk-PADDED prefill high-water mark (the last chunk rounds up to
+        # a ladder size, which can transiently need more pages than the
+        # final context — e.g. a 33-token prompt prefills as one 64 chunk)
+        worst = max(total, self._padded_prefill_len(total))
+        if self.geom.pages_for(worst) > self.cache.pages_total:
+            raise ValueError(
+                f"request needs up to {self.geom.pages_for(worst)} KV pages; "
+                f"the pool only has {self.cache.pages_total} — enlarge "
+                f"num_pages")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      request_id=next(self._ids), eos_id=eos_id,
+                      submitted_s=time.perf_counter())
+        self.queue.append(req)
+        self._gauges()
+        return req
+
+    def step(self) -> bool:
+        """One engine iteration: admit, one batched decode step, prefill.
+        Returns whether any work was done (False = idle).
+
+        Decode-first, chunked prefill interleaving: with a well-filled
+        decode batch, prefill advances ONE chunk per iteration (a long
+        prompt can only add one bounded chunk of latency between decode
+        steps); with a thin batch, prefill bursts so arriving requests
+        reach the decode batch quickly instead of trickling in one chunk
+        per decode step."""
+        self._step_count += 1
+        self._admit()
+        worked = self._decode_step()
+        decoding = sum(1 for r in self.slots
+                       if r is not None and r.state == DECODE)
+        budget = 1 if decoding > self.max_slots // 2 else self.max_slots
+        for _ in range(budget):
+            if not self._prefill_one():
+                break
+            worked = True
+            self._admit()  # a completed prefill may free queue back-pressure
+        self._gauges()
+        return worked
+
+    def drain(self, max_steps: int = 1_000_000) -> list[Request]:
+        """Run until every submitted request completes (or no progress is
+        possible). Returns the completed requests, completion order."""
+        for _ in range(max_steps):
+            if not (self.queue or any(s is not None for s in self.slots)):
+                break
+            if not self.step():
+                break
+        return self.completed
+
+    @property
+    def active_requests(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    # -- scheduling internals -----------------------------------------------
+    def _gauges(self) -> None:
+        _observe.set_gauge("serving.queue_depth", len(self.queue))
+        _observe.set_gauge("serving.active_requests", self.active_requests)
+        _observe.set_gauge("serving.kv_pages_free", self.cache.pages_free)
+
+    def _admit(self) -> None:
+        while self.queue:
+            slot = next((i for i, s in enumerate(self.slots) if s is None), None)
+            if slot is None:
+                return
+            req = self.queue[0]
+            first_chunk = self._chunk_size(len(req.work_prompt))
+            if not self.cache.can_alloc(first_chunk // self.geom.page_size):
+                return  # page back-pressure: wait for completions/evictions
+            self.queue.popleft()
+            req.pages = self.cache.alloc(first_chunk // self.geom.page_size)
+            req.pages_version += 1
+            req.prefilled = 0
+            req.length = 0
+            req.state = PREFILL
+            req.admit_seq = next(self._admits)
+            self.slots[slot] = req
+
+    def _chunk_size(self, remaining: int) -> int:
+        return self.max_chunk if remaining >= self.max_chunk \
+            else self.chunker.bucket_for(remaining)
+
+    def _padded_prefill_len(self, n: int) -> int:
+        """Context length at the end of prefilling ``n`` tokens, including
+        the final chunk's ladder padding."""
+        full = (n // self.max_chunk) * self.max_chunk
+        rem = n - full
+        return full + (self.chunker.bucket_for(rem) if rem else 0)
+
+    def _block_table(self, req: Request) -> np.ndarray:
+        bt = np.zeros(self.geom.pages_per_request, np.int32)
+        bt[:len(req.pages)] = req.pages
+        return bt
+
+    def _prefill_one(self) -> bool:
+        """Advance the head-of-line prefilling request by ONE chunk."""
+        req = min((r for r in self.slots
+                   if r is not None and r.state == PREFILL),
+                  key=lambda r: r.admit_seq, default=None)
+        if req is None:
+            return False
+        g = self.geom
+        wp = req.work_prompt
+        remaining = len(wp) - req.prefilled
+        C = self._chunk_size(remaining)
+        pos0 = req.prefilled                        # chunk/page aligned
+        need_total = (pos0 + C) // g.page_size
+        if len(req.pages) < need_total and \
+                not self._grow_pages(req, need_total - len(req.pages)):
+            return False                            # preempted or must wait
+        real = min(remaining, C)
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :real] = wp[pos0:pos0 + real]
+        lengths = np.asarray([pos0 + C], np.int32)
+        first_page = pos0 // g.page_size
+        page_writes = np.asarray(
+            [req.pages[first_page + i] * g.page_size for i in range(C // g.page_size)],
+            np.int32)
+        t0 = time.perf_counter()
+        logits, pools = self.runner.prefill_jit(
+            self.params, chunk, self._block_table(req)[None], lengths,
+            page_writes, np.int32(real - 1), self.cache.pools)
+        self.cache.update_pools(pools)
+        _observe.observe_value("serving.prefill_ms",
+                               (time.perf_counter() - t0) * 1e3)
+        req.prefilled += real
+        if req.prefilled == len(wp):                # prompt fully resident
+            req.length = len(wp)
+            req.state = DECODE
+            if req.decode_start_s is None:          # survive preempt-resume:
+                # decode_ms stays first-token -> completion, as documented
+                req.decode_start_s = time.perf_counter()
+            tok = int(np.asarray(logits)[0].argmax())
+            self._on_token(req, tok)
+        return True
+
+    def _grow_pages(self, req: Request, n: int) -> bool:
+        """Allocate ``n`` more pages for ``req``, preempting the newest
+        resident request (possibly ``req`` itself) while the pool is dry."""
+        while not self.cache.can_alloc(n):
+            victim = max((r for r in self.slots
+                          if r is not None and r.state in (DECODE, PREFILL)
+                          and r is not req),
+                         key=lambda r: r.admit_seq, default=None)
+            if victim is None:
+                # nothing else to evict: requeue req itself and wait
+                self._preempt(req)
+                return False
+            self._preempt(victim)
+        req.pages.extend(self.cache.alloc(n))
+        req.pages_version += 1
+        return True
+
+    def _preempt(self, req: Request) -> None:
+        """Evict a resident request back to the queue head (recompute-on-
+        resume). Its pages return to the free list immediately."""
+        self.cache.free(req.pages)
+        req.pages = []
+        req.pages_version += 1
+        req.prefilled = 0
+        req.length = 0
+        req.next_token = None
+        req.state = QUEUED
+        req.preemptions += 1
+        self.slots[self.slots.index(req)] = None
+        self.queue.appendleft(req)
+        _observe.inc("serving.preempted_requests")
+        _observe.event("serving_preempt", request=req.request_id,
+                       generated=len(req.generated))
+
+    def _decode_step(self) -> bool:
+        """One batched decode step over every resident DECODE request."""
+        g = self.geom
+        # page capacity first (may preempt, changing the active set)
+        for req in list(self.slots):
+            if req is None or req.state != DECODE:
+                continue
+            need = req.length // g.page_size + 1
+            if len(req.pages) < need:
+                self._grow_pages(req, need - len(req.pages))
+        active = [(i, r) for i, r in enumerate(self.slots)
+                  if r is not None and r.state == DECODE]
+        if not active:
+            return False
+        tokens, bt = self._np_tokens, self._np_bt
+        lengths, write_pos = self._np_len, self._np_wp
+        for i in range(self.max_slots):
+            r = self.slots[i]
+            if r is None or r.state != DECODE:
+                # idle slots attend + scribble on the reserved page 0 only
+                # (their block-table row is zeroed once on going idle, so
+                # the documented invariant holds exactly: idle slots never
+                # read a live request's pages)
+                tokens[i, 0] = 0
+                lengths[i] = 1
+                write_pos[i] = 0
+                if self._bt_slot_version[i] is not None:
+                    bt[i] = 0
+                    self._bt_slot_version[i] = None
+        for i, r in active:
+            tokens[i, 0] = r.next_token
+            key = (r.request_id, r.pages_version)
+            if self._bt_slot_version[i] != key:     # pages changed (rare)
+                bt[i, :len(r.pages)] = r.pages
+                bt[i, len(r.pages):] = 0
+                self._bt_slot_version[i] = key
+            lengths[i] = r.length + 1
+            write_pos[i] = (r.pages[r.length // g.page_size] * g.page_size
+                            + r.length % g.page_size)
+
+        def dispatch():
+            # the `step` fault domain fires BEFORE the device dispatch, so a
+            # retried injected fault re-runs on unconsumed inputs
+            _faults.maybe_fail("step", step=self._step_count)
+            # a quarantine containment inside a previous bound call
+            # recompiled under a NEW cache entry (epoch bump); re-bind so
+            # the fallback program serves — the stale bound entry would
+            # re-enter containment (clear + recompile) on EVERY step
+            ep = _quarantine.epoch()
+            if self._decode_bound is None or self._bound_epoch != ep:
+                self._decode_bound = self.runner.bind_decode(
+                    self.params, tokens, bt, lengths, write_pos,
+                    self.cache.pools)
+                self._bound_epoch = ep
+            return self._decode_bound(self.params, tokens, bt, lengths,
+                                      write_pos, self.cache.pools)
+
+        def classify(exc):
+            kind = _retry.classify(exc)
+            if kind == _retry.RETRYABLE and not self._pools_alive():
+                # the failing dispatch CONSUMED the donated page pools
+                # (real accelerator fault mid-execution): a blind re-run
+                # would crash on deleted buffers every attempt — escalate
+                # to the supervisor instead of spinning
+                return _retry.FATAL
+            return kind
+
+        logits, pools = _retry.call_with_retry(dispatch, domain="step",
+                                               policy=self._retry_policy,
+                                               classify_fn=classify)
+        self.cache.update_pools(pools)
+        toks = np.asarray(logits).argmax(-1)    # host sync: honest step end
+        for i, r in active:
+            r.length += 1
+            self._on_token(r, int(toks[i]))
+        return True
+
+    def _pools_alive(self) -> bool:
+        """False when any pool buffer was deleted (consumed by a donated
+        dispatch that then failed) — replay is impossible without them."""
+        for kv in self.cache.pools:
+            for arr in kv.values():
+                if getattr(arr, "is_deleted", lambda: False)():
+                    return False
+        return True
+
+    def _on_token(self, req: Request, tok: int) -> None:
+        req.generated.append(tok)
+        req.next_token = tok
+        if req.ttft_s is None:
+            req.ttft_s = time.perf_counter() - req.submitted_s
+            _observe.observe_value("serving.ttft_ms", req.ttft_s * 1e3)
+        if (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id)):
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        self.cache.free(req.pages)
+        req.pages = []
+        req.pages_version += 1
+        req.state = DONE
+        req.finished_s = time.perf_counter()
+        if req.decode_start_s is not None:
+            # per-request decode-phase duration (first token -> completion)
+            _observe.observe_value(
+                "serving.decode_ms", (req.finished_s - req.decode_start_s) * 1e3)
+        self.slots[self.slots.index(req)] = None
+        self.completed.append(req)
+        _observe.event("serving_complete", request=req.request_id,
+                       generated=len(req.generated),
+                       preemptions=req.preemptions)
