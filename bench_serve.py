@@ -149,6 +149,19 @@ def main():
         eng.submit(rng.randint(1, cfg.vocab_size, size=L).astype(np.int32),
                    max_new_tokens=2)
     eng.drain()
+    # decode fusion shape, published by the runner at bind time from the
+    # compiled program's executor assignments (registry gauges, NOT trace
+    # grepping) — captured here because the timed rounds reset the registry.
+    # decode_layer_fusions counts whole-decode-layer megakernel claims;
+    # launches is the Pallas dispatch count of ONE decode step (one token
+    # across the whole batch). 0/0 on stacks where Pallas is unavailable
+    # (e.g. this CPU smoke) — the decode trace then runs the XLA
+    # decomposition and the stamped shape says so.
+    snap0 = observe.snapshot()
+    decode_layer_fusions = int(snap0["gauges"].get(
+        "serving.decode_layer_fusions", 0))
+    decode_launches = int(snap0["gauges"].get(
+        "serving.decode_pallas_launches", 0))
 
     def run_continuous():
         eng.completed.clear()
@@ -220,7 +233,11 @@ def main():
             for r in cont["reqs"] if r.decode_start_s is not None), 0.99), 2),
         "kv_page_util_peak": round(cont["util_peak"], 4),
         "kv_pages_total": eng.cache.pages_total,
-        "preempted_requests": int(preempted)}))
+        "preempted_requests": int(preempted),
+        "decode_layer_fusions": decode_layer_fusions,
+        "decode_pallas_launches_per_token": decode_launches,
+        "decode_launches_per_layer_per_token": round(
+            decode_launches / max(n_layers, 1), 3)}))
 
 
 if __name__ == "__main__":

@@ -90,8 +90,11 @@ def test_block_planner_decision_kinds_documented():
     KERNELS.md "Reading planner decisions" table — the decision log is an
     ops surface (dashboards / triage scripts key on the kinds), and a new
     kind landing in code without its documented meaning fails tier-1 here
-    rather than drifting silently. The reverse direction (planner records
-    only registered kinds) is asserted in tests/test_block_planner.py."""
+    rather than drifting silently. BOTH directions are enforced: a kind in
+    KERNELS.md's table that the code no longer registers fails too (stale
+    docs teach triage scripts to match verdicts that never fire). The
+    in-source direction (the planner records only registered kinds) is
+    asserted in tests/test_block_planner.py."""
     from thunder_tpu.core.fusion_passes import BLOCK_DECISION_KINDS
 
     assert BLOCK_DECISION_KINDS, "planner lost its decision vocabulary"
@@ -101,3 +104,10 @@ def test_block_planner_decision_kinds_documented():
     assert not missing, (
         "block-planner decision kinds emitted by the code but missing from "
         f"the KERNELS.md planner-decisions table: {missing}")
+    # reverse direction: parse the planner-decisions table rows (| `kind` |)
+    table_kinds = set(re.findall(r"^\| `([a-z][a-z-]*)` \|", doc, re.M))
+    assert table_kinds, "KERNELS.md lost its planner-decisions table"
+    stale = sorted(table_kinds - set(BLOCK_DECISION_KINDS))
+    assert not stale, (
+        "KERNELS.md planner-decisions table documents kinds the planner "
+        f"no longer registers: {stale}")

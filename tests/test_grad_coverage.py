@@ -124,6 +124,13 @@ _COMPOSITE_GRAD_EXEMPT_REASONED = {
                               "AdamW(slab_persistent=True) on detached "
                               "grads/state strictly after the backward; "
                               "never differentiated",
+    "nn.attn_subblock": "inference-only serving decode sub-block (built by the "
+                        "block planner's attention walk on T==1 decode traces; "
+                        "training attention goes through "
+                        "nn.scaled_dot_product_attention, which has a rule)",
+    "nn.decode_layer": "inference-only whole-decode-layer composite (the "
+                       "chaining stage's unit) — serving decode traces are "
+                       "never differentiated",
     "nn.mlp_subblock_bwd": "backward half of the block planner's megakernel "
                            "pair (emitted by the nn.mlp_subblock VJP rule); "
                            "differentiating it is second-order autodiff, "

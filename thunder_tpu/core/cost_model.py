@@ -280,10 +280,20 @@ def subblock_vmem_bytes(d_model: int, d_ff: int, dtype_bytes: int,
 
 
 def subblock_cost(n_tokens: int, d_model: int, d_ff: int,
-                  dtype_bytes: int) -> dict:
+                  dtype_bytes: int, decode: bool = False) -> dict:
     """Score one MLP sub-block chain for megakernel planning. Returns the
     decision-log dict: VMEM feasibility, the saved-boundary-bytes objective,
-    and est_unfused/fused_us under the efficiency constants above."""
+    and est_unfused/fused_us under the efficiency constants above.
+
+    ``decode=True`` scores the chain as part of a T==1 serving decode step
+    (the planner sets it when the chain's attention input comes from an
+    ``nn.attn_subblock``): at one token per slot every GEMM of the unfused
+    program is its own tiny-M kernel launch, so the unfused side is charged
+    ``DECODE_UNFUSED_LAUNCHES_MLP`` launches — the launch amortization that
+    makes decode-layer fusion win where the byte objective alone would lose
+    at serving row counts. Training/prefill chains (``decode=False``) keep
+    the pure byte objective: at large ``n_tokens`` the launch term is noise
+    and charging it would not change any verdict worth having."""
     flops = 3 * 2 * n_tokens * d_model * d_ff  # gate + up + down GEMMs
     # interior values written+read once each between kernels in the unfused
     # program: normed (N*D), gate pre-act (N*F), up (N*F), swiglu product
@@ -296,13 +306,15 @@ def subblock_cost(n_tokens: int, d_model: int, d_ff: int,
                       + 3 * d_model * d_ff * dtype_bytes)
     flop_us = flops / TPU_PEAK_FLOPS * 1e6
     bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
+    unfused_launches = DECODE_UNFUSED_LAUNCHES_MLP if decode else 0
     unfused = (flop_us / SUBBLOCK_XLA_EFFICIENCY
-               + (boundary_bytes + interior_bytes) * bw_us_per_byte)
+               + (boundary_bytes + interior_bytes) * bw_us_per_byte
+               + unfused_launches * SUBBLOCK_LAUNCH_OVERHEAD_US)
     fused = (flop_us / SUBBLOCK_FUSED_EFFICIENCY
              + boundary_bytes * bw_us_per_byte + SUBBLOCK_LAUNCH_OVERHEAD_US)
     vmem = subblock_vmem_bytes(d_model, d_ff, dtype_bytes, n_tokens)
     return {"n_tokens": n_tokens, "d_model": d_model, "d_ff": d_ff,
-            "flops": flops,
+            "flops": flops, "decode": bool(decode),
             "saved_boundary_bytes": interior_bytes,
             "vmem_bytes_per_step": vmem,
             "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
@@ -317,6 +329,136 @@ def subblock_profitable(cost: dict) -> bool:
     megabytes of interior traffic). ``block_fusion=True/False`` overrides
     per-compile."""
     return bool(cost["vmem_feasible"]) and cost["est_saved_us"] > 0.0
+
+
+# --- whole-decode-layer (serving T==1) model --------------------------------
+# The decode-layer megakernel (core/fusion_passes attn sub-block walk +
+# chaining stage) collapses one transformer layer of the serving decode step
+# — rms_norm → qkv → rope → paged attention → out-proj → residual →
+# MLP sub-block — into ONE Pallas launch per layer per decoded token. Two
+# structural facts drive the model, both specific to T==1 decode:
+#
+# 1. The unfused program pays a kernel LAUNCH per GEMM: at one token per
+#    slot every projection is a tiny-M matmul XLA cannot merge with its
+#    neighbors, so the per-launch 8 µs dominates the per-launch compute.
+# 2. The decomposition of nn.paged_decode_attention GATHERS each request's
+#    whole block-table window into a contiguous (B, KV, L, hd) cache before
+#    attending — per-token traffic the scalar-prefetch kernel never pays
+#    (it DMAs pages straight off the block table and skips past-length
+#    pages). Those gathered bytes are the dominant term of
+#    ``saved_boundary_bytes`` at serving context lengths.
+DECODE_UNFUSED_LAUNCHES_ATTN = 6   # q/k/v GEMMs + paged attention + out-proj
+                                   # + the rope/scatter pointwise region
+DECODE_UNFUSED_LAUNCHES_MLP = 4    # gate/up/down GEMMs + the pointwise glue
+
+
+def decode_subblock_vmem_bytes(n_slots: int, d_model: int, n_heads: int,
+                               kv_heads: int, head_dim: int, page_size: int,
+                               d_ff: int, dtype_bytes: int) -> int:
+    """Modeled per-grid-step VMEM staging of the decode megakernel
+    (``d_ff = 0`` models the attention sub-block alone): the whole slot
+    batch's rows + rope tables + fresh K/V rows stay resident; the f32
+    scratch holds the normed rows, the residual accumulator and (with the
+    MLP chained) the second norm + down accumulator; the streamed tiles
+    (per-head qkv weights, the per-group out-proj slice, one K/V page pair,
+    the ``SUBBLOCK_FF_BLOCK`` MLP slices) are double-buffered. The kernel in
+    ``executors/pallasex.py`` imports the same tile budgets, so this gate
+    and the real staging cannot drift."""
+    f32 = 4
+    g = max(n_heads // max(kv_heads, 1), 1)
+    resident = (n_slots * d_model * dtype_bytes            # h rows
+                + 2 * n_slots * head_dim * dtype_bytes     # cos/sin (hd/2 x2)
+                + n_slots * d_model * dtype_bytes          # normed rows
+                + n_heads * n_slots * head_dim * dtype_bytes    # roped q
+                + 2 * kv_heads * n_slots * head_dim * dtype_bytes  # fresh k/v
+                + n_slots * d_model * f32)                 # residual acc
+    if d_ff:
+        resident += 2 * n_slots * d_model * f32            # mlp norm + acc
+    bf = min(SUBBLOCK_FF_BLOCK, d_ff) if d_ff else 0
+    # every streamed operand owns its VMEM window for the WHOLE kernel —
+    # Mosaic allocates per operand, not per phase — so the streamed tiles
+    # SUM (each double-buffered), they don't max. This is what caps the
+    # fully-chained decode layer at big-D geometries: the attention
+    # sub-block alone fits where attn + the three MLP tiles together do
+    # not, and the planner then keeps the two-launch form.
+    tiles = (3 * head_dim * d_model                        # wq/wk/wv head tiles
+             + d_model * g * head_dim                      # out-proj group tile
+             + 2 * page_size * head_dim                    # k + v page blocks
+             + 3 * bf * d_model)                           # gate/up/down tiles
+    return resident + 2 * tiles * dtype_bytes              # double-buffered
+
+
+def attn_subblock_cost(n_slots: int, d_model: int, n_heads: int,
+                       kv_heads: int, head_dim: int, page_size: int,
+                       pages_per_request: int, dtype_bytes: int) -> dict:
+    """Score one serving attention sub-block chain (T==1 decode). The
+    decision-log dict mirrors ``subblock_cost``'s shape: VMEM feasibility,
+    the saved-boundary-bytes objective (dominated by the decomposition's
+    gathered contiguous cache), and est_unfused/fused_us with the unfused
+    side charged ``DECODE_UNFUSED_LAUNCHES_ATTN`` kernel launches."""
+    L = pages_per_request * page_size              # block-table window
+    qkv_w = (n_heads + 2 * kv_heads) * head_dim
+    flops = (2 * n_slots * d_model * qkv_w                 # q/k/v projections
+             + 2 * n_slots * n_heads * head_dim * L * 2    # scores + attn·V
+             + 2 * n_slots * n_heads * head_dim * d_model)  # out-projection
+    # interiors the unfused program round-trips between kernels: the normed
+    # rows, the q/k/v projections (pre + post rope), the attention output
+    # and the out-projection input — and, far larger, the decomposition's
+    # gathered (B, KV, L, hd) contiguous K/V cache (write + read, x2 pools)
+    gathered_bytes = 2 * 2 * n_slots * kv_heads * L * head_dim * dtype_bytes
+    interior_bytes = (2 * n_slots * (2 * d_model + 2 * qkv_w
+                                     + n_heads * head_dim) * dtype_bytes
+                      + gathered_bytes)
+    # boundary traffic both variants pay: the weights, the slot rows, and
+    # the touched K/V pages
+    boundary_bytes = ((qkv_w * d_model + d_model * n_heads * head_dim)
+                      * dtype_bytes
+                      + 2 * n_slots * d_model * dtype_bytes
+                      + 2 * n_slots * kv_heads * L * head_dim * dtype_bytes)
+    flop_us = flops / TPU_PEAK_FLOPS * 1e6
+    bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
+    unfused = (flop_us / SUBBLOCK_XLA_EFFICIENCY
+               + (boundary_bytes + interior_bytes) * bw_us_per_byte
+               + DECODE_UNFUSED_LAUNCHES_ATTN * SUBBLOCK_LAUNCH_OVERHEAD_US)
+    fused = (flop_us / SUBBLOCK_FUSED_EFFICIENCY
+             + boundary_bytes * bw_us_per_byte + SUBBLOCK_LAUNCH_OVERHEAD_US)
+    vmem = decode_subblock_vmem_bytes(n_slots, d_model, n_heads, kv_heads,
+                                      head_dim, page_size, 0, dtype_bytes)
+    return {"n_slots": n_slots, "d_model": d_model, "n_heads": n_heads,
+            "kv_heads": kv_heads, "head_dim": head_dim,
+            "context_window": L, "flops": flops,
+            "saved_boundary_bytes": interior_bytes,
+            "vmem_bytes_per_step": vmem,
+            "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
+            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+            "est_saved_us": round(unfused - fused, 3)}
+
+
+def decode_layer_cost(attn_cost: dict, mlp_cost: dict, n_slots: int,
+                      d_model: int, page_size: int, dtype_bytes: int) -> dict:
+    """Score chaining a planned attention sub-block with its MLP sub-block
+    into one ``nn.decode_layer`` launch. The chain adds two savings on top
+    of the parts: one fewer kernel launch, and the residual stream h₂
+    (the attention sub-block's output rows) never round-trips HBM between
+    the two megakernels. VMEM feasibility is re-checked for the COMBINED
+    staging — two individually-feasible halves can exceed the scoped
+    budget together, in which case the planner keeps the two-launch form."""
+    h2_roundtrip = 2 * n_slots * d_model * dtype_bytes
+    bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
+    saved = (SUBBLOCK_LAUNCH_OVERHEAD_US + h2_roundtrip * bw_us_per_byte)
+    vmem = decode_subblock_vmem_bytes(
+        n_slots, d_model, attn_cost["n_heads"], attn_cost["kv_heads"],
+        attn_cost["head_dim"], page_size, mlp_cost["d_ff"], dtype_bytes)
+    return {"n_slots": n_slots, "d_model": d_model,
+            "d_ff": mlp_cost["d_ff"], "context_window":
+            attn_cost["context_window"],
+            "saved_boundary_bytes": h2_roundtrip,
+            "saved_launches": 1,
+            "vmem_bytes_per_step": vmem,
+            "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
+            "est_saved_us": round(
+                attn_cost["est_saved_us"] + mlp_cost["est_saved_us"] + saved,
+                3)}
 
 
 def horizontal_merge_profitable(m_tokens: int, out_features) -> bool:

@@ -76,6 +76,13 @@ BLOCK_DECISION_KINDS = {
     "unclaimed": "no executor claims the fused composite (checker refused)",
     "rebuild-mismatch": "the composite retrace produced different output "
                         "metadata than the original chain (kept unfused)",
+    "chained": "a planned nn.attn_subblock and its adjoining nn.mlp_subblock "
+               "fused into one nn.decode_layer composite — one launch per "
+               "layer per decoded token",
+    "chain-blocked": "the attention sub-block planned but could not chain "
+                     "(no adjoining MLP sub-block over the same residual "
+                     "stream, mismatched eps, or an output consumed "
+                     "in between); the layer keeps the two-launch form",
 }
 
 
@@ -593,11 +600,13 @@ def optimizer_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
 
 _ADD_IDS = (PrimIDs.ADD, "ops.add")
 _MUL_IDS = (PrimIDs.MUL, "ops.mul")
+_SUB_IDS = (PrimIDs.SUB, "ops.sub")
 
 
-def _record_block(decision: str, reason: str, cost: dict | None) -> None:
+def _record_block(decision: str, reason: str, cost: dict | None,
+                  op: str = "nn.mlp_subblock") -> None:
     assert decision in BLOCK_DECISION_KINDS, decision
-    _decisions.record("block", "nn.mlp_subblock", None, decision, reason, cost=cost)
+    _decisions.record("block", op, None, decision, reason, cost=cost)
 
 
 def _plain_linear(b: BoundSymbol):
@@ -626,40 +635,55 @@ def _chain_act(b: BoundSymbol) -> str | None:
 
 def block_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
     """The block-level megakernel planner (ROADMAP item 3 / FlashFuser-class
-    fusion scale): walk the trace's dataflow for whole transformer MLP
-    sub-block chains —
+    fusion scale), three staged dataflow walks:
 
-        add(residual, attn_out) → rms_norm → {linear→act, linear} → mul
-        → linear → add
+    1. :func:`_attn_block_pass` — the T==1 serving decode path: chains of
+       ``rms_norm → qkv projections → rope → K/V page writes →
+       nn.paged_decode_attention → out-projection`` become ONE
+       ``nn.attn_subblock`` composite (pool scatter included; block tables
+       and lengths ride to the claimed kernel as scalar-prefetch operands).
+    2. The original MLP walk — ``add(residual, x) → rms_norm →
+       {linear→act, linear} → mul → linear → add`` becomes
+       ``nn.mlp_subblock``; in a decode trace the residual add it absorbs
+       is the attention-out add, scored decode-aware
+       (``subblock_cost(decode=True)``) when its input comes from a planned
+       attention sub-block.
+    3. :func:`_decode_chain_pass` — a planned ``nn.attn_subblock`` whose
+       output feeds its layer's ``nn.mlp_subblock`` over the same residual
+       stream chains into one ``nn.decode_layer`` composite: one Pallas
+       launch per layer per decoded token.
 
-    — score each candidate with ``cost_model.subblock_cost`` (VMEM-residency
-    feasibility + the saved-boundary-bytes objective) and rewrite accepted
-    chains into ONE ``nn.mlp_subblock`` composite claimed by the Pallas
-    executor as a single streamed-weight megakernel. Runs at two points:
-
-    - pre-autodiff on the loss sub-trace (``core.transforms``
-      ``inline_value_and_grad``), so the composite's VJP rule keeps BOTH
-      directions claimable in training traces (backward emits the
-      equally-claimable ``nn.mlp_subblock_bwd``);
-    - in ``transform_for_execution`` for inference traces (no autodiff, so
-      the composite-level chain survives to the execution pipeline).
+    MLP planning runs at two points (pre-autodiff on the loss sub-trace via
+    ``plan_blocks_for_autodiff`` so the VJP rule fires, and in
+    ``transform_for_execution`` for inference traces); the attention and
+    chaining stages only ever fire on decode traces (their anchor,
+    ``nn.paged_decode_attention`` at T==1, cannot appear under autodiff).
 
     Every verdict — chain found, boundary chosen, VMEM-infeasible,
-    cost-rejected, escape-blocked — lands in ``CompileStats.last_decisions``
-    with the cost-model numbers (``observe.explain()``'s "block planner"
-    section); the kinds are enumerated in :data:`BLOCK_DECISION_KINDS`.
-    ``block_fusion=True`` forces planning past the cost/VMEM gates (test and
-    interpret-mode use), ``False`` disables the pass, unset lets the cost
-    model decide. Dist-annotated operands are never planned across shards.
+    cost-rejected, escape-blocked, chained — lands in
+    ``CompileStats.last_decisions`` with the cost-model numbers
+    (``observe.explain()``'s "block planner" section); the kinds are
+    enumerated in :data:`BLOCK_DECISION_KINDS`. ``block_fusion=True``
+    forces planning past the cost/VMEM gates (test and interpret-mode use),
+    ``False`` disables the pass, unset lets the cost model decide.
+    Dist-annotated operands are never planned across shards.
     """
     enabled = get_compile_option(
         "block_fusion",
-        "plan whole transformer MLP sub-block chains into single claimed "
-        "megakernels (nn.mlp_subblock): True = always (skips the cost/VMEM "
-        "gates), False = never, unset = cost-model decision",
+        "plan whole transformer sub-block chains into single claimed "
+        "megakernels (nn.mlp_subblock / nn.attn_subblock, chained into "
+        "nn.decode_layer on the T==1 serving path): True = always (skips "
+        "the cost/VMEM gates), False = never, unset = cost-model decision",
         None)
     if enabled is False or not executors:
         return trc
+    trc = _attn_block_pass(trc, executors, enabled)
+    trc = _mlp_block_pass(trc, executors, enabled)
+    return _decode_chain_pass(trc, executors, enabled)
+
+
+def _mlp_block_pass(trc: TraceCtx, executors, enabled) -> TraceCtx:
+    """The MLP sub-block walk (stage 2 of :func:`block_fusion_pass`)."""
     bsyms = trc.bound_symbols
     # cheap anchor scan: the chain needs a composite-level rms_norm AND
     # composite-level linears (post-autodiff traces are prim-level for the
@@ -796,9 +820,18 @@ def block_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
         n_tokens = 1
         for d in h.shape[:-1]:
             n_tokens *= int(d)
+        # serving-decode context: when the residual add absorbs a planned
+        # attention sub-block's output, this is a T==1 decode layer — every
+        # GEMM of the unfused program is its own tiny-M launch, so the cost
+        # model charges them (subblock_cost(decode=True)); the chaining
+        # stage then fuses the pair into nn.decode_layer
+        decode_ctx = any(
+            bsyms[producer[p.name]].sym.id == "nn.attn_subblock"
+            for p in (residual, xx) if p.name in producer)
         cost = dict(cost_model.subblock_cost(
             n_tokens, int(w_gate.shape[1]), int(w_gate.shape[0]),
-            h.dtype.bytes), chain=h.name, act=act, ops=len(chain))
+            h.dtype.bytes, decode=decode_ctx), chain=h.name, act=act,
+            ops=len(chain))
         # --- verdicts (phase 2) --------------------------------------------
         # exclusivity: every interior value must be consumed ONLY inside the
         # chain and must not be a trace output — the megakernel does not
@@ -866,16 +899,506 @@ def block_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
 
     if not replacements:
         return trc
+    return _rebuild_trace(trc, replacements, dropped,
+                          f"Block fusion planner ({n_planned} sub-block "
+                          f"megakernels)")
+
+
+# ---------------------------------------------------------------------------
+# serving decode-layer planning: the attention sub-block walk (stage 1) and
+# the attn+mlp -> nn.decode_layer chaining stage (stage 3)
+# ---------------------------------------------------------------------------
+
+_PAGED_ID = "nn.paged_decode_attention"
+
+
+def _single_out(b: BoundSymbol):
+    outs = b.flat_proxy_outs()
+    return outs[0] if len(outs) == 1 else None
+
+
+def _dataflow(trc: TraceCtx):
+    """(producer index, consumer indices, trace-output names) maps."""
+    from thunder_tpu.core.pytree import tree_flatten
+
+    producer: dict[str, int] = {}
+    consumers: dict[str, list[int]] = {}
+    for i, b in enumerate(trc.bound_symbols):
+        for p in b.flat_proxy_args():
+            consumers.setdefault(p.name, []).append(i)
+        for o in b.flat_proxy_outs():
+            producer.setdefault(o.name, i)
+    out_names = {o.name for o in tree_flatten(trc.output)[0]
+                 if isinstance(o, Proxy)}
+    return producer, consumers, out_names
+
+
+def _producer_bsym(bsyms, producer, p):
+    i = producer.get(getattr(p, "name", None))
+    return (i, bsyms[i]) if i is not None else (None, None)
+
+
+def _match_rope(bsyms, producer, val):
+    """Match the GPT-NeoX half-rotation ``models.llama._apply_rope`` emits,
+    ending at ``val``::
+
+        cat([x1*cos - x2*sin, x2*cos + x1*sin], -1)
+
+    with ``x1``/``x2`` the lower/upper half slices of ONE base tensor (the
+    slice starts are checked). The structure is matched EXACTLY, operand
+    roles and all — a trace using a different rotation (future rope
+    scaling) must stay unfused rather than be silently rewritten to this
+    formula. Returns ``(base, cos, sin, matched_indices)`` or None."""
+    ci, cb = _producer_bsym(bsyms, producer, val)
+    if cb is None or cb.sym.id is not PrimIDs.CAT or not cb.args:
+        return None
+    parts = cb.args[0]
+    if not isinstance(parts, (list, tuple)) or len(parts) != 2:
+        return None
+    dim = cb.args[1] if len(cb.args) > 1 else cb.kwargs.get("dim", -1)
+    if dim not in (-1, val.ndim - 1):
+        return None
+    si, sb = _producer_bsym(bsyms, producer, parts[0])
+    ai, ab = _producer_bsym(bsyms, producer, parts[1])
+    if sb is None or ab is None or sb.sym.id not in _SUB_IDS \
+            or ab.sym.id not in _ADD_IDS:
+        return None
+    if len(sb.args) != 2 or len(ab.args) != 2:
+        return None
+    muls = []
+    for operand in (*sb.args, *ab.args):
+        mi, mb = _producer_bsym(bsyms, producer, operand)
+        if mb is None or mb.sym.id not in _MUL_IDS or len(mb.args) != 2 \
+                or not all(isinstance(a, TensorProxy) for a in mb.args):
+            return None
+        muls.append((mi, mb))
+    (i1, m1), (i2, m2), (i3, m3), (i4, m4) = muls
+    x1, cos = m1.args       # rx1 = x1*cos - x2*sin
+    x2, sin = m2.args
+    x2b, cosb = m3.args     # rx2 = x2*cos + x1*sin
+    x1b, sinb = m4.args
+    if x1.name != x1b.name or x2.name != x2b.name \
+            or cos.name != cosb.name or sin.name != sinb.name \
+            or x1.name == x2.name:
+        return None
+    j1, sl1 = _producer_bsym(bsyms, producer, x1)
+    j2, sl2 = _producer_bsym(bsyms, producer, x2)
+    if sl1 is None or sl2 is None or sl1.sym.id is not PrimIDs.SLICE \
+            or sl2.sym.id is not PrimIDs.SLICE:
+        return None
+    base = sl1.args[0]
+    if not isinstance(base, TensorProxy) \
+            or getattr(sl2.args[0], "name", None) != base.name:
+        return None
+    hd2 = int(x1.shape[-1])
+    try:
+        if int(sl1.args[1][-1]) != 0 or int(sl2.args[1][-1]) != hd2:
+            return None
+    except (TypeError, IndexError, ValueError):
+        return None
+    return base, cos, sin, {ci, si, ai, i1, i2, i3, i4, j1, j2}
+
+
+def _match_head_proj(bsyms, producer, base):
+    """``base = transpose(reshape(nn.linear(x, w)), (0, 2, 1, 3))`` — the
+    runner's head-split projection. Returns ``(x, w, indices)`` or None."""
+    ti, tb = _producer_bsym(bsyms, producer, base)
+    if tb is None or tb.sym.id is not PrimIDs.TRANSPOSE:
+        return None
+    perm = tb.args[1] if len(tb.args) > 1 else tb.kwargs.get("perm")
+    if tuple(perm or ()) != (0, 2, 1, 3):
+        return None
+    ri, rb = _producer_bsym(bsyms, producer, tb.args[0])
+    if rb is None or rb.sym.id is not PrimIDs.RESHAPE:
+        return None
+    li, lb = _producer_bsym(bsyms, producer, rb.args[0])
+    if lb is None:
+        return None
+    facts = _plain_linear(lb)
+    if facts is None:
+        return None
+    return facts[0], facts[1], {ti, ri, li}
+
+
+def _match_pool_write(bsyms, producer, pool_out):
+    """Match the paged K/V append ``ops.nn.decode_row_write`` emits (via the
+    serving runner)::
+
+        pool_out = reshape(scatter(reshape(pool_in),
+                                   broadcast(reshape(write_pos)),
+                                   transpose(squeeze(rows), (1, 0, 2)), 1))
+
+    Returns ``(pool_in, write_pos, rows, indices)`` or None."""
+    r2i, r2b = _producer_bsym(bsyms, producer, pool_out)
+    if r2b is None or r2b.sym.id is not PrimIDs.RESHAPE:
+        return None
+    sci, scb = _producer_bsym(bsyms, producer, r2b.args[0])
+    if scb is None or scb.sym.id is not PrimIDs.SCATTER or len(scb.args) < 4:
+        return None
+    flat, idx, src = scb.args[0], scb.args[1], scb.args[2]
+    if int(scb.args[3]) != 1:
+        return None
+    r1i, r1b = _producer_bsym(bsyms, producer, flat)
+    if r1b is None or r1b.sym.id is not PrimIDs.RESHAPE \
+            or not isinstance(r1b.args[0], TensorProxy):
+        return None
+    pool_in = r1b.args[0]
+    # the scatter-index build (reshape(write_pos) -> broadcast) is SHARED
+    # across the k/v writes of every layer when the tracer dedups identical
+    # subexpressions — it is input-adjacent glue, not an exclusive chain
+    # interior: resolve write_pos through it but leave the two bsyms out of
+    # the matched set (the composite re-emits its own; DCE drops orphans)
+    _, bib = _producer_bsym(bsyms, producer, idx)
+    if bib is None or bib.sym.id is not PrimIDs.BROADCAST_IN_DIM:
+        return None
+    _, r3b = _producer_bsym(bsyms, producer, bib.args[0])
+    if r3b is None or r3b.sym.id is not PrimIDs.RESHAPE \
+            or not isinstance(r3b.args[0], TensorProxy) \
+            or r3b.args[0].ndim != 1:
+        return None
+    write_pos = r3b.args[0]
+    tri, trb = _producer_bsym(bsyms, producer, src)
+    if trb is None or trb.sym.id is not PrimIDs.TRANSPOSE:
+        return None
+    perm = trb.args[1] if len(trb.args) > 1 else trb.kwargs.get("perm")
+    if tuple(perm or ()) != (1, 0, 2):
+        return None
+    sqi, sqb = _producer_bsym(bsyms, producer, trb.args[0])
+    if sqb is None or sqb.sym.id is not PrimIDs.SQUEEZE \
+            or not isinstance(sqb.args[0], TensorProxy):
+        return None
+    rows = sqb.args[0]
+    return pool_in, write_pos, rows, {r2i, sci, r1i, tri, sqi}
+
+
+def _rebuild_trace(trc, replacements, dropped, provenance):
     new = from_trace(trc)
     out: list[BoundSymbol] = []
-    for i, b in enumerate(bsyms):
+    for i, b in enumerate(trc.bound_symbols):
         if i in replacements:
             out.extend(replacements[i])
         elif i not in dropped:
             out.append(b)
     new.bound_symbols = out
-    new.set_provenance(f"Block fusion planner ({n_planned} sub-block megakernels)")
+    new.set_provenance(provenance)
     return new
+
+
+def _attn_block_pass(trc: TraceCtx, executors, enabled) -> TraceCtx:
+    """The serving attention sub-block walk (stage 1 of
+    :func:`block_fusion_pass`): anchor every T==1
+    ``nn.paged_decode_attention``, match backwards through the rope /
+    head-split projections / K/V page writes to the ``nn.rms_norm`` head,
+    and forwards through the out-projection; rewrite legal, cost-approved
+    chains into ONE ``nn.attn_subblock`` composite (outputs: the
+    pre-residual projection + the two updated page pools)."""
+    bsyms = trc.bound_symbols
+    ids = {b.sym.id for b in bsyms}
+    if _PAGED_ID not in ids or "nn.rms_norm" not in ids:
+        return trc
+    producer, consumers, out_names = _dataflow(trc)
+    replacements: dict[int, list[BoundSymbol]] = {}
+    dropped: set[int] = set()
+    used: set[int] = set()
+    n_planned = 0
+    for pi, pb in enumerate(bsyms):
+        if pb.sym.id != _PAGED_ID or pi in used or len(pb.args) < 5:
+            continue
+        q_arg, kp_u, vp_u, bt, ln = pb.args[:5]
+        if not all(isinstance(t, TensorProxy)
+                   for t in (q_arg, kp_u, vp_u, bt, ln)):
+            continue
+        if q_arg.ndim != 4 or int(q_arg.shape[2]) != 1:
+            continue                      # decode only; prefill stays unfused
+        scale = pb.kwargs.get("scale",
+                              pb.args[5] if len(pb.args) > 5 else None)
+        rope_q = _match_rope(bsyms, producer, q_arg)
+        if rope_q is None:
+            continue
+        q0, cos, sin, rq_idx = rope_q
+        pq = _match_head_proj(bsyms, producer, q0)
+        if pq is None:
+            continue
+        x_in, wq, pq_idx = pq
+        kw_ = _match_pool_write(bsyms, producer, kp_u)
+        vw_ = _match_pool_write(bsyms, producer, vp_u)
+        if kw_ is None or vw_ is None:
+            continue
+        k_pool, wp_k, k_rows, kw_idx = kw_
+        v_pool, wp_v, v_rows, vw_idx = vw_
+        if wp_k.name != wp_v.name or k_pool.name == v_pool.name:
+            continue
+        rope_k = _match_rope(bsyms, producer, k_rows)
+        if rope_k is None:
+            continue
+        k0, cos_k, sin_k, rk_idx = rope_k
+        if cos_k.name != cos.name or sin_k.name != sin.name:
+            continue
+        pk = _match_head_proj(bsyms, producer, k0)
+        pv = _match_head_proj(bsyms, producer, v_rows)
+        if pk is None or pv is None:
+            continue
+        xk, wk, pk_idx = pk
+        xv, wv, pv_idx = pv
+        if xk.name != x_in.name or xv.name != x_in.name:
+            continue
+        ri, rb = _producer_bsym(bsyms, producer, x_in)
+        if rb is None or rb.sym.id != "nn.rms_norm":
+            continue
+        h = rb.args[0] if rb.args else None
+        w_norm = rb.args[1] if len(rb.args) > 1 else rb.kwargs.get("weight")
+        if not (isinstance(h, TensorProxy) and isinstance(w_norm, TensorProxy)):
+            continue
+        dim = rb.kwargs.get("dim", rb.args[3] if len(rb.args) > 3 else -1)
+        if dim not in (-1, h.ndim - 1):
+            continue
+        eps = rb.kwargs.get("eps", rb.args[2] if len(rb.args) > 2 else 1e-5)
+        # forward: attn -> transpose(0,2,1,3) -> reshape -> linear(., wo)
+        aout = _single_out(pb)
+        if aout is None:
+            continue
+        acons = set(consumers.get(aout.name, ()))
+        if len(acons) != 1:
+            continue
+        t2i = next(iter(acons))
+        t2b = bsyms[t2i]
+        if t2b.sym.id is not PrimIDs.TRANSPOSE:
+            continue
+        perm = t2b.args[1] if len(t2b.args) > 1 else t2b.kwargs.get("perm")
+        if tuple(perm or ()) != (0, 2, 1, 3):
+            continue
+        t2o = _single_out(t2b)
+        r4cons = set(consumers.get(t2o.name, ())) if t2o is not None else set()
+        if len(r4cons) != 1:
+            continue
+        r4i = next(iter(r4cons))
+        r4b = bsyms[r4i]
+        if r4b.sym.id is not PrimIDs.RESHAPE:
+            continue
+        r4o = _single_out(r4b)
+        lcons = set(consumers.get(r4o.name, ())) if r4o is not None else set()
+        if len(lcons) != 1:
+            continue
+        li = next(iter(lcons))
+        lfacts = _plain_linear(bsyms[li])
+        if lfacts is None or lfacts[0].name != r4o.name:
+            continue
+        wo = lfacts[1]
+        proj = _single_out(bsyms[li])
+        if proj is None:
+            continue
+        chain = ({pi, ri, t2i, r4i, li} | rq_idx | pq_idx | kw_idx | vw_idx
+                 | rk_idx | pk_idx | pv_idx)
+        if chain & used:
+            continue
+        KV, P, ps, hd = (int(d) for d in kp_u.shape)
+        if wq.shape[0] % hd or wk.shape[0] % hd:
+            continue
+        H = int(wq.shape[0]) // hd
+        S = int(h.shape[0])
+        D = int(h.shape[-1])
+        npg = int(bt.shape[1])
+        cost = dict(cost_model.attn_subblock_cost(
+            S, D, H, KV, hd, ps, npg, h.dtype.bytes),
+            chain=h.name, ops=len(chain))
+        # exclusivity: interior values consumed only inside the chain, and
+        # never trace outputs — the composite's outputs (the projection and
+        # the two updated pools) are the only values allowed to escape
+        comp_outs = {proj.name, kp_u.name, vp_u.name}
+        escaped = None
+        for bi in sorted(chain):
+            for o in bsyms[bi].flat_proxy_outs():
+                if o.name in comp_outs:
+                    continue
+                if o.name in out_names or set(consumers.get(o.name, ())) - chain:
+                    escaped = o.name
+                    break
+            if escaped:
+                break
+        if escaped is not None:
+            _record_block("interior-escapes",
+                          f"{escaped} is consumed outside the chain", cost,
+                          op="nn.attn_subblock")
+            continue
+        if any(_dist_annotated(p) for p in
+               (h, w_norm, wq, wk, wv, wo, k_pool, v_pool)):
+            _record_block("dist-annotated",
+                          "operands carry distributed-parallel metadata; "
+                          "never planned across shards", cost,
+                          op="nn.attn_subblock")
+            continue
+        if enabled is not True and not cost["vmem_feasible"]:
+            _record_block("vmem-infeasible",
+                          "per-grid-step staging exceeds the scoped-VMEM "
+                          "budget", cost, op="nn.attn_subblock")
+            continue
+        if enabled is not True and not cost_model.subblock_profitable(cost):
+            _record_block("cost-rejected",
+                          "saved boundary bytes + launch amortization lose "
+                          "to the modeled MXU-efficiency handicap "
+                          "(need est_saved_us > 0)", cost,
+                          op="nn.attn_subblock")
+            continue
+        comp_args = (h, w_norm, wq, wk, wv, wo, cos, sin, k_pool, v_pool,
+                     bt, ln, wp_k)
+        comp_kwargs = {"eps": eps}
+        if scale is not None:
+            comp_kwargs["scale"] = scale
+        if not _some_executor_claims(executors, "nn.attn_subblock",
+                                     comp_args, comp_kwargs,
+                                     (proj, kp_u, vp_u)):
+            _record_block("unclaimed",
+                          "no executor claims the fused composite "
+                          "(checker refused)", cost, op="nn.attn_subblock")
+            continue
+        from thunder_tpu.ops import nn as tnn
+
+        repl = _build_composite(trc, tnn.attn_subblock, comp_args,
+                                comp_kwargs, [proj, kp_u, vp_u])
+        if not repl:
+            _record_block("rebuild-mismatch",
+                          "composite retrace changed output metadata", cost,
+                          op="nn.attn_subblock")
+            continue
+        last = max(chain)
+        repl[-1].header = (f"{BLOCK_MARKER}: {len(chain)}-op attention "
+                           f"sub-block (qkv+rope+page-write+paged-attention"
+                           f"+out-proj) planned as one megakernel")
+        _record_block("planned",
+                      "forced by block_fusion=True" if enabled is True
+                      else "cost model: interior bytes + launch "
+                           "amortization beat the fused-path overheads",
+                      cost, op="nn.attn_subblock")
+        _observe.inc("fusion.block_fusions")
+        replacements[last] = repl
+        dropped.update(chain - {last})
+        used |= chain
+        n_planned += 1
+
+    if not replacements:
+        return trc
+    return _rebuild_trace(trc, replacements, dropped,
+                          f"Attention sub-block planner ({n_planned} chains)")
+
+
+def _decode_chain_pass(trc: TraceCtx, executors, enabled) -> TraceCtx:
+    """The chaining stage (stage 3 of :func:`block_fusion_pass`): a planned
+    ``nn.attn_subblock`` whose projection feeds its layer's
+    ``nn.mlp_subblock`` as the attention-out summand, over the SAME
+    residual stream, fuses into one ``nn.decode_layer`` composite — one
+    Pallas launch per layer per decoded token. Chaining never changes
+    numerics (the composite's decomposition IS the two sub-blocks); the
+    only gate besides claimability is combined VMEM feasibility, since two
+    individually-feasible halves can exceed the scoped budget together."""
+    bsyms = trc.bound_symbols
+    if not any(b.sym.id == "nn.attn_subblock" for b in bsyms):
+        return trc
+    producer, consumers, out_names = _dataflow(trc)
+    replacements: dict[int, list[BoundSymbol]] = {}
+    dropped: set[int] = set()
+    n_chained = 0
+    for ai, ab in enumerate(bsyms):
+        if ab.sym.id != "nn.attn_subblock" or len(ab.args) != 13:
+            continue
+        outs = ab.flat_proxy_outs()
+        if len(outs) != 3:
+            continue
+        proj, kp, vp = outs
+        h = ab.args[0]
+        base_cost = {"chain": getattr(h, "name", "?")}
+        mb, mi = None, None
+        pcons = set(consumers.get(proj.name, ()))
+        if proj.name not in out_names and len(pcons) == 1:
+            ci = next(iter(pcons))
+            cand = bsyms[ci]
+            if cand.sym.id == "nn.mlp_subblock" and len(cand.args) >= 6:
+                residual, xx = cand.args[0], cand.args[1]
+                if getattr(residual, "name", None) == h.name \
+                        and getattr(xx, "name", None) == proj.name:
+                    mb, mi = cand, ci
+        if mb is None:
+            _record_block("chain-blocked",
+                          "no adjoining nn.mlp_subblock consumes the "
+                          "attention output over the same residual stream",
+                          base_cost, op="nn.decode_layer")
+            continue
+        eps_a = ab.kwargs.get("eps", 1e-5)
+        if eps_a != mb.kwargs.get("eps", 1e-5):
+            _record_block("chain-blocked",
+                          "the two sub-blocks normalize with different eps",
+                          base_cost, op="nn.decode_layer")
+            continue
+        # the fused composite lands at the MLP's position: the pools it
+        # produces must not be consumed before that
+        if any(j < mi for o in (kp, vp) for j in consumers.get(o.name, ())):
+            _record_block("chain-blocked",
+                          "an updated page pool is consumed before the "
+                          "layer's MLP sub-block", base_cost,
+                          op="nn.decode_layer")
+            continue
+        act = mb.kwargs.get("act", "silu")
+        scale = ab.kwargs.get("scale")
+        kp_in = ab.args[8]
+        KV, P, ps, hd = (int(d) for d in kp_in.shape)
+        S = int(h.shape[0])
+        D = int(h.shape[-1])
+        H = int(ab.args[2].shape[0]) // hd
+        npg = int(ab.args[10].shape[1])
+        w_gate = mb.args[3]
+        F = int(w_gate.shape[0])
+        acost = cost_model.attn_subblock_cost(S, D, H, KV, hd, ps, npg,
+                                              h.dtype.bytes)
+        mcost = cost_model.subblock_cost(S, D, F, h.dtype.bytes, decode=True)
+        cost = dict(cost_model.decode_layer_cost(acost, mcost, S, D, ps,
+                                                 h.dtype.bytes),
+                    chain=h.name)
+        if enabled is not True and not cost["vmem_feasible"]:
+            _record_block("vmem-infeasible",
+                          "the combined attention+MLP staging exceeds the "
+                          "scoped-VMEM budget; keeping the two-launch form",
+                          cost, op="nn.decode_layer")
+            continue
+        comp_args = tuple(ab.args) + (mb.args[2], mb.args[3], mb.args[4],
+                                      mb.args[5])
+        comp_kwargs = {"act": act, "eps": eps_a}
+        if scale is not None:
+            comp_kwargs["scale"] = scale
+        m_out = _single_out(mb)
+        if m_out is None:
+            continue
+        if not _some_executor_claims(executors, "nn.decode_layer",
+                                     comp_args, comp_kwargs,
+                                     (m_out, kp, vp)):
+            _record_block("unclaimed",
+                          "no executor claims the fused composite "
+                          "(checker refused); keeping the two-launch form",
+                          cost, op="nn.decode_layer")
+            continue
+        from thunder_tpu.ops import nn as tnn
+
+        repl = _build_composite(trc, tnn.decode_layer, comp_args,
+                                comp_kwargs, [m_out, kp, vp])
+        if not repl:
+            _record_block("rebuild-mismatch",
+                          "composite retrace changed output metadata", cost,
+                          op="nn.decode_layer")
+            continue
+        repl[-1].header = (f"{BLOCK_MARKER}: attention + MLP sub-blocks "
+                           f"chained into one decode-layer launch")
+        _record_block("chained",
+                      "forced by block_fusion=True" if enabled is True
+                      else "one launch per layer: chaining saves a launch "
+                           "and keeps the residual stream in VMEM",
+                      cost, op="nn.decode_layer")
+        _observe.inc("fusion.decode_layer_chains")
+        replacements[mi] = repl
+        dropped.add(ai)
+        n_chained += 1
+
+    if not replacements:
+        return trc
+    return _rebuild_trace(trc, replacements, dropped,
+                          f"Decode-layer chaining ({n_chained} layers)")
 
 
 def plan_blocks_for_autodiff(trc: TraceCtx) -> TraceCtx:

@@ -1390,6 +1390,469 @@ def _paged_decode_checker(q, k_pages, v_pages, block_tables, lengths,
 
 
 # ---------------------------------------------------------------------------
+# whole-decode-layer megakernel (serving T==1): ONE launch per transformer
+# layer per decoded token, claimed from the nn.decode_layer composite the
+# block planner's chaining stage builds (nn.attn_subblock alone gets the
+# same kernel minus the MLP phases — the quarantine fallback's middle rung).
+#
+# The grid is ONE flattened sequential dimension whose steps encode three
+# phases; index maps decode the phase from the step index and pin every
+# operand not used by the current phase to a constant block (revisiting the
+# same block index means Mosaic skips the redundant DMA):
+#
+#   phase QKV  (H + 2*KV steps, one head each): at step 0 the whole slot
+#     batch's rows are normalized into VMEM scratch; each step streams one
+#     head's weight tile, runs the (S, D) x (D, hd) projection, applies the
+#     rope half-rotation in-register, and parks the roped rows in scratch
+#     (k/v rows are also emitted as outputs for the page-pool append).
+#   phase ATTN (S * KV * npg steps): the PR 10 scalar-prefetch discipline —
+#     each step's K/V page is selected by bt[b, p] inside the BlockSpec
+#     index map, online-softmax (m, l, acc) carries across the sequential
+#     page dimension, pages wholly past a request's length skip compute via
+#     pl.when. The page that holds THIS token's row is patched from the
+#     fresh-row scratch (jnp.where on the row iota), so the kernel never
+#     re-reads its own append from HBM. At each request's last page the
+#     finalized head group is immediately projected through its wo slice
+#     and accumulated onto the residual rows — the out-projection rides the
+#     attention phase, no separate pass.
+#   phase MLP  (F / bf steps, decode_layer only): the pallas_mlp_subblock
+#     recipe at row-block = the whole slot batch — second norm from the
+#     residual accumulator at the first step, gate/up/down tiles streamed,
+#     final step stores h2 + mlp.
+#
+# The one HBM write the kernel does NOT absorb is the page-pool append
+# itself: the fresh K/V rows leave as (KV, S, hd) outputs and a plain jax
+# scatter places them (same replace semantics as the decomposition's
+# prims.scatter) — identical traffic to the unfused path, fused into the
+# same XLA program, and the attention phase never waits on it thanks to the
+# VMEM patch.
+# ---------------------------------------------------------------------------
+
+
+def _decode_qkv_phase(i, h_ref, wn1_ref, wq_ref, wk_ref, wv_ref, cos_ref,
+                      sin_ref, kr_ref, vr_ref, xn_ref, q_ref, kf_ref, vf_ref,
+                      hacc_ref, *, H: int, KV: int, hd: int, eps: float,
+                      cast, init_h: bool):
+    """Phase QKV step: norm-once init, then one head's projection + rope."""
+    @pl.when(i == 0)
+    def _init():
+        h = h_ref[...]
+        h32 = h.astype(jnp.float32)
+        ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
+        xn_ref[...] = ((h32 * jax.lax.rsqrt(ms + eps)).astype(cast)
+                       * wn1_ref[...]).astype(xn_ref.dtype)
+        hacc_ref[...] = h32 if init_h else jnp.zeros_like(hacc_ref)
+
+    xn = xn_ref[...]
+    hd2 = hd // 2
+    c = cos_ref[...]
+    s = sin_ref[...]
+
+    def rope(t):
+        t1, t2 = t[:, :hd2], t[:, hd2:]
+        return jnp.concatenate([t1 * c - t2 * s, t2 * c + t1 * s], axis=-1)
+
+    @pl.when(i < H)
+    def _q():
+        t = jax.lax.dot_general(xn, wq_ref[...], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32).astype(cast)
+        pl.store(q_ref, (pl.ds(jnp.clip(i, 0, H - 1), 1),
+                         slice(None), slice(None)), rope(t)[None])
+
+    @pl.when((i >= H) & (i < H + KV))
+    def _k():
+        t = jax.lax.dot_general(xn, wk_ref[...], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32).astype(cast)
+        rk = rope(t)
+        pl.store(kf_ref, (pl.ds(jnp.clip(i - H, 0, KV - 1), 1),
+                          slice(None), slice(None)), rk[None])
+        kr_ref[...] = rk[None].astype(kr_ref.dtype)
+
+    @pl.when((i >= H + KV) & (i < H + 2 * KV))
+    def _v():
+        t = jax.lax.dot_general(xn, wv_ref[...], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32).astype(cast)
+        pl.store(vf_ref, (pl.ds(jnp.clip(i - H - KV, 0, KV - 1), 1),
+                          slice(None), slice(None)), t[None])
+        vr_ref[...] = t[None].astype(vr_ref.dtype)
+
+
+def _decode_attn_phase(i, off, n_att, wo_ref, kp_ref, vp_ref, ln_ref, q_ref,
+                       kf_ref, vf_ref, hacc_ref, m_ref, l_ref, acc_ref, *,
+                       KV: int, G: int, hd: int, ps: int, npg: int,
+                       scale: float, cast):
+    """Phase ATTN step: online softmax over one (request, kv_head, page)."""
+    t = jnp.clip(i - off, 0, n_att - 1)
+    b = t // (KV * npg)
+    rem = t - b * (KV * npg)
+    kvh = rem // npg
+    p = rem - kvh * npg
+    active = (i >= off) & (i < off + n_att)
+
+    @pl.when(active & (p == 0))
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ln = ln_ref[b]
+
+    @pl.when(active & (p * ps < ln))
+    def _compute():
+        qg = pl.load(q_ref, (pl.ds(kvh * G, G), pl.ds(b, 1),
+                             slice(None))).reshape(G, hd)
+        k = kp_ref[0, 0]                               # (ps, hd), bt-selected
+        v = vp_ref[0, 0]
+        # patch THIS token's row (position ln-1) from the fresh-row scratch:
+        # the HBM page still holds the pre-append contents
+        fp = ln - 1
+        row = jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
+        sel = (fp >= p * ps) & (fp < (p + 1) * ps) & (row == fp - p * ps)
+        fk = pl.load(kf_ref, (pl.ds(kvh, 1), pl.ds(b, 1),
+                              slice(None))).reshape(1, hd)
+        fv = pl.load(vf_ref, (pl.ds(kvh, 1), pl.ds(b, 1),
+                              slice(None))).reshape(1, hd)
+        k = jnp.where(sel, fk, k)
+        v = jnp.where(sel, fv, v)
+        s_ = jax.lax.dot_general(qg, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        col = p * ps + jax.lax.broadcasted_iota(jnp.int32, s_.shape, 1)
+        s_ = jnp.where(col < ln, s_, -jnp.inf)         # ragged tail mask
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s_, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(s_ - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(active & (p == npg - 1))
+    def _finalize():
+        l = l_ref[...]
+        lsafe = jnp.where(l == 0.0, 1.0, l)            # unreachable rows
+        attn = (acc_ref[...] / lsafe).astype(cast).reshape(1, G * hd)
+        contrib = jax.lax.dot_general(attn, wo_ref[...],
+                                      (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        prev = pl.load(hacc_ref, (pl.ds(b, 1), slice(None)))
+        pl.store(hacc_ref, (pl.ds(b, 1), slice(None)), prev + contrib)
+
+
+def _decode_mlp_phase(i, off, nf, wn2_ref, wg_ref, wu_ref, wd_ref, o_ref,
+                      hacc_ref, x2_ref, macc_ref, *, eps: float, act: str,
+                      cast):
+    """Phase MLP step: the mlp_subblock recipe at row-block = whole batch."""
+    f = i - off
+
+    @pl.when(f == 0)
+    def _init():
+        h2 = hacc_ref[...]
+        ms = jnp.mean(h2 * h2, axis=-1, keepdims=True)
+        x2_ref[...] = ((h2 * jax.lax.rsqrt(ms + eps)).astype(cast)
+                       * wn2_ref[...]).astype(x2_ref.dtype)
+        macc_ref[...] = jnp.zeros_like(macc_ref)
+
+    @pl.when(f >= 0)
+    def _body():
+        n = x2_ref[...]
+        gpre = jax.lax.dot_general(n, wg_ref[...], (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        ga = _ACT_IMPLS[act](gpre).astype(cast)
+        u = jax.lax.dot_general(n, wu_ref[...], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32).astype(cast)
+        macc_ref[...] += jax.lax.dot_general(ga * u, wd_ref[...],
+                                             (((1,), (1,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+
+    @pl.when(f == nf - 1)
+    def _store():
+        o_ref[...] = (hacc_ref[...] + macc_ref[...]).astype(o_ref.dtype)
+
+
+def _decode_layer_kernel(bt_ref, ln_ref, h_ref, wn1_ref, wq_ref, wk_ref,
+                         wv_ref, wo_ref, cos_ref, sin_ref, kp_ref, vp_ref,
+                         wn2_ref, wg_ref, wu_ref, wd_ref,
+                         o_ref, kr_ref, vr_ref,
+                         xn_ref, q_ref, kf_ref, vf_ref, hacc_ref,
+                         m_ref, l_ref, acc_ref, x2_ref, macc_ref, *,
+                         H, KV, G, hd, ps, npg, nf, eps, scale, act, cast):
+    i = pl.program_id(0)
+    OA = H + 2 * KV
+    n_att = pl.num_programs(0) - OA - nf
+    _decode_qkv_phase(i, h_ref, wn1_ref, wq_ref, wk_ref, wv_ref, cos_ref,
+                      sin_ref, kr_ref, vr_ref, xn_ref, q_ref, kf_ref, vf_ref,
+                      hacc_ref, H=H, KV=KV, hd=hd, eps=eps, cast=cast,
+                      init_h=True)
+    _decode_attn_phase(i, OA, n_att, wo_ref, kp_ref, vp_ref, ln_ref, q_ref,
+                       kf_ref, vf_ref, hacc_ref, m_ref, l_ref, acc_ref,
+                       KV=KV, G=G, hd=hd, ps=ps, npg=npg, scale=scale,
+                       cast=cast)
+
+    @pl.when(i >= OA + n_att)
+    def _mlp():
+        _decode_mlp_phase(i, OA + n_att, nf, wn2_ref, wg_ref, wu_ref, wd_ref,
+                          o_ref, hacc_ref, x2_ref, macc_ref, eps=eps, act=act,
+                          cast=cast)
+
+
+def _attn_subblock_kernel(bt_ref, ln_ref, h_ref, wn1_ref, wq_ref, wk_ref,
+                          wv_ref, wo_ref, cos_ref, sin_ref, kp_ref, vp_ref,
+                          o_ref, kr_ref, vr_ref,
+                          xn_ref, q_ref, kf_ref, vf_ref, hacc_ref,
+                          m_ref, l_ref, acc_ref, *,
+                          H, KV, G, hd, ps, npg, eps, scale, cast):
+    i = pl.program_id(0)
+    OA = H + 2 * KV
+    n_att = pl.num_programs(0) - OA
+    _decode_qkv_phase(i, h_ref, wn1_ref, wq_ref, wk_ref, wv_ref, cos_ref,
+                      sin_ref, kr_ref, vr_ref, xn_ref, q_ref, kf_ref, vf_ref,
+                      hacc_ref, H=H, KV=KV, hd=hd, eps=eps, cast=cast,
+                      init_h=False)
+    _decode_attn_phase(i, OA, n_att, wo_ref, kp_ref, vp_ref, ln_ref, q_ref,
+                       kf_ref, vf_ref, hacc_ref, m_ref, l_ref, acc_ref,
+                       KV=KV, G=G, hd=hd, ps=ps, npg=npg, scale=scale,
+                       cast=cast)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _store():
+        o_ref[...] = hacc_ref[...].astype(o_ref.dtype)  # pre-residual proj
+
+
+def _decode_call(h, w_norm, wq, wk, wv, wo, cos, sin, k_pages, v_pages,
+                 block_tables, lengths, write_pos, mlp=None, act="silu",
+                 eps=1e-5, scale=None):
+    """Shared wrapper: build the flattened phase grid, run the megakernel,
+    and append the fresh K/V rows to the pools with the decomposition's
+    replace-semantics scatter. ``mlp=(w_norm2, w_gate, w_up, w_down)``
+    selects the full decode-layer kernel; None the attention sub-block."""
+    S, T, D = h.shape
+    KV, P, ps, hd = k_pages.shape
+    H = wq.shape[0] // hd
+    G = H // KV
+    npg = block_tables.shape[1]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(hd)
+    cast = h.dtype
+    h2 = h.reshape(S, D)
+    cos2 = cos.reshape(S, hd // 2)
+    sin2 = sin.reshape(S, hd // 2)
+    OA = H + 2 * KV
+    n_att = S * KV * npg
+
+    def att_decode(i):
+        t = jnp.clip(i - OA, 0, n_att - 1)
+        b = t // (KV * npg)
+        rem = t - b * (KV * npg)
+        return b, rem // npg, rem - (rem // npg) * npg
+
+    def im_page(i, bt, ln):
+        b, kvh, p = att_decode(i)
+        return (kvh, bt[b, p], 0, 0)
+
+    def im_wo(i, bt, ln):
+        _, kvh, _ = att_decode(i)
+        return (0, kvh)
+
+    in_specs = [
+        pl.BlockSpec((S, D), lambda i, bt, ln: (0, 0)),            # h
+        pl.BlockSpec((D,), lambda i, bt, ln: (0,)),                # wn1
+        pl.BlockSpec((hd, D), lambda i, bt, ln: (jnp.clip(i, 0, H - 1), 0)),
+        pl.BlockSpec((hd, D),
+                     lambda i, bt, ln: (jnp.clip(i - H, 0, KV - 1), 0)),
+        pl.BlockSpec((hd, D),
+                     lambda i, bt, ln: (jnp.clip(i - H - KV, 0, KV - 1), 0)),
+        pl.BlockSpec((D, G * hd), im_wo),                          # wo
+        pl.BlockSpec((S, hd // 2), lambda i, bt, ln: (0, 0)),      # cos
+        pl.BlockSpec((S, hd // 2), lambda i, bt, ln: (0, 0)),      # sin
+        pl.BlockSpec((1, 1, ps, hd), im_page),                     # k pages
+        pl.BlockSpec((1, 1, ps, hd), im_page),                     # v pages
+    ]
+    operands = [h2, w_norm, wq, wk, wv, wo, cos2, sin2, k_pages, v_pages]
+    scratch = [
+        pltpu.VMEM((S, D), cast),          # normed rows
+        pltpu.VMEM((H, S, hd), cast),      # roped q
+        pltpu.VMEM((KV, S, hd), cast),     # fresh k rows
+        pltpu.VMEM((KV, S, hd), cast),     # fresh v rows
+        pltpu.VMEM((S, D), jnp.float32),   # residual accumulator
+        pltpu.VMEM((G, 1), jnp.float32),   # online-softmax m
+        pltpu.VMEM((G, 1), jnp.float32),   # online-softmax l
+        pltpu.VMEM((G, hd), jnp.float32),  # online-softmax acc
+    ]
+    if mlp is not None:
+        wn2, wg, wu, wd = mlp
+        F = wg.shape[0]
+        bf = _pick_block(F, _SUBBLOCK_FF_BUDGET)
+        nf = F // bf
+        OM = OA + n_att
+        in_specs += [
+            pl.BlockSpec((D,), lambda i, bt, ln: (0,)),            # wn2
+            pl.BlockSpec((bf, D),
+                         lambda i, bt, ln: (jnp.clip(i - OM, 0, nf - 1), 0)),
+            pl.BlockSpec((bf, D),
+                         lambda i, bt, ln: (jnp.clip(i - OM, 0, nf - 1), 0)),
+            pl.BlockSpec((D, bf),
+                         lambda i, bt, ln: (0, jnp.clip(i - OM, 0, nf - 1))),
+        ]
+        operands += [wn2, wg, wu, wd]
+        scratch += [pltpu.VMEM((S, D), cast),          # second norm rows
+                    pltpu.VMEM((S, D), jnp.float32)]   # mlp accumulator
+        kern = functools.partial(_decode_layer_kernel, H=H, KV=KV, G=G,
+                                 hd=hd, ps=ps, npg=npg, nf=nf, eps=eps,
+                                 scale=scale_v, act=act, cast=cast)
+        n_total = OM + nf
+    else:
+        kern = functools.partial(_attn_subblock_kernel, H=H, KV=KV, G=G,
+                                 hd=hd, ps=ps, npg=npg, eps=eps,
+                                 scale=scale_v, cast=cast)
+        n_total = OA + n_att
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                         # block_tables, lengths
+        grid=(n_total,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((S, D), lambda i, bt, ln: (0, 0)),
+            pl.BlockSpec((1, S, hd),
+                         lambda i, bt, ln: (jnp.clip(i - H, 0, KV - 1), 0, 0)),
+            pl.BlockSpec((1, S, hd),
+                         lambda i, bt, ln: (jnp.clip(i - H - KV, 0, KV - 1),
+                                            0, 0)),
+        ],
+        scratch_shapes=scratch,
+    )
+    out, k_rows, v_rows = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((S, D), cast),
+                   jax.ShapeDtypeStruct((KV, S, hd), cast),
+                   jax.ShapeDtypeStruct((KV, S, hd), cast)],
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
+    # the page-pool append stays a plain replace-semantics scatter in the
+    # same XLA program (identical traffic to the decomposition's
+    # prims.scatter; duplicate idle-slot positions all hit the reserved
+    # scratch page, any write wins)
+    wp = write_pos.astype(jnp.int32)
+    kp = k_pages.reshape(KV, P * ps, hd).at[:, wp].set(k_rows)
+    vp = v_pages.reshape(KV, P * ps, hd).at[:, wp].set(v_rows)
+    return (out.reshape(S, T, D), kp.reshape(KV, P, ps, hd),
+            vp.reshape(KV, P, ps, hd))
+
+
+def pallas_attn_subblock(h, w_norm, wq, wk, wv, wo, cos, sin, k_pages,
+                         v_pages, block_tables, lengths, write_pos,
+                         eps=1e-5, scale=None):
+    return _decode_call(h, w_norm, wq, wk, wv, wo, cos, sin, k_pages,
+                        v_pages, block_tables, lengths, write_pos,
+                        mlp=None, eps=eps, scale=scale)
+
+
+def pallas_decode_layer(h, attn_norm, wq, wk, wv, wo, cos, sin, k_pages,
+                        v_pages, block_tables, lengths, write_pos, mlp_norm,
+                        w_gate, w_up, w_down, act="silu", eps=1e-5,
+                        scale=None):
+    return _decode_call(h, attn_norm, wq, wk, wv, wo, cos, sin, k_pages,
+                        v_pages, block_tables, lengths, write_pos,
+                        mlp=(mlp_norm, w_gate, w_up, w_down), act=act,
+                        eps=eps, scale=scale)
+
+
+def _attn_subblock_checker(h, w_norm, wq, wk, wv, wo, cos, sin, k_pages,
+                           v_pages, block_tables, lengths, write_pos,
+                           eps=1e-5, scale=None):
+    if not _enabled():
+        return False
+    if h.ndim != 3 or int(h.shape[1]) != 1:
+        return False                       # decode-only: one row per slot
+    if k_pages.ndim != 4 or tuple(v_pages.shape) != tuple(k_pages.shape):
+        return False
+    KV, P, ps, hd = (int(d) for d in k_pages.shape)
+    if hd % 2:
+        return False
+    S, D = int(h.shape[0]), int(h.shape[-1])
+    if w_norm is None or getattr(w_norm, "ndim", 0) != 1 \
+            or int(w_norm.shape[0]) != D:
+        return False
+    if wq.ndim != 2 or int(wq.shape[1]) != D or int(wq.shape[0]) % hd:
+        return False
+    H = int(wq.shape[0]) // hd
+    if H % KV:
+        return False
+    if tuple(wk.shape) != (KV * hd, D) or tuple(wv.shape) != (KV * hd, D):
+        return False
+    if tuple(wo.shape) != (D, H * hd):
+        return False
+    if tuple(cos.shape) != (S, 1, 1, hd // 2) \
+            or tuple(sin.shape) != (S, 1, 1, hd // 2):
+        return False
+    # f32 norm/softmax/GEMM accumulation: reject f64 (x64 mode) rather than
+    # silently narrow; weights, tables and pools must share the row dtype
+    # (the kernel writes its fresh rows straight into the pools)
+    if not h.dtype.is_float or h.dtype.bytes > 4:
+        return False
+    if any(w.dtype != h.dtype
+           for w in (w_norm, wq, wk, wv, wo, cos, sin, k_pages, v_pages)):
+        return False
+    if (block_tables.ndim != 2 or int(block_tables.shape[0]) != S
+            or lengths.ndim != 1 or int(lengths.shape[0]) != S
+            or write_pos.ndim != 1 or int(write_pos.shape[0]) != S):
+        return False
+    if not (block_tables.dtype.is_int and lengths.dtype.is_int
+            and write_pos.dtype.is_int):
+        return False
+    if _interpret():
+        return True
+    from thunder_tpu.core.cost_model import (
+        VMEM_BUDGET_BYTES,
+        decode_subblock_vmem_bytes,
+    )
+
+    return (hd % 128 == 0 and ps % 8 == 0 and D % 128 == 0 and S % 8 == 0
+            and decode_subblock_vmem_bytes(S, D, H, KV, hd, ps, 0,
+                                           h.dtype.bytes)
+            <= VMEM_BUDGET_BYTES)
+
+
+def _decode_layer_checker(h, attn_norm, wq, wk, wv, wo, cos, sin, k_pages,
+                          v_pages, block_tables, lengths, write_pos,
+                          mlp_norm, w_gate, w_up, w_down, act="silu",
+                          eps=1e-5, scale=None):
+    if act not in _ACT_IMPLS:
+        return False
+    if not _attn_subblock_checker(h, attn_norm, wq, wk, wv, wo, cos, sin,
+                                  k_pages, v_pages, block_tables, lengths,
+                                  write_pos, eps, scale):
+        return False
+    D = int(h.shape[-1])
+    if mlp_norm is None or getattr(mlp_norm, "ndim", 0) != 1 \
+            or int(mlp_norm.shape[0]) != D:
+        return False
+    if w_gate.ndim != 2 or int(w_gate.shape[1]) != D \
+            or tuple(w_up.shape) != tuple(w_gate.shape):
+        return False
+    F = int(w_gate.shape[0])
+    if tuple(w_down.shape) != (D, F):
+        return False
+    if any(w.dtype != h.dtype for w in (mlp_norm, w_gate, w_up, w_down)):
+        return False
+    if _interpret():
+        return True
+    from thunder_tpu.core.cost_model import (
+        VMEM_BUDGET_BYTES,
+        decode_subblock_vmem_bytes,
+    )
+
+    KV, _, ps, hd = (int(d) for d in k_pages.shape)
+    H = int(wq.shape[0]) // hd
+    S = int(h.shape[0])
+    return (F % 128 == 0
+            and decode_subblock_vmem_bytes(S, D, H, KV, hd, ps, F,
+                                           h.dtype.bytes)
+            <= VMEM_BUDGET_BYTES)
+
+
+# ---------------------------------------------------------------------------
 # fused multi-tensor AdamW (one kernel launch per dtype bucket: the
 # apex-multi_tensor_apply / torch-"foreach" analog, claimed from the
 # optim.fused_adamw composite built by core.fusion_passes.
@@ -1669,6 +2132,22 @@ if PALLAS_AVAILABLE:
     ex.register_implementation("nn.paged_decode_attention", paged_decode_op,
                                checker=_paged_decode_checker,
                                profitable=_pallas_claim_profitable)
+
+    # serving: the whole-decode-layer megakernel family (claimed from the
+    # composites the block planner's attention walk + chaining stage build;
+    # no `profitable` hook — the planner's decode cost model is the gate).
+    # Layered quarantine fallback: pallas.decode_layer -> the two sub-block
+    # kernels -> the fully per-op XLA chain.
+    _attn_sub_sym = get_op("nn.attn_subblock")
+    _decode_layer_sym = get_op("nn.decode_layer")
+    attn_subblock_op = ex.register_operator(
+        "attn_subblock", meta=_attn_sub_sym.meta, fn=pallas_attn_subblock)
+    decode_layer_op = ex.register_operator(
+        "decode_layer", meta=_decode_layer_sym.meta, fn=pallas_decode_layer)
+    ex.register_implementation("nn.attn_subblock", attn_subblock_op,
+                               checker=_attn_subblock_checker)
+    ex.register_implementation("nn.decode_layer", decode_layer_op,
+                               checker=_decode_layer_checker)
 
     # inference-path SDPA (no lse output needed)
     def pallas_sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):

@@ -92,8 +92,10 @@ def explain(jfn) -> str:
         if "vmem_bytes_per_step" in cost:
             detail.append(f"vmem_bytes_per_step={cost['vmem_bytes_per_step']}")
         suffix = f" ({', '.join(detail)})" if detail else ""
-        lines.append(f"  chain@{chain} -> {d['decision']}: {d.get('reason', '')}"
-                     f"{suffix}")
+        # the planner plans three composite kinds (nn.mlp_subblock,
+        # nn.attn_subblock, nn.decode_layer) — name the op per line
+        lines.append(f"  {d.get('op', '?')} chain@{chain} -> {d['decision']}: "
+                     f"{d.get('reason', '')}{suffix}")
     if not block_dec:
         lines.append("  (none — no sub-block chains found in this trace)")
 

@@ -444,6 +444,111 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
     return ops.convert_element_type(ops.reshape(attn, (B, H, T, hd)), q.dtype)
 
 
+def decode_row_write(pool_flat, rows, flat_positions):
+    """Scatter every decode slot's K/V row into a flattened page pool in ONE
+    replace-semantics scatter — the serving runner's K/V append, shared here
+    so the ``nn.attn_subblock`` decomposition and ``serving/runner.py`` emit
+    the IDENTICAL op sequence (the block planner's chain matcher and the
+    per-op quarantine fallback both depend on that identity).
+
+    ``pool_flat``: (KV, P*ps, hd); ``rows``: (S, KV, 1, hd);
+    ``flat_positions``: (S,) int32 of ``page*ps + offset``. Idle slots all
+    target position 0 (the reserved scratch page); duplicate indices there
+    are benign (any write wins, nobody reads)."""
+    S = rows.shape[0]
+    src = ops.transpose(ops.squeeze(rows, 2), (1, 0, 2))       # (KV, S, hd)
+    idx = ops.expand_to(ops.reshape(flat_positions, (1, S, 1)), src.shape)
+    return prims.scatter(pool_flat, idx, src, 1)
+
+
+_DECODE_T1 = ("decode-only composite (T == 1): every slot contributes one "
+              "new row; the chunked-prefill path keeps the unfused ops")
+
+
+@opsymbol(id="nn.attn_subblock")
+def attn_subblock(h, w_norm, wq, wk, wv, wo, cos, sin, k_pages, v_pages,
+                  block_tables, lengths, write_pos, *, eps: float = 1e-5,
+                  scale: float | None = None):
+    """Whole serving attention sub-block of one T==1 decode step as ONE
+    claimable composite — the block planner's attention unit
+    (``core/fusion_passes.block_fusion_pass`` attention walk)::
+
+        x    = rms_norm(h, w_norm)
+        q,k,v= rope(split_heads(x @ wq/wk/wv.T))   # v un-roped
+        kp,vp= pools with this step's k/v rows scattered at write_pos
+        attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+        out  = merge_heads(attn) @ wo.T            # residual add stays outside
+
+    Returns ``(out, kp, vp)`` — the out-projection (pre-residual; the
+    ``h + out`` add belongs to the adjoining MLP sub-block, which is how
+    the chaining stage fuses the two into ``nn.decode_layer``) and the
+    updated page pools. The decomposition below is EXACTLY the op sequence
+    ``serving/runner.py`` emits per layer (that is the numerics contract
+    when nothing claims it, and the per-op XLA fallback quarantine/bisection
+    recompiles to); the Pallas executor claims it as a single launch with
+    the weights streamed through VMEM, the fresh K/V rows patched in from
+    VMEM scratch, and block tables / lengths scalar-prefetched.
+    """
+    _tensor_like(h, "attn_subblock")
+    B, T = h.shape[0], h.shape[1]
+    check(T == 1, lambda: f"attn_subblock: {_DECODE_T1}; got T={T}")
+    KV, P, ps, hd = k_pages.shape
+    check(tuple(v_pages.shape) == tuple(k_pages.shape),
+          lambda: f"attn_subblock: page pools {tuple(k_pages.shape)} / "
+                  f"{tuple(v_pages.shape)} differ")
+    H = wq.shape[0] // hd
+    check(wq.shape[0] == H * hd and wk.shape[0] == KV * hd
+          and tuple(wv.shape) == tuple(wk.shape)
+          and wo.shape[1] == H * hd,
+          lambda: f"attn_subblock: projection shapes wq {tuple(wq.shape)} / "
+                  f"wk {tuple(wk.shape)} / wo {tuple(wo.shape)} do not agree "
+                  f"with head_dim {hd}")
+    from thunder_tpu.models.llama import _apply_rope
+
+    x = rms_norm(h, w_norm, eps=eps)
+    q = ops.transpose(ops.reshape(ops.linear(x, wq), (B, T, H, hd)),
+                      (0, 2, 1, 3))
+    k = ops.transpose(ops.reshape(ops.linear(x, wk), (B, T, KV, hd)),
+                      (0, 2, 1, 3))
+    v = ops.transpose(ops.reshape(ops.linear(x, wv), (B, T, KV, hd)),
+                      (0, 2, 1, 3))
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    flat = (KV, P * ps, hd)
+    paged = (KV, P, ps, hd)
+    kp = ops.reshape(decode_row_write(ops.reshape(k_pages, flat), k,
+                                      write_pos), paged)
+    vp = ops.reshape(decode_row_write(ops.reshape(v_pages, flat), v,
+                                      write_pos), paged)
+    attn = paged_decode_attention(q, kp, vp, block_tables, lengths,
+                                  scale=scale)
+    attn = ops.reshape(ops.transpose(attn, (0, 2, 1, 3)), (B, T, H * hd))
+    return ops.linear(attn, wo), kp, vp
+
+
+@opsymbol(id="nn.decode_layer")
+def decode_layer(h, attn_norm, wq, wk, wv, wo, cos, sin, k_pages, v_pages,
+                 block_tables, lengths, write_pos, mlp_norm, w_gate, w_up,
+                 w_down, *, act: str = "silu", eps: float = 1e-5,
+                 scale: float | None = None):
+    """One whole transformer decode layer (T==1 serving path) as ONE
+    claimable composite — the block planner's chaining unit: the attention
+    sub-block plus the MLP sub-block, one Pallas launch per layer per
+    decoded token when claimed. Returns ``(out, kp, vp)``.
+
+    The decomposition is the two sub-block composites, which gives the
+    quarantine/bisection machinery a LAYERED fallback: a quarantined
+    ``pallas.decode_layer`` decomposes into ``nn.attn_subblock`` +
+    ``nn.mlp_subblock`` (two launches, still fused); quarantining those too
+    reaches the fully per-op XLA chain with equal numerics."""
+    proj, kp, vp = attn_subblock(h, attn_norm, wq, wk, wv, wo, cos, sin,
+                                 k_pages, v_pages, block_tables, lengths,
+                                 write_pos, eps=eps, scale=scale)
+    out = mlp_subblock(h, proj, mlp_norm, w_gate, w_up, w_down,
+                       act=act, eps=eps)
+    return out, kp, vp
+
+
 @opsymbol(id="nn.fp8_linear")
 def fp8_linear(a, w, x_scale=None, w_scale=None, bias=None, slot: int = -1):
     """FP8 linear (TransformerEngine analog, reference

@@ -52,12 +52,13 @@ def _write_rows(pool, rows, flat_positions):
     Idle slots all target position 0 (the reserved scratch page); duplicate
     indices there are benign (any write wins, nobody reads it). One scatter
     beats S chained dynamic_update_slices: XLA copies the input pool once
-    either way, but the chain pays S update kernels."""
-    S = rows.shape[0]
-    hd = pool.shape[-1]
-    src = ops.transpose(ops.squeeze(rows, 2), (1, 0, 2))       # (KV, S, hd)
-    idx = ops.expand_to(ops.reshape(flat_positions, (1, S, 1)), src.shape)
-    return prims.scatter(pool, idx, src, 1)
+    either way, but the chain pays S update kernels.
+
+    The op emission lives in ``ops.nn.decode_row_write`` — ONE owner shared
+    with the ``nn.attn_subblock`` decomposition, so the block planner's
+    chain matcher and the quarantine fallback always see the exact sequence
+    this runner traces."""
+    return tnn.decode_row_write(pool, rows, flat_positions)
 
 
 def _write_pages(pool, rows, page_positions, ps: int):
@@ -77,18 +78,25 @@ class PagedLlamaRunner:
     """Builds + owns the compiled paged step functions for one engine."""
 
     def __init__(self, cfg, geometry, *, n_layers: int | None = None,
-                 executors=None):
+                 executors=None, block_fusion=None):
         import thunder_tpu as tt
 
         self.cfg = cfg
         self.geom = geometry
         self.n_layers = n_layers if n_layers is not None else cfg.n_layers
+        # block planner passthrough: unset lets the decode cost model decide
+        # (at T==1 serving shapes the launch-amortization objective plans the
+        # whole-decode-layer megakernel whenever an executor claims it);
+        # True/False force/disable — tests and A/Bs use both
+        opts = {} if block_fusion is None else {"block_fusion": block_fusion}
         # one jitted fn each; distinct chunk shapes become distinct cache
         # entries inside the ThunderTPUFunction (bounded by the ladder)
         self.decode_jit = tt.jit(self._decode_fn, executors=executors,
-                                 fn_name="serving_decode", donate_argnums=(5,))
+                                 fn_name="serving_decode", donate_argnums=(5,),
+                                 **opts)
         self.prefill_jit = tt.jit(self._prefill_fn, executors=executors,
-                                  fn_name="serving_prefill", donate_argnums=(6,))
+                                  fn_name="serving_prefill", donate_argnums=(6,),
+                                  **opts)
 
     # -- traced bodies ------------------------------------------------------
     def _attn_block(self, h, layer, q, block_tables, lengths, pools_kv):
@@ -190,5 +198,49 @@ class PagedLlamaRunner:
         """Compile the decode step for these inputs and bind it (zero-guard
         dispatch). The scheduler owns the bound callable and re-binds when
         the quarantine epoch moves (a containment event recompiled under a
-        new cache entry; the stale binding would re-contain every call)."""
-        return self.decode_jit.bind(*args)
+        new cache entry; the stale binding would re-contain every call).
+        Each (re)bind republishes the decode program's fusion shape to the
+        observe registry, so a fallback to the unfused decode layer is
+        visible as a launch-count move rather than only as a throughput
+        regression."""
+        bound = self.decode_jit.bind(*args)
+        self._publish_decode_fusion_shape()
+        return bound
+
+    def _publish_decode_fusion_shape(self) -> None:
+        """Gauges describing the compiled decode step's per-token launch
+        shape, read from the execution trace's executor assignments (NOT
+        from trace-source grepping): how many Pallas launches one decode
+        step dispatches, and how many of them are whole-decode-layer
+        megakernels. ``bench_serve.py`` stamps both; the fusion-shape
+        acceptance test reads launches-per-layer from them."""
+        import thunder_tpu as tt
+        from thunder_tpu.observe import registry as _observe
+
+        try:
+            trc = tt.last_execution_trace(self.decode_jit)
+        except Exception:
+            return
+        if trc is None:
+            return
+        launches = 0
+        layers = 0
+
+        def walk(bsyms):
+            nonlocal launches, layers
+            for b in bsyms:
+                ex = b.sym.executor
+                if ex is not None and ex.name == "pallas":
+                    # one claimed kernel = one launch; its subsymbols are
+                    # the decomposition (never dispatched), don't recurse
+                    launches += 1
+                    if b.sym.name == "decode_layer":
+                        layers += 1
+                    continue
+                # XLA regions ABSORB claimed pallas calls (Fusion 2.0);
+                # the launches live one level down
+                walk(b.subsymbols)
+
+        walk(trc.bound_symbols)
+        _observe.set_gauge("serving.decode_pallas_launches", launches)
+        _observe.set_gauge("serving.decode_layer_fusions", layers)

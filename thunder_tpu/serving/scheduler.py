@@ -101,7 +101,7 @@ class ServingEngine:
     def __init__(self, params, cfg, *, max_slots: int = 8, page_size: int = 16,
                  num_pages: int | None = None, max_context: int | None = None,
                  prefill_chunk: int | None = None, n_layers: int | None = None,
-                 executors=None, retry_policy=None):
+                 executors=None, retry_policy=None, block_fusion=None):
         self.params = params
         self.cfg = cfg
         n_layers_eff = n_layers if n_layers is not None else cfg.n_layers
@@ -136,7 +136,8 @@ class ServingEngine:
         self.geom = geometry
         self.cache = PagedKVCache(geometry, cfg.dtype.jax)
         self.runner = PagedLlamaRunner(cfg, geometry, n_layers=n_layers,
-                                       executors=executors)
+                                       executors=executors,
+                                       block_fusion=block_fusion)
         self.max_slots = int(max_slots)
         self.slots: list[Request | None] = [None] * self.max_slots
         self.queue: deque[Request] = deque()
@@ -395,6 +396,20 @@ class ServingEngine:
             # re-enter containment (clear + recompile) on EVERY step
             ep = _quarantine.epoch()
             if self._decode_bound is None or self._bound_epoch != ep:
+                if self._decode_bound is not None:
+                    # the epoch MOVED under a live binding: a kernel was
+                    # quarantined and the decode program is about to fall
+                    # back (e.g. the decode-layer megakernel to its per-op
+                    # form). Log it — a silent fallback would only show up
+                    # as a throughput regression; the counter renders in
+                    # explain()'s serving section, the event carries the
+                    # epochs, and the rebind republishes the launch gauges.
+                    _observe.inc("serving.decode_rebinds")
+                    _observe.event("serving_decode_rebind",
+                                   old_epoch=self._bound_epoch, epoch=ep,
+                                   quarantined=sorted(
+                                       _quarantine.get_quarantine().ids()))
+                _observe.set_gauge("serving.quarantine_epoch", ep)
                 self._decode_bound = self.runner.bind_decode(
                     self.params, tokens, bt, lengths, write_pos,
                     self.cache.pools)
