@@ -45,11 +45,13 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 5: bench_serve --overload stamps shed_rate / deadline_miss_rate /
+# slo_attainment (request SLOs + supervised engine lifecycle);
 # 4: bench_serve stamps decode_layer_fusions + decode_pallas_launches_per_token
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 4
+METRICS_SCHEMA = 5
 
 
 def main():

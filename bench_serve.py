@@ -20,9 +20,18 @@ axis), p99 per-request decode duration, and peak KV page utilization.
 ``vs_baseline`` on the continuous line is the aggregate-throughput ratio
 over sequential — the number the ≥4x acceptance gate reads.
 
+``--overload`` replaces the comparison with the OVERLOAD scenario (arrival
+rate > capacity): requests carry mixed priorities and a deadline SLO, the
+admission queue is bounded, and traffic flows through an
+``EngineSupervisor``. The JSON line stamps ``shed_rate`` (bounded-queue +
+priority shedding over all offered requests), ``deadline_miss_rate``
+(late completions among accepted non-shed requests — the acceptance gate
+wants this at zero for the smoke SLO) and ``slo_attainment`` (the
+engine's rolling on-time ratio over every terminal request).
+
 Env: SERVE_MODEL, SERVE_LAYERS, SERVE_REQUESTS, SERVE_DECODE, SERVE_SLOTS,
-SERVE_CONTEXT, SERVE_PAGE, SERVE_CHUNK, SERVE_RATE. ``--smoke``: tiny GQA
-geometry on CPU.
+SERVE_CONTEXT, SERVE_PAGE, SERVE_CHUNK, SERVE_RATE, SERVE_DEADLINE_S,
+SERVE_QUEUE. ``--smoke``: tiny GQA geometry on CPU.
 """
 
 from __future__ import annotations
@@ -46,6 +55,12 @@ def main():
     import jax
 
     smoke = "--smoke" in sys.argv
+    overload = "--overload" in sys.argv
+    if overload and smoke:
+        # overload smoke: enough offered load to overflow the bounded queue
+        # while each accepted request keeps a wide SLO margin
+        os.environ.setdefault("SERVE_REQUESTS", "24")
+        os.environ.setdefault("SERVE_DECODE", "32")
     if smoke:
         os.environ.setdefault("SERVE_MODEL", "tiny-gqa")
         os.environ.setdefault("SERVE_LAYERS", "1")
@@ -93,6 +108,76 @@ def main():
     # need the registry; the baseline runs under the same instrumentation
     # so the comparison carries identical per-dispatch overhead)
     observe.enable(clear=True)
+
+    # ---- overload scenario: arrival rate > capacity, SLOs + supervision ---
+    if overload:
+        from thunder_tpu.serving import AdmissionRejected, EngineSupervisor
+
+        deadline = float(os.environ.get("SERVE_DEADLINE_S",
+                                        "120" if smoke else "60"))
+        qbound = int(os.environ.get("SERVE_QUEUE", str(slots)))
+        need = -(-int(max(len(p) for p in prompts) + n_decode) // page)
+        eng = ServingEngine(params, cfg, max_slots=slots, page_size=page,
+                            max_context=max_context, n_layers=n_layers,
+                            prefill_chunk=chunk, num_pages=slots * need + 1)
+        # warm the real length mix + decode program with the queue unbounded
+        for L in sorted({int(l) for l in lens}):
+            eng.submit(rng.randint(1, cfg.vocab_size, size=L).astype(np.int32),
+                       max_new_tokens=2)
+        eng.drain()
+        eng.completed.clear()
+        eng.shed.clear()
+        eng.cache.reset_peak()
+        eng.reset_slo_window()          # warm requests are not SLO traffic
+        observe.reset()                 # warmup compiles pollute the stats
+        eng.max_queue = qbound          # bound admissions for the timed run
+        sup = EngineSupervisor(eng)
+        prios = rng.randint(0, 3, size=n_requests)
+        pending = sorted(zip(arrivals.tolist(), prompts, prios.tolist()),
+                         key=lambda x: x[0])
+        accepted, rejected = [], 0
+        t0 = time.perf_counter()
+        while pending or not eng.idle:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, p, pr = pending.pop(0)
+                try:
+                    accepted.append(sup.submit(p, n_decode,
+                                               deadline_s=deadline,
+                                               priority=int(pr)))
+                except AdmissionRejected:
+                    rejected += 1       # shed at submit (queue full)
+            if not sup.step() and pending:
+                time.sleep(max(0.0, min(pending[0][0] - now, 1e-3)))
+        sup.drain()                     # stamps serving.drain_ms; engine idle
+        wall = time.perf_counter() - t0
+        eng.assert_quiescent()          # leak audit: overload must not leak
+        snap = observe.snapshot()
+        done = [r for r in accepted if r.done]
+        late = sum(1 for r in done if r.deadline_at is not None
+                   and r.finished_s > r.deadline_at)
+        shed_total = len(eng.shed)      # queue/priority shed + rejected
+        slo = snap["gauges"].get("serving.slo_attainment", float("nan"))
+        tok_s = sum(len(r.generated) for r in done) / wall
+        print(f"overload: {n_requests} offered at {rate:g}/s, queue bound "
+              f"{qbound}: {len(done)} completed, {shed_total} shed "
+              f"({rejected} at submit), {late} late — slo {slo:.3f}, "
+              f"{tok_s:.1f} tok/s aggregate", file=sys.stderr)
+        print(json.dumps({
+            "metrics_schema": METRICS_SCHEMA,
+            "metric": f"{geom} overload slo_attainment "
+                      f"(rate>capacity, deadline {deadline:g}s)",
+            "value": round(slo, 4), "unit": "ratio", "vs_baseline": 1.0,
+            "requests": n_requests, "decode_tokens": n_decode,
+            "queue_bound": qbound, "deadline_s": deadline,
+            "completed": len(done),
+            "shed_rate": round(shed_total / n_requests, 4),
+            "deadline_miss_rate": round(late / max(1, len(done)), 4),
+            "slo_attainment": round(slo, 4),
+            "engine_restarts": int(snap["counters"].get(
+                "serving.engine_restarts", 0)),
+            "tokens_per_s": round(tok_s, 1)}))
+        return
 
     # ---- sequential single-stream baseline (dense cache + bind) -----------
     step_fn, prefill_fn = llama._get_step_fns(cfg, n_layers)

@@ -71,11 +71,15 @@ def test_serving_metric_names_documented():
     for path in sources:
         with open(path) as f:
             names |= set(re.findall(r"[\"'](serving\.[a-z0-9_]+)[\"']", f.read()))
-    # the scheduler's core metric families must all be present (a refactor
-    # that stops emitting them should fail loudly here)
+    # the scheduler's core metric families AND the SLO/supervision family
+    # (engine restarts, shedding, deadline health) must all be present (a
+    # refactor that stops emitting them should fail loudly here)
     for required in ("serving.queue_depth", "serving.active_requests",
                      "serving.kv_pages_free", "serving.ttft_ms",
-                     "serving.decode_ms", "serving.preempted_requests"):
+                     "serving.decode_ms", "serving.preempted_requests",
+                     "serving.engine_restarts", "serving.shed_requests",
+                     "serving.deadline_misses", "serving.drain_ms",
+                     "serving.slo_attainment"):
         assert required in names, f"code no longer emits {required}"
     with open(DOC) as f:
         doc = f.read()

@@ -15,6 +15,10 @@ from thunder_tpu.ops import nn as tnn
 from thunder_tpu.runtime import faults, quarantine
 from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
 from thunder_tpu.serving import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    EngineStallError,
+    InfeasibleRequest,
     OutOfPages,
     PagedKVCache,
     PageGeometry,
@@ -79,6 +83,30 @@ class TestPagedKVCache:
             cache.free([a[0]])
         with pytest.raises(ValueError, match="invalid page"):
             cache.free([0])                     # the reserved scratch page
+
+    def test_assert_quiescent_leak_audit(self):
+        import jax.numpy as jnp
+
+        cache = PagedKVCache(_geometry(), jnp.float32)
+        cache.assert_quiescent()                       # fresh pool is clean
+        held = cache.alloc(2)
+        with pytest.raises(AssertionError, match="leak"):
+            cache.assert_quiescent()
+        cache.free(held)
+        cache.assert_quiescent(np.zeros((3, 4), np.int32))
+        with pytest.raises(AssertionError, match="block-table"):
+            cache.assert_quiescent(np.asarray([[0, 3, 0, 0]], np.int32))
+        cache._free_set.discard(cache._free[0])        # corrupt the mirror
+        with pytest.raises(AssertionError, match="mirror"):
+            cache.assert_quiescent()
+
+    def test_pools_alive_detects_consumed_buffers(self):
+        import jax.numpy as jnp
+
+        cache = PagedKVCache(_geometry(), jnp.float32)
+        assert cache.pools_alive()
+        cache.pools[0]["k"].delete()                   # donated-and-consumed
+        assert not cache.pools_alive()
 
     def test_pool_shapes(self):
         import jax.numpy as jnp
@@ -297,15 +325,152 @@ class TestServingEngine:
         assert eng.cache.pages_free == eng.cache.pages_total
 
     def test_submit_capacity_contract(self, model):
+        """Infeasible requests fail at submit() with the TYPED error (which
+        still subclasses ValueError for pre-SLO callers) — queueing one
+        forever is the classic drain() wedge."""
         cfg, params = model
         eng = _tiny_engine(params, cfg)
-        with pytest.raises(ValueError, match="context window"):
+        with pytest.raises(InfeasibleRequest, match="context window"):
             eng.submit(np.ones(60, np.int32), 10)
         with pytest.raises(ValueError, match="empty prompt"):
             eng.submit(np.ones(0, np.int32), 1)
         small = _tiny_engine(params, cfg, num_pages=3)
-        with pytest.raises(ValueError, match="KV pages"):
+        with pytest.raises(InfeasibleRequest, match="KV pages"):
             small.submit(np.ones(40, np.int32), 20)
+        assert issubclass(InfeasibleRequest, ValueError)
+        assert issubclass(InfeasibleRequest, AdmissionRejected)
+        # nothing queued: an infeasible submit must leave no residue that
+        # could wedge drain()
+        assert not eng.queue and not small.queue
+        assert small.drain(max_steps=10) == []
+
+    def test_drain_stall_raises_naming_stuck_requests(self, model):
+        """Regression for the drain() wedge: a queued request that can
+        never admit (every page externally held — the shape of a leak) must
+        raise EngineStallError naming the stuck request, not burn
+        max_steps or return silently with work outstanding."""
+        cfg, params = model
+        eng = _tiny_engine(params, cfg, max_slots=1)
+        eng.cache.alloc(eng.cache.pages_free)        # simulate a full hold
+        req = eng.submit(np.ones(4, np.int32), 2)
+        with pytest.raises(EngineStallError) as ei:
+            eng.drain(max_steps=50)
+        assert (req.request_id, "queued") in ei.value.stuck
+        assert "stalled" in str(ei.value)
+
+    def test_deadline_sheds_queued_and_evicts_resident(self, model):
+        """Deadline-aware scheduling: an expired queued request sheds with
+        DeadlineExceeded before ever admitting; an expired RESIDENT is
+        evicted mid-flight (pages freed). Both count deadline_misses, and
+        unaffected requests still produce exact tokens."""
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        p1 = rng.randint(1, cfg.vocab_size, size=5).astype(np.int32)
+        p2 = rng.randint(1, cfg.vocab_size, size=7).astype(np.int32)
+        ref = self._references(params, cfg, [p1], 6)[0]
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg, max_slots=1)
+            r1 = eng.submit(p1, 6)
+            r2 = eng.submit(p2, 4, deadline_s=0.0)   # expired on arrival
+            eng.drain()
+            # resident eviction, deterministically: admit r3, then move its
+            # deadline into the past mid-decode
+            r3 = eng.submit(p2, 8, deadline_s=60.0)
+            eng.step()
+            assert r3.state in ("prefill", "decode")
+            r3.deadline_at = r3.submitted_s          # now in the past
+            eng.drain()
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        assert r1.done
+        np.testing.assert_array_equal(r1.output(), ref)
+        assert r2.failed and isinstance(r2.error, DeadlineExceeded)
+        assert r2.error.request_id == r2.request_id
+        assert r3.failed and isinstance(r3.error, DeadlineExceeded)
+        assert snap["counters"]["serving.deadline_misses"] == 2
+        assert snap["counters"]["serving.shed_requests"] == 2
+        assert 0.0 < snap["gauges"]["serving.slo_attainment"] < 1.0
+        eng.assert_quiescent()                       # eviction leaked nothing
+
+    def test_bounded_queue_sheds_by_priority(self, model):
+        """Priority-ordered load shedding under queue pressure: a full
+        bounded queue sheds its lowest-priority request for a higher-
+        priority newcomer, and rejects a newcomer that outranks nobody."""
+        cfg, params = model
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg, max_slots=1, max_queue=2)
+            resident = eng.submit(np.ones(4, np.int32), 6)
+            eng.step()                               # resident takes the slot
+            low = eng.submit(np.ones(4, np.int32), 2, priority=0)
+            mid = eng.submit(np.ones(4, np.int32), 2, priority=1)
+            high = eng.submit(np.ones(4, np.int32), 2, priority=2)  # sheds low
+            assert low.failed and isinstance(low.error, AdmissionRejected)
+            with pytest.raises(AdmissionRejected, match="queue full"):
+                eng.submit(np.ones(4, np.int32), 2, priority=1)
+            done = eng.drain()
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        assert snap["counters"]["serving.shed_requests"] == 2
+        assert mid.done and high.done and resident.done
+        # priority-ordered admission: high joined the batch before mid
+        assert done.index(high) < done.index(mid) or \
+            high.admit_seq < mid.admit_seq
+        eng.assert_quiescent()
+
+    def test_zero_queue_bound_rejects_typed(self, model):
+        """max_queue=0 closes the queue entirely (admission happens inside
+        step(), so every request must pass through it): each submit gets
+        the TYPED rejection and is recorded as shed (regression: this used
+        to crash with min() on an empty deque)."""
+        cfg, params = model
+        observe.enable(clear=True)
+        try:
+            eng = _tiny_engine(params, cfg, max_slots=1, max_queue=0)
+            with pytest.raises(AdmissionRejected, match="queue full"):
+                eng.submit(np.ones(4, np.int32), 2)
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        assert len(eng.shed) == 1 and eng.shed[0].failed
+        assert snap["counters"]["serving.shed_requests"] == 1
+        assert eng.drain(max_steps=5) == []            # nothing wedged
+        eng.assert_quiescent()
+
+    def test_page_pressure_never_preempts_higher_priority(self, model):
+        """Priority-inversion regression: when the pool runs dry, a
+        low-priority request growing its pages must never evict a
+        higher-priority resident — it self-preempts instead. Both still
+        finish with exact tokens."""
+        cfg, params = model
+        rng = np.random.RandomState(9)
+        p_hi = rng.randint(1, cfg.vocab_size, size=30).astype(np.int32)
+        p_lo = rng.randint(1, cfg.vocab_size, size=20).astype(np.int32)
+        refs = self._references(params, cfg, [p_hi, p_lo], 8)
+        eng = _tiny_engine(params, cfg, max_slots=2, page_size=8,
+                           num_pages=7, prefill_chunk=16)
+        hi = eng.submit(p_hi, 8, priority=5)
+        lo = eng.submit(p_lo, 8, priority=0)
+        eng.drain()
+        assert hi.preemptions == 0                     # never the victim
+        assert lo.preemptions >= 1                     # the pool WAS dry
+        np.testing.assert_array_equal(hi.output(), refs[0])
+        np.testing.assert_array_equal(lo.output(), refs[1])
+        eng.assert_quiescent()
+
+    def test_draining_engine_rejects_admissions(self, model):
+        cfg, params = model
+        eng = _tiny_engine(params, cfg)
+        r = eng.submit(np.ones(3, np.int32), 2)
+        eng.stop_admissions()
+        with pytest.raises(AdmissionRejected, match="draining"):
+            eng.submit(np.ones(3, np.int32), 2)
+        eng.drain()
+        assert r.done
+        eng.assert_quiescent()
 
     def test_serving_metrics_emitted(self, model):
         cfg, params = model

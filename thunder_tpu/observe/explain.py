@@ -145,21 +145,40 @@ def explain(jfn) -> str:
 
     if _registry.is_enabled():
         snap = _registry.snapshot()
-        sv_g = {k: v for k, v in snap["gauges"].items() if k.startswith("serving.")}
-        sv_c = {k: v for k, v in snap["counters"].items() if k.startswith("serving.")}
-        sv_h = {k: v for k, v in snap["histograms"].items() if k.startswith("serving.")}
-        if sv_g or sv_c or sv_h:
+        # SLO / supervision metrics get their own section: they describe the
+        # engine LIFECYCLE (restarts, shedding, deadline health), not the
+        # steady-state scheduler, and an operator triaging an incident reads
+        # them first
+        slo_keys = ("serving.engine_restarts", "serving.shed_requests",
+                    "serving.deadline_misses", "serving.drain_ms",
+                    "serving.slo_attainment")
+        def metric_line(k):
+            # one renderer for both serving sections, gauge/counter/histogram
+            if k in snap["gauges"]:
+                return f"  {k}: {snap['gauges'][k]:g}"
+            if k in snap["counters"]:
+                return f"  {k}: {snap['counters'][k]:g} (counter)"
+            h = snap["histograms"].get(k)
+            if h and h["count"]:
+                return (f"  {k}: n={h['count']} "
+                        f"mean={h['sum'] / h['count']:.2f} "
+                        f"min={h['min']:.2f} max={h['max']:.2f}")
+            return None
+
+        generic = sorted(
+            k for src in ("gauges", "counters", "histograms")
+            for k in snap[src]
+            if k.startswith("serving.") and k not in slo_keys)
+        generic_lines = [ln for k in generic if (ln := metric_line(k))]
+        if generic_lines:
             lines.append("")
             lines.append("== serving ==")
-            for k, v in sorted(sv_g.items()):
-                lines.append(f"  {k}: {v:g}")
-            for k, v in sorted(sv_c.items()):
-                lines.append(f"  {k}: {v:g} (counter)")
-            for k, h in sorted(sv_h.items()):
-                if h["count"]:
-                    lines.append(f"  {k}: n={h['count']} "
-                                 f"mean={h['sum'] / h['count']:.2f} "
-                                 f"min={h['min']:.2f} max={h['max']:.2f}")
+            lines.extend(generic_lines)
+        slo_lines = [ln for k in slo_keys if (ln := metric_line(k))]
+        if slo_lines:
+            lines.append("")
+            lines.append("== serving slo/supervision ==")
+            lines.extend(slo_lines)
 
     # -- step cost estimates ------------------------------------------------
     lines.append("")

@@ -19,7 +19,23 @@ domain              hook point
                     eager per-region path = a runtime kernel fault)
 ``collective``      the eager lowerings in ``distributed/prims.py``
 ``checkpoint_io``   ``checkpoint.save_checkpoint``
-``step``            ``ElasticTrainer``'s step loop
+``step``            ``ElasticTrainer``'s step loop AND the serving
+                    engine's batched decode dispatch (legacy serving
+                    domain, kept for existing chaos plans)
+``serving:prefill``  the serving engine's prefill-chunk dispatch
+                    (pre-dispatch, so a retried transient replays on
+                    unconsumed inputs)
+``serving:decode``   the serving engine's batched decode dispatch
+                    (pre-dispatch; retried like ``step``)
+``serving:admission``  the scheduler's admission path, BEFORE pages are
+                    allocated — contained locally (the request stays
+                    queued and retries next engine step)
+``serving:engine``  the serving engine's fatal-crash domain: fires in
+                    the decode dispatch and CONSUMES the donated page
+                    pools first (what a real mid-execution accelerator
+                    fault does), so the retry classifier escalates FATAL
+                    and ``serving.supervisor.EngineSupervisor`` restarts
+                    the engine (pool rebuild + re-prefill)
 ``numerics:*``      silent-data faults — these *corrupt values* instead of
                     raising. ``numerics:grads`` / ``numerics:loss`` poison
                     the gradients / loss of a ``NumericsGuardTransform``-ed

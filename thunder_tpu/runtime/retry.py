@@ -156,3 +156,9 @@ class RestartBudget:
     def in_window(self) -> int:
         self._prune(self._clock())
         return len(self._events)
+
+    def describe(self) -> str:
+        """One-line budget state for events/reports:
+        ``2/3 restarts in 600s window``."""
+        window = "lifetime" if self.window_s is None else f"{self.window_s:g}s"
+        return f"{self.in_window}/{self.max_restarts} restarts in {window} window"

@@ -1,29 +1,48 @@
-"""Production serving runtime: continuous batching over a paged KV cache.
+"""Production serving runtime: continuous batching over a paged KV cache,
+supervised for survival under fire.
 
-The three layers (ROADMAP item 1):
+The layers (ROADMAP item 1 + the serving containment story):
 
 - :mod:`thunder_tpu.serving.kv_cache` — block-allocated page pool +
   free-list + per-request block tables (requests at any mix of sequence
-  lengths share one device allocation, one compiled decode shape).
+  lengths share one device allocation, one compiled decode shape), with
+  the :meth:`~kv_cache.PagedKVCache.assert_quiescent` leak audit.
 - :mod:`thunder_tpu.serving.runner` — the compiled paged prefill/decode
   step programs (``bind()``-dispatched decode; ``LengthBucketer``-laddered
   prefill chunks; ragged attention via ``nn.paged_decode_attention``,
   Pallas-claimed on TPU).
-- :mod:`thunder_tpu.serving.scheduler` — admission, decode-first
-  continuous batching with chunked prefill interleaving, mid-flight
-  join/evict, page-pressure preemption, ``step``-domain retry, and the
-  ``serving.*`` observe metrics.
+- :mod:`thunder_tpu.serving.scheduler` — admission (priority-ordered,
+  optionally bounded, infeasibility-checked), deadline-aware continuous
+  batching with chunked prefill interleaving, mid-flight join/evict,
+  page-pressure preemption, load shedding with typed errors
+  (:mod:`~thunder_tpu.serving.errors`), ``serving:*``-domain retry, and
+  the ``serving.*`` observe metrics.
+- :mod:`thunder_tpu.serving.supervisor` — the engine-level fallback rung:
+  crash recovery (pool rebuild + re-prefill of in-flight requests, charged
+  to a sliding-window :class:`~thunder_tpu.runtime.retry.RestartBudget`),
+  graceful ``drain()``/``shutdown()``, and a heartbeat watchdog.
 
->>> from thunder_tpu.serving import ServingEngine
+>>> from thunder_tpu.serving import EngineSupervisor, ServingEngine
 >>> eng = ServingEngine(params, cfg, max_slots=8, page_size=16,
 ...                     max_context=256, n_layers=2)
->>> req = eng.submit(prompt_ids, max_new_tokens=32)
->>> eng.drain(); req.output()
+>>> sup = EngineSupervisor(eng, max_restarts=3, restart_window_s=600.0)
+>>> req = sup.submit(prompt_ids, max_new_tokens=32, deadline_s=30.0)
+>>> sup.drain(); req.output()
 
 ``bench_serve.py`` at the repo root is the committed throughput benchmark
-(requests/s and aggregate decode tokens/s at a latency SLO).
+(requests/s and aggregate decode tokens/s at a latency SLO; ``--overload``
+measures shedding and SLO attainment past capacity).
 """
 
+from thunder_tpu.serving.errors import (  # noqa: F401
+    AdmissionRejected,
+    DeadlineExceeded,
+    EngineFault,
+    EngineStallError,
+    InfeasibleRequest,
+    RestartBudgetExceeded,
+    ServingError,
+)
 from thunder_tpu.serving.kv_cache import (  # noqa: F401
     OutOfPages,
     PagedKVCache,
@@ -31,3 +50,4 @@ from thunder_tpu.serving.kv_cache import (  # noqa: F401
 )
 from thunder_tpu.serving.runner import PagedLlamaRunner  # noqa: F401
 from thunder_tpu.serving.scheduler import Request, ServingEngine  # noqa: F401
+from thunder_tpu.serving.supervisor import EngineSupervisor  # noqa: F401

@@ -1,0 +1,92 @@
+"""Typed serving errors: the request-SLO and engine-lifecycle vocabulary.
+
+Every failure a caller can act on gets its own type — catching broad
+``RuntimeError`` around ``submit()``/``drain()`` cannot distinguish "your
+request was load-shed, resubmit later" from "the engine is wedged, page
+somebody". The hierarchy:
+
+- :class:`ServingError` — base for everything below.
+- :class:`AdmissionRejected` — the request never became (or stopped being)
+  resident for capacity/lifecycle reasons: admissions stopped by a drain,
+  the bounded admission queue shed it under priority pressure, or a
+  graceful-drain wall-clock bound evicted it.
+- :class:`InfeasibleRequest` — the request could NEVER run on this engine
+  (context window or total page pool too small); raised at ``submit()``
+  time so an impossible request fails fast instead of queueing forever and
+  wedging ``drain()``. Subclasses ``ValueError`` too: infeasibility is a
+  caller bug, and pre-SLO code that caught ``ValueError`` keeps working.
+- :class:`DeadlineExceeded` — the request's SLO deadline passed before it
+  completed (shed from the queue, evicted mid-flight, or drained past the
+  bound).
+- :class:`EngineFault` — the engine's device state is unrecoverable in
+  place (a failing dispatch consumed the donated page pools): a blind
+  retry would crash on deleted buffers, so the engine escalates this to
+  its supervisor, whose restart (pool rebuild + re-prefill of every
+  in-flight request) is the only recovery rung.
+- :class:`EngineStallError` — a ``drain()`` step made no progress (nothing
+  admitted, prefilled, decoded, or shed) while requests remain; names the
+  stuck requests instead of burning ``max_steps`` silently.
+- :class:`RestartBudgetExceeded` — the supervisor's sliding-window restart
+  budget ran out; the engine is failing faster than restarts can honestly
+  mask, so the failure escalates to the caller.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for typed serving-engine errors."""
+
+
+class AdmissionRejected(ServingError):
+    """The engine refused (or revoked) admission for capacity/lifecycle
+    reasons — draining, a full bounded queue, or priority shedding."""
+
+    def __init__(self, message: str, *, request_id: int | None = None):
+        super().__init__(message)
+        self.request_id = request_id
+
+
+class InfeasibleRequest(AdmissionRejected, ValueError):
+    """The request can never run on this engine (context window or total
+    KV page pool too small) — raised at ``submit()`` so it fails fast."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's SLO deadline passed before completion."""
+
+    def __init__(self, message: str, *, request_id: int | None = None,
+                 deadline_s: float | None = None):
+        super().__init__(message)
+        self.request_id = request_id
+        self.deadline_s = deadline_s
+
+
+class EngineFault(ServingError):
+    """Device state lost mid-dispatch (donated page pools consumed by a
+    failing step): per-step retry is impossible; only a supervised engine
+    restart — pool rebuild plus re-prefill of in-flight requests — can
+    recover. Carries the dispatch ``domain`` that escalated."""
+
+    def __init__(self, message: str, *, domain: str = ""):
+        super().__init__(message)
+        self.domain = domain
+
+
+class EngineStallError(ServingError):
+    """``drain()`` detected a step with no progress while requests remain.
+    ``stuck`` holds ``(request_id, state)`` pairs for triage."""
+
+    def __init__(self, message: str, *, stuck: list | None = None):
+        super().__init__(message)
+        self.stuck = list(stuck or [])
+
+
+class RestartBudgetExceeded(ServingError):
+    """The supervisor's sliding-window restart budget is exhausted."""
+
+    def __init__(self, message: str, *, in_window: int = 0,
+                 max_restarts: int = 0):
+        super().__init__(message)
+        self.in_window = in_window
+        self.max_restarts = max_restarts

@@ -119,6 +119,65 @@ class PagedKVCache:
         donates the old buffers, so the engine must never reuse them)."""
         self.pools = list(new_pools)
 
+    def pools_alive(self) -> bool:
+        """False when any pool buffer was deleted — a dispatch that donated
+        the pools and then failed consumed them mid-execution, so replaying
+        against this cache is impossible (the supervisor must rebuild)."""
+        for kv in self.pools:
+            for arr in kv.values():
+                if getattr(arr, "is_deleted", lambda: False)():
+                    return False
+        return True
+
+    def consume_pools(self) -> None:
+        """Delete every pool buffer — what a real accelerator fault does to
+        donated inputs mid-execution (the write-side dual of
+        :meth:`pools_alive`). Only the ``serving:engine`` fault-injection
+        path calls this; recovery is a supervisor pool rebuild."""
+        for kv in self.pools:
+            for arr in kv.values():
+                try:
+                    arr.delete()
+                except Exception:
+                    pass
+
+    def assert_quiescent(self, block_tables=None) -> None:
+        """Leak audit for an idle pool: every allocatable page is back on
+        the free list, the mirror set agrees with the list exactly, every
+        listed page id is a valid non-scratch pool index, and (when the
+        engine hands its block tables over) no table entry references
+        anything but the reserved scratch page 0. Raises ``AssertionError``
+        naming the violation — the chaos-soak / eviction / supervisor-
+        restart tests call this after every run, so a single leaked page or
+        a diverged mirror fails loudly instead of surfacing later as an
+        allocator mystery."""
+        leaked = self.pages_total - len(self._free)
+        if leaked:
+            raise AssertionError(
+                f"KV page leak: {leaked} of {self.pages_total} pages still "
+                f"allocated on an idle pool")
+        if len(self._free) != len(self._free_set) or \
+                set(self._free) != self._free_set:
+            raise AssertionError(
+                f"free-list/mirror-set divergence: list holds "
+                f"{len(self._free)} entries ({len(set(self._free))} unique), "
+                f"mirror holds {len(self._free_set)}")
+        bad = sorted(p for p in self._free
+                     if not (0 < p < self.geometry.num_pages))
+        if bad:
+            raise AssertionError(f"free list holds invalid page ids {bad} "
+                                 f"(pool has {self.geometry.num_pages} pages, "
+                                 f"page 0 reserved)")
+        if block_tables is not None:
+            import numpy as np
+
+            nz = np.flatnonzero(np.asarray(block_tables))
+            if nz.size:
+                raise AssertionError(
+                    f"{nz.size} block-table entries still reference "
+                    f"non-scratch pages on an idle engine (first flat "
+                    f"indices: {nz[:8].tolist()})")
+
 
 class OutOfPages(RuntimeError):
     """The page pool cannot satisfy an allocation; scheduler-level signal."""

@@ -20,6 +20,14 @@ K/V writes address the pools through host-computed flat positions
 traced program never does page arithmetic; it just ``dynamic_update_slice``s
 at traced scalar positions, which keeps one compiled decode program valid
 for every allocation pattern.
+
+Crash-recovery note: both programs take the page pools as DONATED
+arguments, so a dispatch that fails mid-execution may leave them consumed
+(deleted buffers). The runner's compiled cache entries are keyed on shapes
+only and survive a supervisor restart unchanged — rebuilding after an
+``EngineFault`` means fresh pools (same shapes) plus a re-``bind_decode``;
+no recompilation. The *binding* is engine-owned state (the scheduler drops
+and re-creates it), never stored here.
 """
 
 from __future__ import annotations

@@ -5,7 +5,20 @@ different sequence lengths served by ONE compiled decode program.
 
 - **Admission**: queued requests join a free decode slot when the page pool
   can cover their first prefill chunk; otherwise the queue back-pressures
-  (nothing crashes — pages are the capacity unit).
+  (nothing crashes — pages are the capacity unit). Admission is
+  priority-ordered (highest first, FIFO among equals), the queue is
+  optionally bounded (``max_queue``: overflow sheds the lowest-priority
+  queued request or rejects the newcomer, typed
+  :class:`~thunder_tpu.serving.errors.AdmissionRejected`), and a request
+  whose page demand exceeds the TOTAL pool fails at ``submit()`` with
+  :class:`~thunder_tpu.serving.errors.InfeasibleRequest` instead of
+  queueing forever.
+- **Request SLOs**: ``submit(deadline_s=, priority=)``. Every engine
+  iteration sheds expired queued requests and evicts expired residents
+  with :class:`~thunder_tpu.serving.errors.DeadlineExceeded`
+  (``serving.deadline_misses``); shedding of any kind counts
+  ``serving.shed_requests`` and the rolling on-time completion ratio is
+  the ``serving.slo_attainment`` gauge.
 - **Decode-first with chunked prefill interleaving**: every engine
   iteration runs one batched decode step over all resident requests, plus
   at most ONE prefill chunk of the head-of-line prefilling request — long
@@ -13,14 +26,21 @@ different sequence lengths served by ONE compiled decode program.
 - **Continuous batching**: requests join and leave the decode batch
   mid-flight. Completion (or EOS) frees the request's pages immediately;
   the slot admits the next queued request on the same compiled program.
-- **Preemption**: when the pool runs dry mid-decode, the newest resident
-  request is evicted back to the queue (recompute-on-resume: its generated
-  tokens re-prefill as prompt) — ``serving.preempted_requests`` counts
-  these.
+- **Preemption**: when the pool runs dry mid-decode, the lowest-priority
+  newest resident request is evicted back to the queue (recompute-on-
+  resume: its generated tokens re-prefill as prompt) —
+  ``serving.preempted_requests`` counts these.
 - **Dispatch**: the decode step is bound (``bind()``, zero-guard) and runs
-  under the ``step`` fault domain with retry — a transient injected or XLA
-  fault re-runs the same step; kernel crashes still take the normal
-  quarantine path inside the bound call.
+  under the ``step`` + ``serving:decode`` fault domains with retry (prefill
+  under ``serving:prefill``) — a transient injected or XLA fault re-runs
+  the same step; kernel crashes still take the normal quarantine path
+  inside the bound call. A failure that CONSUMED the donated page pools
+  mid-execution (the ``serving:engine`` domain simulates this) escalates
+  as :class:`~thunder_tpu.serving.errors.EngineFault`: in-place retry is
+  impossible, and the :class:`~thunder_tpu.serving.supervisor
+  .EngineSupervisor` restart — pool rebuild + re-prefill of every
+  in-flight request via :meth:`ServingEngine.rebuild_after_fault` — is the
+  engine-level fallback rung.
 
 Greedy sampling (argmax) — the engine is a throughput/latency runtime, not
 a sampling library; temperature sampling stays in ``models.llama.generate``.
@@ -39,10 +59,18 @@ from thunder_tpu.observe import registry as _observe
 from thunder_tpu.runtime import faults as _faults
 from thunder_tpu.runtime import quarantine as _quarantine
 from thunder_tpu.runtime import retry as _retry
+from thunder_tpu.serving.errors import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    EngineFault,
+    EngineStallError,
+    InfeasibleRequest,
+)
 from thunder_tpu.serving.kv_cache import PagedKVCache, PageGeometry
 from thunder_tpu.serving.runner import PagedLlamaRunner
 
-QUEUED, PREFILL, DECODE, DONE = "queued", "prefill", "decode", "done"
+QUEUED, PREFILL, DECODE, DONE, SHED = \
+    "queued", "prefill", "decode", "done", "shed"
 
 
 @dataclass(eq=False)  # identity semantics: requests live in slot lists
@@ -53,8 +81,11 @@ class Request:
     max_new_tokens: int
     request_id: int
     eos_id: int | None = None
+    priority: int = 0                   # higher = more important (shed last)
+    deadline_at: float | None = None    # absolute perf_counter deadline
     submitted_s: float = 0.0
     state: str = QUEUED
+    error: BaseException | None = None  # set when state == SHED
     pages: list = field(default_factory=list)   # allocated page ids, in order
     prefilled: int = 0                  # work-prompt tokens written so far
     length: int = 0                     # context tokens written into the cache
@@ -64,13 +95,15 @@ class Request:
     finished_s: float | None = None
     decode_start_s: float | None = None
     preemptions: int = 0
+    restarts: int = 0                   # supervisor crash-recovery re-admits
     admit_seq: int = -1                 # admission order (preemption victim pick)
     pages_version: int = 0              # bumped when ``pages`` changes
 
     @property
     def work_prompt(self) -> np.ndarray:
         """What prefill must write: the original prompt plus any tokens
-        generated before a preemption (recompute-on-resume)."""
+        generated before a preemption or engine restart
+        (recompute-on-resume)."""
         if not self.generated:
             return self.prompt
         return np.concatenate(
@@ -79,6 +112,12 @@ class Request:
     @property
     def done(self) -> bool:
         return self.state == DONE
+
+    @property
+    def failed(self) -> bool:
+        """True when the engine shed this request (``error`` says why:
+        ``DeadlineExceeded`` or ``AdmissionRejected``)."""
+        return self.state == SHED
 
     def output(self) -> np.ndarray:
         return np.asarray(self.generated, np.int32)
@@ -89,19 +128,21 @@ class ServingEngine:
 
     >>> eng = ServingEngine(params, cfg, max_slots=8, page_size=16,
     ...                     max_context=256, n_layers=2)
-    >>> r = eng.submit([1, 2, 3], max_new_tokens=16)
+    >>> r = eng.submit([1, 2, 3], max_new_tokens=16, deadline_s=30.0)
     >>> eng.drain()
     >>> r.output()
 
     ``max_slots`` is the compiled decode batch width; ``num_pages`` sizes
     the shared pool (default: full residency for every slot — shrink it to
-    exercise admission back-pressure and preemption).
+    exercise admission back-pressure and preemption); ``max_queue`` bounds
+    the admission queue (``None`` = unbounded; overflow sheds by priority).
     """
 
     def __init__(self, params, cfg, *, max_slots: int = 8, page_size: int = 16,
                  num_pages: int | None = None, max_context: int | None = None,
                  prefill_chunk: int | None = None, n_layers: int | None = None,
-                 executors=None, retry_policy=None, block_fusion=None):
+                 max_queue: int | None = None, executors=None,
+                 retry_policy=None, block_fusion=None):
         self.params = params
         self.cfg = cfg
         n_layers_eff = n_layers if n_layers is not None else cfg.n_layers
@@ -139,12 +180,17 @@ class ServingEngine:
                                        executors=executors,
                                        block_fusion=block_fusion)
         self.max_slots = int(max_slots)
+        self.max_queue = max_queue
         self.slots: list[Request | None] = [None] * self.max_slots
         self.queue: deque[Request] = deque()
         self.completed: list[Request] = []
+        self.shed: list[Request] = []
+        self.admitting = True           # stop_admissions() flips this
         self._ids = itertools.count()
         self._admits = itertools.count()
         self._step_count = 0
+        self._slo_attained = 0          # on-time completions
+        self._slo_total = 0             # terminal requests (done + shed)
         # serving is latency-sensitive: quick retries, no long backoff
         self._retry_policy = retry_policy or _retry.RetryPolicy(
             max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
@@ -162,9 +208,17 @@ class ServingEngine:
 
     # -- public API ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *,
-               eos_id: int | None = None) -> Request:
-        """Enqueue a request. Raises if it could never fit the context
-        window or the page pool (capacity contract, checked up front)."""
+               eos_id: int | None = None, deadline_s: float | None = None,
+               priority: int = 0) -> Request:
+        """Enqueue a request. ``deadline_s`` is the SLO budget from now
+        (expiry sheds the request with ``DeadlineExceeded``); ``priority``
+        orders admission and shedding (higher survives longer).
+
+        Raises ``InfeasibleRequest`` when the request could never run on
+        this engine (capacity contract, checked up front — an infeasible
+        prompt must not queue forever and wedge ``drain()``) and
+        ``AdmissionRejected`` when admissions are stopped or the bounded
+        queue sheds it."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -172,7 +226,7 @@ class ServingEngine:
             raise ValueError("max_new_tokens must be >= 1")
         total = int(prompt.size) + int(max_new_tokens)
         if total > self.max_context:
-            raise ValueError(
+            raise InfeasibleRequest(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the engine context window ({self.max_context})")
         # worst-case page footprint: the larger of the final context and the
@@ -181,20 +235,49 @@ class ServingEngine:
         # final context — e.g. a 33-token prompt prefills as one 64 chunk)
         worst = max(total, self._padded_prefill_len(total))
         if self.geom.pages_for(worst) > self.cache.pages_total:
-            raise ValueError(
+            raise InfeasibleRequest(
                 f"request needs up to {self.geom.pages_for(worst)} KV pages; "
                 f"the pool only has {self.cache.pages_total} — enlarge "
                 f"num_pages")
+        now = time.perf_counter()
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       request_id=next(self._ids), eos_id=eos_id,
-                      submitted_s=time.perf_counter())
+                      priority=int(priority),
+                      deadline_at=None if deadline_s is None
+                      else now + float(deadline_s),
+                      submitted_s=now)
+        if not self.admitting:
+            err = AdmissionRejected(
+                f"request {req.request_id} rejected: engine is draining, "
+                f"admissions are stopped", request_id=req.request_id)
+            self._shed(req, err)
+            raise err
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            # max_queue=0 is a legal admit-or-reject config: no queued
+            # victim exists, so the newcomer is always the one rejected
+            victim = min(self.queue,
+                         key=lambda r: (r.priority, -r.request_id)) \
+                if self.queue else None
+            if victim is None or victim.priority >= req.priority:
+                err = AdmissionRejected(
+                    f"request {req.request_id} rejected: admission queue "
+                    f"full ({self.max_queue}) and every queued request has "
+                    f"priority >= {req.priority}", request_id=req.request_id)
+                self._shed(req, err)
+                raise err
+            self._shed(victim, AdmissionRejected(
+                f"request {victim.request_id} (priority {victim.priority}) "
+                f"shed from the full admission queue for higher-priority "
+                f"request {req.request_id}", request_id=victim.request_id))
         self.queue.append(req)
         self._gauges()
         return req
 
     def step(self) -> bool:
-        """One engine iteration: admit, one batched decode step, prefill.
-        Returns whether any work was done (False = idle).
+        """One engine iteration: expire deadlines, admit, one batched decode
+        step, prefill. Returns whether any scheduling progress was made
+        (False = idle — and ``drain()`` treats a no-progress step with work
+        remaining as a stall, not as quiet completion).
 
         Decode-first, chunked prefill interleaving: with a well-filled
         decode batch, prefill advances ONE chunk per iteration (a long
@@ -203,8 +286,9 @@ class ServingEngine:
         reach the decode batch quickly instead of trickling in one chunk
         per decode step."""
         self._step_count += 1
-        self._admit()
-        worked = self._decode_step()
+        worked = self._expire_deadlines()
+        worked = self._admit() or worked
+        worked = self._decode_step() or worked
         decoding = sum(1 for r in self.slots
                        if r is not None and r.state == DECODE)
         budget = 1 if decoding > self.max_slots // 2 else self.max_slots
@@ -217,35 +301,183 @@ class ServingEngine:
         return worked
 
     def drain(self, max_steps: int = 1_000_000) -> list[Request]:
-        """Run until every submitted request completes (or no progress is
-        possible). Returns the completed requests, completion order."""
+        """Run until every submitted request reaches a terminal state
+        (completed or shed). Returns the completed requests in completion
+        order. A step that makes NO progress (nothing admitted, prefilled,
+        decoded, or shed) while requests remain raises
+        ``EngineStallError`` naming the stuck requests — as does burning
+        ``max_steps`` — instead of returning silently with work wedged."""
         for _ in range(max_steps):
-            if not (self.queue or any(s is not None for s in self.slots)):
+            if self.idle:
                 break
             if not self.step():
-                break
+                raise self._stall_error("no-progress step")
+        else:
+            if not self.idle:
+                raise self._stall_error(f"no completion in {max_steps} steps")
         return self.completed
+
+    def stop_admissions(self) -> None:
+        """Graceful-drain entry: every later ``submit()`` raises
+        ``AdmissionRejected``; resident and queued requests keep running."""
+        self.admitting = False
+
+    def shed_outstanding(self, reason: str) -> list[Request]:
+        """Shed every queued and resident request with ``DeadlineExceeded``
+        (the graceful-drain wall-clock bound expired). Pages return to the
+        free list; outputs produced so far stay readable on the request."""
+        victims = list(self.queue) + [r for r in self.slots if r is not None]
+        for req in victims:
+            self._shed(req, DeadlineExceeded(
+                f"request {req.request_id} shed: {reason}",
+                request_id=req.request_id))
+        return victims
+
+    def rebuild_after_fault(self) -> list[Request]:
+        """Crash recovery (the supervisor's restart rung): discard the
+        consumed device pools, build fresh ones, drop the stale decode
+        binding, and re-queue every in-flight request for recompute-on-
+        resume re-prefill — the same discipline as ``_preempt``, so
+        surviving outputs stay token-identical to a fault-free run. The
+        compiled prefill/decode programs survive (same shapes, same cache
+        entries); only the pools and the binding are rebuilt."""
+        residents = sorted((r for r in self.slots if r is not None),
+                           key=lambda r: r.admit_seq, reverse=True)
+        for req in residents:
+            self.slots[self.slots.index(req)] = None
+            req.pages = []          # the pool they lived in is gone
+            req.pages_version += 1
+            req.prefilled = 0
+            req.length = 0
+            req.next_token = None
+            req.state = QUEUED
+            req.restarts += 1
+            self.queue.appendleft(req)  # reverse admit order -> FIFO resume
+        self.cache = PagedKVCache(self.geom, self.cfg.dtype.jax)
+        self._decode_bound = None
+        self._bound_epoch = -1
+        self._np_bt[:] = 0
+        self._bt_slot_version = [None] * self.max_slots
+        self._gauges()
+        return residents
+
+    def assert_quiescent(self) -> None:
+        """Leak audit: the engine must be idle with every KV page back on
+        the free list and every block-table row pointing only at the
+        scratch page (see ``PagedKVCache.assert_quiescent``)."""
+        busy = [r.request_id for r in self.slots if r is not None]
+        if busy or self.queue:
+            raise AssertionError(
+                f"engine not idle: resident {busy}, "
+                f"queued {[r.request_id for r in self.queue]}")
+        self.cache.assert_quiescent(self._np_bt)
+
+    def reset_slo_window(self) -> None:
+        """Restart SLO-attainment accounting (benchmarks: exclude warmup)."""
+        self._slo_attained = 0
+        self._slo_total = 0
 
     @property
     def active_requests(self) -> int:
         return sum(s is not None for s in self.slots)
 
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not any(s is not None for s in self.slots)
+
     # -- scheduling internals -----------------------------------------------
+    def _stall_error(self, why: str) -> EngineStallError:
+        stuck = [(r.request_id, r.state) for r in self.queue]
+        stuck += [(r.request_id, r.state)
+                  for r in self.slots if r is not None]
+        return EngineStallError(
+            f"engine stalled ({why}) with {len(stuck)} request(s) "
+            f"outstanding: {stuck} — free pages "
+            f"{self.cache.pages_free}/{self.cache.pages_total}", stuck=stuck)
+
     def _gauges(self) -> None:
         _observe.set_gauge("serving.queue_depth", len(self.queue))
         _observe.set_gauge("serving.active_requests", self.active_requests)
         _observe.set_gauge("serving.kv_pages_free", self.cache.pages_free)
+        if self._slo_total:
+            _observe.set_gauge("serving.slo_attainment",
+                               self._slo_attained / self._slo_total)
 
-    def _admit(self) -> None:
+    def _expire_deadlines(self) -> bool:
+        """Shed expired queued requests and evict expired residents —
+        deadline-aware scheduling's enforcement point, once per step."""
+        now = time.perf_counter()
+        expired = [r for r in self.queue
+                   if r.deadline_at is not None and now > r.deadline_at]
+        expired += [r for r in self.slots
+                    if r is not None and r.deadline_at is not None
+                    and now > r.deadline_at]
+        for req in expired:
+            self._shed(req, DeadlineExceeded(
+                f"request {req.request_id} missed its deadline "
+                f"({req.deadline_at - req.submitted_s:.3f}s) in state "
+                f"{req.state}", request_id=req.request_id,
+                deadline_s=req.deadline_at - req.submitted_s))
+        return bool(expired)
+
+    def _shed(self, req: Request, error: BaseException) -> None:
+        """Terminal removal with a typed error: from the queue, from a
+        slot (pages freed, block-table row zeroed), or pre-admission."""
+        shed_from = req.state           # the state it was shed FROM
+        if req in self.queue:
+            self.queue.remove(req)
+        elif req in self.slots:
+            self._release_slot(req)
+        req.state = SHED
+        req.error = error
+        req.finished_s = time.perf_counter()
+        self.shed.append(req)
+        self._slo_total += 1
+        _observe.inc("serving.shed_requests")
+        if isinstance(error, DeadlineExceeded):
+            _observe.inc("serving.deadline_misses")
+        _observe.event("serving_shed", request=req.request_id,
+                       priority=req.priority, state=shed_from,
+                       reason=type(error).__name__,
+                       generated=len(req.generated))
+        self._gauges()
+
+    def _release_slot(self, req: Request) -> None:
+        """Return a resident request's pages and zero its block-table row
+        (the quiescence invariant: idle rows reference only page 0)."""
+        slot = self.slots.index(req)
+        self.cache.free(req.pages)
+        req.pages = []
+        req.pages_version += 1
+        self.slots[slot] = None
+        self._np_bt[slot] = 0
+        self._bt_slot_version[slot] = None
+
+    def _admit(self) -> bool:
+        admitted = False
         while self.queue:
             slot = next((i for i, s in enumerate(self.slots) if s is None), None)
             if slot is None:
-                return
-            req = self.queue[0]
+                break
+            # priority-ordered admission: highest priority first, FIFO among
+            # equals (all-default-priority traffic keeps the old strict FIFO)
+            req = max(self.queue, key=lambda r: r.priority)
             first_chunk = self._chunk_size(len(req.work_prompt))
             if not self.cache.can_alloc(first_chunk // self.geom.page_size):
-                return  # page back-pressure: wait for completions/evictions
-            self.queue.popleft()
+                break   # page back-pressure: wait for completions/evictions
+            try:
+                _faults.maybe_fail("serving:admission", step=self._step_count)
+            except _faults.InjectedFault as e:
+                # contained: the request stays queued and this step's
+                # admission round aborts; the next step retries it. The
+                # deferral COUNTS as progress — drain() must read it as
+                # "the engine deliberately waited", not as a stall (a
+                # permanent admission fault still bounds out via max_steps)
+                _observe.event("serving_admission_fault", error=repr(e),
+                               request=req.request_id)
+                admitted = True
+                break
+            self.queue.remove(req)
             req.pages = self.cache.alloc(first_chunk // self.geom.page_size)
             req.pages_version += 1
             req.prefilled = 0
@@ -253,6 +485,8 @@ class ServingEngine:
             req.state = PREFILL
             req.admit_seq = next(self._admits)
             self.slots[slot] = req
+            admitted = True
+        return admitted
 
     def _chunk_size(self, remaining: int) -> int:
         return self.max_chunk if remaining >= self.max_chunk \
@@ -269,6 +503,33 @@ class ServingEngine:
         bt = np.zeros(self.geom.pages_per_request, np.int32)
         bt[:len(req.pages)] = req.pages
         return bt
+
+    def _dispatch_guarded(self, dispatch, domain: str):
+        """Run a pool-donating dispatch under retry. A retryable failure
+        that consumed the donated pools mid-execution escalates FATAL (a
+        blind re-run would crash on deleted buffers every attempt), and any
+        failure that leaves the pools dead surfaces as ``EngineFault`` —
+        the supervisor's restart signal."""
+        def classify(exc):
+            kind = _retry.classify(exc)
+            if kind == _retry.RETRYABLE and not self.cache.pools_alive():
+                return _retry.FATAL
+            return kind
+
+        try:
+            return _retry.call_with_retry(dispatch, domain=domain,
+                                          policy=self._retry_policy,
+                                          classify_fn=classify)
+        except (KeyboardInterrupt, SystemExit, GeneratorExit):
+            raise
+        except BaseException as e:
+            if not self.cache.pools_alive():
+                raise EngineFault(
+                    f"{domain} dispatch consumed the donated page pools; "
+                    f"in-place retry is impossible — supervisor restart "
+                    f"(pool rebuild + re-prefill) required", domain=domain) \
+                    from e
+            raise
 
     def _prefill_one(self) -> bool:
         """Advance the head-of-line prefilling request by ONE chunk."""
@@ -294,10 +555,17 @@ class ServingEngine:
         page_writes = np.asarray(
             [req.pages[first_page + i] * g.page_size for i in range(C // g.page_size)],
             np.int32)
+
+        def dispatch():
+            # the fault hook fires BEFORE the device dispatch, so a retried
+            # injected fault re-runs on unconsumed inputs
+            _faults.maybe_fail("serving:prefill", step=self._step_count)
+            return self.runner.prefill_jit(
+                self.params, chunk, self._block_table(req)[None], lengths,
+                page_writes, np.int32(real - 1), self.cache.pools)
+
         t0 = time.perf_counter()
-        logits, pools = self.runner.prefill_jit(
-            self.params, chunk, self._block_table(req)[None], lengths,
-            page_writes, np.int32(real - 1), self.cache.pools)
+        logits, pools = self._dispatch_guarded(dispatch, "serving:prefill")
         self.cache.update_pools(pools)
         _observe.observe_value("serving.prefill_ms",
                                (time.perf_counter() - t0) * 1e3)
@@ -313,15 +581,19 @@ class ServingEngine:
         return True
 
     def _grow_pages(self, req: Request, n: int) -> bool:
-        """Allocate ``n`` more pages for ``req``, preempting the newest
-        resident request (possibly ``req`` itself) while the pool is dry."""
+        """Allocate ``n`` more pages for ``req``, preempting the lowest-
+        priority newest resident request (possibly ``req`` itself) while
+        the pool is dry."""
         while not self.cache.can_alloc(n):
-            victim = max((r for r in self.slots
+            victim = min((r for r in self.slots
                           if r is not None and r.state in (DECODE, PREFILL)
                           and r is not req),
-                         key=lambda r: r.admit_seq, default=None)
-            if victim is None:
-                # nothing else to evict: requeue req itself and wait
+                         key=lambda r: (r.priority, -r.admit_seq),
+                         default=None)
+            if victim is None or victim.priority > req.priority:
+                # nothing else to evict, or every other resident OUTRANKS
+                # the grower ("higher survives longer" — evicting one would
+                # be a priority inversion): requeue req itself and wait
                 self._preempt(req)
                 return False
             self._preempt(victim)
@@ -332,15 +604,12 @@ class ServingEngine:
     def _preempt(self, req: Request) -> None:
         """Evict a resident request back to the queue head (recompute-on-
         resume). Its pages return to the free list immediately."""
-        self.cache.free(req.pages)
-        req.pages = []
-        req.pages_version += 1
+        self._release_slot(req)
         req.prefilled = 0
         req.length = 0
         req.next_token = None
         req.state = QUEUED
         req.preemptions += 1
-        self.slots[self.slots.index(req)] = None
         self.queue.appendleft(req)
         _observe.inc("serving.preempted_requests")
         _observe.event("serving_preempt", request=req.request_id,
@@ -366,9 +635,9 @@ class ServingEngine:
             r = self.slots[i]
             if r is None or r.state != DECODE:
                 # idle slots attend + scribble on the reserved page 0 only
-                # (their block-table row is zeroed once on going idle, so
-                # the documented invariant holds exactly: idle slots never
-                # read a live request's pages)
+                # (their block-table row is zeroed when the slot is
+                # released, so the documented invariant holds exactly:
+                # idle slots never read a live request's pages)
                 tokens[i, 0] = 0
                 lengths[i] = 1
                 write_pos[i] = 0
@@ -387,9 +656,20 @@ class ServingEngine:
                             + r.length % g.page_size)
 
         def dispatch():
-            # the `step` fault domain fires BEFORE the device dispatch, so a
-            # retried injected fault re-runs on unconsumed inputs
+            # injected faults fire BEFORE the device dispatch, so a retried
+            # transient re-runs on unconsumed inputs (`step` is the legacy
+            # domain; `serving:decode` the serving-layer one)
             _faults.maybe_fail("step", step=self._step_count)
+            _faults.maybe_fail("serving:decode", step=self._step_count)
+            try:
+                _faults.maybe_fail("serving:engine", step=self._step_count)
+            except _faults.InjectedFault:
+                # the engine domain simulates the REAL fatal failure mode —
+                # a mid-execution accelerator fault that consumed the
+                # donated page pools — so the supervisor's restart rung is
+                # exercisable deterministically on CPU
+                self.cache.consume_pools()
+                raise
             # a quarantine containment inside a previous bound call
             # recompiled under a NEW cache entry (epoch bump); re-bind so
             # the fallback program serves — the stale bound entry would
@@ -417,33 +697,12 @@ class ServingEngine:
             return self._decode_bound(self.params, tokens, bt, lengths,
                                       write_pos, self.cache.pools)
 
-        def classify(exc):
-            kind = _retry.classify(exc)
-            if kind == _retry.RETRYABLE and not self._pools_alive():
-                # the failing dispatch CONSUMED the donated page pools
-                # (real accelerator fault mid-execution): a blind re-run
-                # would crash on deleted buffers every attempt — escalate
-                # to the supervisor instead of spinning
-                return _retry.FATAL
-            return kind
-
-        logits, pools = _retry.call_with_retry(dispatch, domain="step",
-                                               policy=self._retry_policy,
-                                               classify_fn=classify)
+        logits, pools = self._dispatch_guarded(dispatch, "serving:decode")
         self.cache.update_pools(pools)
         toks = np.asarray(logits).argmax(-1)    # host sync: honest step end
         for i, r in active:
             r.length += 1
             self._on_token(r, int(toks[i]))
-        return True
-
-    def _pools_alive(self) -> bool:
-        """False when any pool buffer was deleted (consumed by a donated
-        dispatch that then failed) — replay is impossible without them."""
-        for kv in self.cache.pools:
-            for arr in kv.values():
-                if getattr(arr, "is_deleted", lambda: False)():
-                    return False
         return True
 
     def _on_token(self, req: Request, tok: int) -> None:
@@ -457,17 +716,20 @@ class ServingEngine:
             self._finish(req)
 
     def _finish(self, req: Request) -> None:
-        self.cache.free(req.pages)
-        req.pages = []
-        req.pages_version += 1
+        self._release_slot(req)
         req.state = DONE
         req.finished_s = time.perf_counter()
         if req.decode_start_s is not None:
             # per-request decode-phase duration (first token -> completion)
             _observe.observe_value(
                 "serving.decode_ms", (req.finished_s - req.decode_start_s) * 1e3)
-        self.slots[self.slots.index(req)] = None
         self.completed.append(req)
+        self._slo_total += 1
+        if req.deadline_at is None or req.finished_s <= req.deadline_at:
+            self._slo_attained += 1
+        else:
+            # completed, but late: an SLO miss even though tokens shipped
+            _observe.inc("serving.deadline_misses")
         _observe.event("serving_complete", request=req.request_id,
                        generated=len(req.generated),
-                       preemptions=req.preemptions)
+                       preemptions=req.preemptions, restarts=req.restarts)

@@ -1,0 +1,168 @@
+"""Supervised serving-engine lifecycle: crash recovery, graceful drain,
+and a stall watchdog.
+
+The paper's core discipline — every fast path gets an always-available
+fallback rung — extended one level up: the *engine itself* is the fast
+path here, and the fallback rung is a supervised restart. PR 7/8 gave
+training this story (fault domains, retry budgets, quarantine, the
+numerics sentinel); :class:`EngineSupervisor` is the serving counterpart:
+
+- **Crash recovery.** When a dispatch fault consumes the donated page
+  pools mid-execution, the engine's retry classifier escalates FATAL and
+  the scheduler raises :class:`~thunder_tpu.serving.errors.EngineFault`.
+  The supervisor rebuilds the pools and the decode binding
+  (:meth:`ServingEngine.rebuild_after_fault`) and re-admits every
+  in-flight request by re-prefilling prompt + generated tokens — PR 10's
+  recompute-on-resume discipline generalized from *preemption* to *crash*
+  recovery, so surviving outputs stay token-identical to a fault-free run.
+- **Restart budget.** Each restart charges a
+  :class:`~thunder_tpu.runtime.retry.RestartBudget` sliding window; an
+  engine failing faster than restarts can honestly mask escalates
+  :class:`~thunder_tpu.serving.errors.RestartBudgetExceeded` to the
+  caller instead of flapping forever.
+- **Graceful drain/shutdown.** :meth:`drain` stops admissions (later
+  ``submit()`` raises ``AdmissionRejected``), finishes residents under an
+  optional wall-clock bound (expiry sheds the rest with
+  ``DeadlineExceeded``), and records the whole episode in the
+  ``serving.drain_ms`` histogram.
+- **Stall watchdog.** With ``heartbeat_path=`` set, every :meth:`step`
+  publishes a heartbeat and an :class:`~thunder_tpu.elastic.Watchdog`
+  thread escalates when it goes stale — a dispatch hung inside the device
+  never raises, but its heartbeat age climbs
+  (``runtime.heartbeat_age_s``) and ``on_stall`` fires instead of the
+  engine hanging forever unobserved.
+
+>>> sup = EngineSupervisor(engine, max_restarts=3, restart_window_s=600.0)
+>>> req = sup.submit(prompt, max_new_tokens=32, deadline_s=30.0)
+>>> sup.drain(deadline_s=120.0)   # stop admissions, finish residents
+>>> sup.shutdown()
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from thunder_tpu.observe import registry as _observe
+from thunder_tpu.runtime import retry as _retry
+from thunder_tpu.serving.errors import EngineFault, RestartBudgetExceeded
+from thunder_tpu.serving.scheduler import Request, ServingEngine
+
+
+class EngineSupervisor:
+    """Wraps a :class:`ServingEngine` with the restart/drain/watchdog
+    lifecycle. All request traffic should flow through the supervisor
+    (``submit``/``step``/``drain``) so faults recover transparently."""
+
+    def __init__(self, engine: ServingEngine, *,
+                 restart_budget: _retry.RestartBudget | None = None,
+                 max_restarts: int = 3, restart_window_s: float = 600.0,
+                 heartbeat_path: str | None = None,
+                 stall_timeout_s: float = 30.0,
+                 on_stall: Callable[[float], None] | None = None):
+        self.engine = engine
+        self.budget = restart_budget or _retry.RestartBudget(
+            max_restarts=max_restarts, window_s=restart_window_s)
+        self.restarts = 0
+        self.on_stall = on_stall
+        self.heartbeat = None
+        self.watchdog = None
+        if heartbeat_path is not None:
+            from thunder_tpu.elastic import Heartbeat, Watchdog
+
+            self.heartbeat = Heartbeat(heartbeat_path)
+            self.watchdog = Watchdog(heartbeat_path, stall_timeout_s,
+                                     escalate=self._escalate_stall).start()
+
+    # -- request traffic ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, **kwargs) -> Request:
+        """Delegates to the engine (draining engines raise
+        ``AdmissionRejected`` there — one admission gate, not two)."""
+        return self.engine.submit(prompt, max_new_tokens, **kwargs)
+
+    def step(self) -> bool:
+        """One supervised engine iteration: publish the heartbeat, run the
+        engine step, and turn an ``EngineFault`` into a budget-charged
+        restart instead of a crash. Returns whether progress was made
+        (a restart counts — recovery IS progress)."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat(self.engine._step_count)
+        try:
+            return self.engine.step()
+        except EngineFault as e:
+            self._restart(e)
+            return True
+
+    def drain(self, *, deadline_s: float | None = None,
+              max_steps: int = 1_000_000) -> list[Request]:
+        """Graceful drain: stop admissions, then run residents and queued
+        requests to completion under ``deadline_s`` (wall clock). On bound
+        expiry the remainder is shed with ``DeadlineExceeded``; a
+        no-progress step raises ``EngineStallError`` (same contract as
+        ``ServingEngine.drain``, but each step here is supervised, so an
+        engine fault mid-drain restarts and keeps draining). Records the
+        episode in ``serving.drain_ms`` and returns the completed list."""
+        eng = self.engine
+        eng.stop_admissions()
+        t0 = time.perf_counter()
+        try:
+            for _ in range(max_steps):
+                if eng.idle:
+                    break
+                if deadline_s is not None and \
+                        time.perf_counter() - t0 > deadline_s:
+                    victims = eng.shed_outstanding(
+                        f"drain wall-clock bound ({deadline_s}s) expired")
+                    _observe.event("serving_drain_bound_expired",
+                                   shed=[r.request_id for r in victims])
+                    break
+                if not self.step():
+                    raise eng._stall_error("no-progress step during drain")
+            else:
+                if not eng.idle:
+                    raise eng._stall_error(
+                        f"no completion in {max_steps} drain steps")
+        finally:
+            _observe.observe_value("serving.drain_ms",
+                                   (time.perf_counter() - t0) * 1e3)
+        return eng.completed
+
+    def shutdown(self, *, deadline_s: float | None = None) -> list[Request]:
+        """Drain (bounded when ``deadline_s`` is given), then stop the
+        watchdog thread. Terminal: the engine stays non-admitting."""
+        try:
+            return self.drain(deadline_s=deadline_s)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the watchdog thread (idempotent). Does not drain."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+
+    # -- recovery internals -------------------------------------------------
+    def _escalate_stall(self, age_s: float) -> None:
+        _observe.event("serving_engine_stalled", age_s=age_s,
+                       step=self.engine._step_count)
+        if self.on_stall is not None:
+            self.on_stall(age_s)
+
+    def _restart(self, cause: BaseException) -> None:
+        """The engine-level fallback rung: charge the sliding-window
+        budget, rebuild pools + binding, re-admit in-flight requests."""
+        if not self.budget.record():
+            _observe.event("serving_restart_budget_exhausted",
+                           cause=repr(cause), budget=self.budget.describe())
+            raise RestartBudgetExceeded(
+                f"engine restart budget exhausted "
+                f"({self.budget.describe()}); last fault: {cause!r}",
+                in_window=self.budget.in_window,
+                max_restarts=self.budget.max_restarts) from cause
+        t0 = time.perf_counter()
+        recovered = self.engine.rebuild_after_fault()
+        self.restarts += 1
+        _observe.inc("serving.engine_restarts")
+        _observe.event("serving_engine_restart", cause=repr(cause),
+                       recovered=[r.request_id for r in recovered],
+                       restart_ms=(time.perf_counter() - t0) * 1e3,
+                       budget=self.budget.describe())
