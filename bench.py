@@ -45,13 +45,16 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 6: bench_serve stamps request-timeline summary stats (queue_ms_p50/p99,
+# sched_host_ms_mean / decode_dispatch_ms_mean, prefill_chunks_total,
+# flight_records) from the lifecycle tracing + flight recorder;
 # 5: bench_serve --overload stamps shed_rate / deadline_miss_rate /
 # slo_attainment (request SLOs + supervised engine lifecycle);
 # 4: bench_serve stamps decode_layer_fusions + decode_pallas_launches_per_token
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 5
+METRICS_SCHEMA = 6
 
 
 def main():

@@ -29,9 +29,18 @@ priority shedding over all offered requests), ``deadline_miss_rate``
 wants this at zero for the smoke SLO) and ``slo_attainment`` (the
 engine's rolling on-time ratio over every terminal request).
 
+The continuous line also stamps the schema-6 **request-timeline summary**
+from the serving lifecycle tracing: per-request queue-time percentiles
+(``queue_ms_p50/p99``), the scheduler-iteration split between host
+scheduling and device dispatch (``sched_host_ms_mean`` /
+``decode_dispatch_ms_mean``), total prefill chunks, and the flight-
+recorder record count. ``SERVE_TRACE=/path.json`` additionally exports
+the Perfetto serving timeline (per-request tracks + scheduler track +
+queue/slots/pages counter tracks) of the winning round.
+
 Env: SERVE_MODEL, SERVE_LAYERS, SERVE_REQUESTS, SERVE_DECODE, SERVE_SLOTS,
 SERVE_CONTEXT, SERVE_PAGE, SERVE_CHUNK, SERVE_RATE, SERVE_DEADLINE_S,
-SERVE_QUEUE. ``--smoke``: tiny GQA geometry on CPU.
+SERVE_QUEUE, SERVE_TRACE. ``--smoke``: tiny GQA geometry on CPU.
 """
 
 from __future__ import annotations
@@ -177,6 +186,13 @@ def main():
             "engine_restarts": int(snap["counters"].get(
                 "serving.engine_restarts", 0)),
             "tokens_per_s": round(tok_s, 1)}))
+        trace_path = os.environ.get("SERVE_TRACE")
+        if trace_path:
+            # the overload run is single-round; the registry holds exactly
+            # its spans (reset after warmup), counter tracks ride the ring
+            n = observe.export_chrome_trace(trace_path)
+            print(f"serving timeline: {n} trace events -> {trace_path}",
+                  file=sys.stderr)
         return
 
     # ---- sequential single-stream baseline (dense cache + bind) -----------
@@ -222,6 +238,9 @@ def main():
         return time.perf_counter() - t0, outs
 
     # ---- continuous batching engine ---------------------------------------
+    # SERVE_TRACE=/path.json: capture the Perfetto serving timeline of the
+    # winning continuous round for chrome://tracing / ui.perfetto.dev
+    trace_path = os.environ.get("SERVE_TRACE")
     # pool sized to the workload's full residency (not the whole context
     # window): the scatter-write copies the pool per step on backends
     # without donation, so dead pages cost real bandwidth
@@ -252,6 +271,7 @@ def main():
         eng.completed.clear()
         eng.cache.reset_peak()
         observe.reset()  # per-round metrics (warmup compiles pollute p99)
+        flight_base = observe.flight.get_recorder().total
         pending = sorted(zip(arrivals.tolist(), prompts), key=lambda x: x[0])
         reqs = []
         t0 = time.perf_counter()
@@ -263,13 +283,34 @@ def main():
                 time.sleep(max(0.0, min(pending[0][0] - now, 1e-3)))
         wall = time.perf_counter() - t0
         snap = observe.snapshot()
-        return wall, {
+        # request-timeline summary (schema 6): the lifecycle tracing's
+        # scheduler-iteration spans split host scheduling from dispatch,
+        # and per-request queued time comes off the Request objects
+        sched = [s for s in snap["spans"] if s["cat"] == "serving:sched"]
+        host = [s["dur_us"] / 1e3 for s in sched if s["name"] == "schedule"]
+        disp = [s["dur_us"] / 1e3 for s in sched
+                if s["name"] == "decode_dispatch"]
+        stats = {
             "wall": wall,
             "ttfts": sorted(r.ttft_s * 1e3 for r in reqs),
             "reqs": reqs,
             "preempted": snap["counters"].get("serving.preempted_requests", 0),
             "util_peak": eng.cache.peak_pages_used / eng.cache.pages_total,
+            "queue_ms": sorted(r.queued_ms for r in reqs),
+            "sched_host_ms_mean": sum(host) / len(host) if host else 0.0,
+            "decode_dispatch_ms_mean": sum(disp) / len(disp) if disp else 0.0,
+            "prefill_chunks": sum(r.prefill_chunks for r in reqs),
+            # per-round delta, not the process-lifetime cumulative total:
+            # the stat must describe THIS round like every other stat
+            "flight_records": observe.flight.get_recorder().total - flight_base,
         }
+        if trace_path:
+            # capture per round so the file written at the end really is
+            # the WINNING round's span timeline (the registry resets each
+            # round; counter tracks come from the flight ring and span the
+            # whole process — warmup included — which is documented)
+            stats["trace"] = observe.chrome_trace_dict()
+        return wall, stats
 
     # best-of-N, ALTERNATING the two serving modes per round: single-trial
     # walls swing with machine weather (the bench.py / bench_generate.py
@@ -322,7 +363,20 @@ def main():
         "decode_layer_fusions": decode_layer_fusions,
         "decode_pallas_launches_per_token": decode_launches,
         "decode_launches_per_layer_per_token": round(
-            decode_launches / max(n_layers, 1), 3)}))
+            decode_launches / max(n_layers, 1), 3),
+        # schema-6 request-timeline summary (lifecycle tracing + flight ring)
+        "queue_ms_p50": round(_percentile(cont["queue_ms"], 0.50), 2),
+        "queue_ms_p99": round(_percentile(cont["queue_ms"], 0.99), 2),
+        "sched_host_ms_mean": round(cont["sched_host_ms_mean"], 3),
+        "decode_dispatch_ms_mean": round(cont["decode_dispatch_ms_mean"], 3),
+        "prefill_chunks_total": int(cont["prefill_chunks"]),
+        "flight_records": int(cont["flight_records"])}))
+
+    if trace_path:
+        with open(trace_path, "w") as f:
+            json.dump(cont["trace"], f, default=str)
+        print(f"serving timeline: {len(cont['trace']['traceEvents'])} trace "
+              f"events -> {trace_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

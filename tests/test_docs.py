@@ -89,6 +89,48 @@ def test_serving_metric_names_documented():
         f"serving metrics table (docs/zero_to_thunder_tpu.md): {missing}")
 
 
+def test_serving_event_kinds_documented():
+    """The serving event vocabulary is an ops contract three ways: every
+    kind the code emits must be registered in ``serving.EVENT_KINDS`` and
+    documented in the docs' serving-events table, and every registered or
+    documented kind must still be emitted — a stale vocabulary teaches
+    postmortem triage scripts to match events that never fire (same
+    two-direction pattern as the block-planner decision kinds)."""
+    import glob
+
+    import thunder_tpu
+    from thunder_tpu.serving import EVENT_KINDS
+
+    assert EVENT_KINDS, "serving lost its event vocabulary"
+    pkg_root = os.path.dirname(thunder_tpu.__file__)
+    sources = glob.glob(os.path.join(pkg_root, "**", "*.py"), recursive=True)
+    emitted: set = set()
+    for path in sources:
+        with open(path) as f:
+            emitted |= set(re.findall(
+                r"event\(\s*[\"'](serving_[a-z_]+)[\"']", f.read()))
+    unregistered = sorted(emitted - EVENT_KINDS)
+    assert not unregistered, (
+        f"code emits serving event kinds missing from EVENT_KINDS "
+        f"(thunder_tpu/serving/events.py): {unregistered}")
+    dead = sorted(EVENT_KINDS - emitted)
+    assert not dead, (
+        f"EVENT_KINDS registers kinds no code emits any more: {dead}")
+    with open(DOC) as f:
+        doc = f.read()
+    table_kinds = set(re.findall(r"^\| `(serving_[a-z_]+)` \|", doc, re.M))
+    assert table_kinds, "docs lost the serving event-vocabulary table"
+    undocumented = sorted(EVENT_KINDS - table_kinds)
+    assert not undocumented, (
+        "serving event kinds registered in EVENT_KINDS but missing from the "
+        f"docs serving-events table (docs/zero_to_thunder_tpu.md): "
+        f"{undocumented}")
+    stale = sorted(table_kinds - EVENT_KINDS)
+    assert not stale, (
+        "docs serving-events table documents kinds the code no longer "
+        f"registers: {stale}")
+
+
 def test_block_planner_decision_kinds_documented():
     """Every verdict kind the block planner can emit must appear in the
     KERNELS.md "Reading planner decisions" table — the decision log is an

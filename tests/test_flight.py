@@ -1,0 +1,469 @@
+"""Flight recorder + serving request-lifecycle tracing + postmortem
+bundles: the always-on black box (PR acceptance: a ``serving:engine``
+fault with the registry DISABLED still yields a bundle whose ring holds
+the pre-fault lifecycle), the Perfetto serving timeline (per-request
+tracks, scheduler track, counter tracks), and the explain() request
+timeline. CPU-only, tier-1."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from thunder_tpu import observe
+from thunder_tpu.models import llama
+from thunder_tpu.observe import flight
+from thunder_tpu.observe import registry as obs_registry
+from thunder_tpu.runtime import faults, quarantine
+from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+from thunder_tpu.serving import EngineSupervisor, ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    # quarantine.reset() publishes a gauge, which lands in the flight ring
+    # (always-on!) — reset it BEFORE clearing the ring so tests start from
+    # an empty black box
+    observe.disable()
+    observe.reset()
+    quarantine.reset()
+    flight.clear()
+    yield
+    observe.disable()
+    observe.reset()
+    quarantine.reset()
+    faults.clear()
+    flight.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.CONFIGS["tiny-gqa"]
+    return cfg, llama.init_params(cfg, seed=0, scale_layers=1)
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(max_slots=3, page_size=16, max_context=64, n_layers=1,
+                    prefill_chunk=32)
+    defaults.update(kw)
+    return ServingEngine(params, cfg, **defaults)
+
+
+def _fast_retry():
+    from thunder_tpu.runtime.retry import RetryPolicy
+
+    return RetryPolicy(max_attempts=3, base_delay_s=0.001, max_delay_s=0.01)
+
+
+# ---------------------------------------------------------------------------
+# the ring itself
+# ---------------------------------------------------------------------------
+
+def test_ring_records_with_registry_disabled():
+    """The always-on contract: events, gauge moves, and span edges land in
+    the ring while the registry stays empty. Histogram samples are
+    registry-only — each duplicates an edge the ring already holds as a
+    span or event, and doubling lifecycle edges would halve the black
+    box's usable history."""
+    assert not observe.is_enabled()
+    observe.event("serving_shed", request=1, reason="DeadlineExceeded")
+    observe.set_gauge("serving.queue_depth", 4)
+    observe.observe_value("serving.ttft_ms", 12.5)
+    obs_registry.record_span("queued", "serving:request", 10.0, 5.0,
+                             {"request": 1})
+    with observe.span("ring_span", cat="test"):
+        pass                            # the span() CM is always-on too
+    snap = observe.snapshot()
+    assert snap["events"] == [] and snap["spans"] == []
+    assert snap["gauges"] == {} and snap["histograms"] == {}
+    recs = flight.snapshot()
+    assert {r["type"] for r in recs} == {"event", "gauge", "span"}
+    ev = next(r for r in recs if r["type"] == "event")
+    assert ev["kind"] == "serving_shed" and ev["request"] == 1
+    assert any(r["type"] == "span" and r["name"] == "ring_span"
+               for r in recs)
+    assert all("ts_us" in r for r in recs)
+
+
+def test_ring_is_bounded_and_drops_oldest():
+    rec = flight.get_recorder()
+    old_cap = rec.capacity
+    flight.configure(8)
+    try:
+        for i in range(20):
+            observe.event("serving_submitted", request=i)
+        recs = flight.snapshot()
+        assert len(recs) == 8
+        # oldest fell off the far end; the newest 8 survive
+        assert [r["request"] for r in recs] == list(range(12, 20))
+        assert rec.dropped == 12 and rec.total == 20
+    finally:
+        flight.configure(old_cap)
+
+
+def test_resize_sweeps_appends_that_race_the_swap(monkeypatch):
+    """``append`` is lock-free, so a record can land in the old deque while
+    ``resize`` is mid-swap; the straggler sweep must re-home it into the
+    new ring instead of silently dropping it. Simulated deterministically
+    by appending to the old ring while the new deque is being built."""
+    rec = flight.FlightRecorder(capacity=4)
+    rec.append({"type": "event", "n": 1})
+    old_ring = rec._ring
+    real_deque = flight.deque
+
+    def racing_deque(*args, **kwargs):
+        d = real_deque(*args, **kwargs)
+        old_ring.append({"type": "event", "n": "straggler"})
+        return d
+
+    monkeypatch.setattr(flight, "deque", racing_deque)
+    rec.resize(8)
+    assert [r.get("n") for r in rec.snapshot()] == [1, "straggler"]
+    assert rec.capacity == 8
+
+
+def test_ring_survives_registry_reset_and_enable_clear():
+    """The black box must outlive registry resets (benches reset the
+    registry between rounds; the incident history must not go with it)."""
+    observe.event("serving_submitted", request=7)
+    observe.reset()
+    observe.enable(clear=True)
+    try:
+        assert any(r.get("kind") == "serving_submitted"
+                   for r in flight.snapshot())
+    finally:
+        observe.disable()
+
+
+def test_dump_jsonl_coerces_non_jsonable_fields(tmp_path):
+    """A postmortem dump must never raise on exotic field values."""
+    observe.event("serving_shed", request=1, error=ValueError("boom"),
+                  arr=np.arange(3), scalar=np.float32(2.5), obj=object())
+    path = str(tmp_path / "flight.jsonl")
+    n = flight.dump_jsonl(path)
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert len(recs) == n
+    ev = next(r for r in recs if r.get("kind") == "serving_shed")
+    assert ev["scalar"] == 2.5          # numpy scalar unwrapped, not str'd
+    assert "boom" in ev["error"]
+
+
+# ---------------------------------------------------------------------------
+# serving lifecycle tracing
+# ---------------------------------------------------------------------------
+
+def test_request_lifecycle_spans_and_events(model):
+    """One served request leaves the full span chain (queued -> prefill
+    with chunk spans -> decode -> terminal umbrella) and the lifecycle
+    events in the ring — with the registry disabled throughout."""
+    cfg, params = model
+    eng = _engine(params, cfg)
+    rng = np.random.RandomState(0)
+    req = eng.submit(rng.randint(1, cfg.vocab_size, size=33).astype(np.int32),
+                     max_new_tokens=3)
+    eng.drain()
+    recs = flight.snapshot()
+    spans = [r for r in recs if r["type"] == "span"
+             and r["cat"] == "serving:request"
+             and r["args"].get("request") == req.request_id]
+    names = [s["name"] for s in spans]
+    for expected in ("queued", "prefill", "decode",
+                     f"request {req.request_id}"):
+        assert expected in names, (expected, names)
+    # 33-token prompt at chunk 32 prefills in two chunks
+    assert names.count("prefill_chunk") == 2 and req.prefill_chunks == 2
+    umbrella = next(s for s in spans if s["name"].startswith("request "))
+    assert umbrella["args"]["state"] == "done"
+    assert umbrella["args"]["tokens"] == 3
+    kinds = [r["kind"] for r in recs if r["type"] == "event"
+             and r.get("request") == req.request_id]
+    for expected in ("serving_submitted", "serving_admitted",
+                     "serving_prefill_chunk", "serving_first_token",
+                     "serving_complete"):
+        assert expected in kinds, (expected, kinds)
+    assert req.queued_ms >= 0.0
+    # scheduler-iteration spans: host scheduling vs decode dispatch
+    sched = {r["name"] for r in recs if r["type"] == "span"
+             and r["cat"] == "serving:sched"}
+    assert {"schedule", "decode_dispatch"} <= sched
+
+
+def test_preempt_resume_traced(model):
+    """A preempted request re-enters the queue: a second queued span, a
+    second admission event, and the umbrella span counts the preemption."""
+    cfg, params = model
+    eng = _engine(params, cfg, max_slots=4, page_size=8, num_pages=13,
+                  prefill_chunk=16)
+    rng = np.random.RandomState(0)
+    rs = [eng.submit(rng.randint(1, cfg.vocab_size, size=30).astype(np.int32),
+                     6) for _ in range(4)]
+    eng.drain()
+    assert all(r.done for r in rs)
+    recs = flight.snapshot()
+    preempted = [r["request"] for r in recs
+                 if r["type"] == "event" and r["kind"] == "serving_preempt"]
+    assert preempted
+    rid = preempted[0]
+    queued_spans = [r for r in recs if r["type"] == "span"
+                    and r["cat"] == "serving:request"
+                    and r["name"] == "queued"
+                    and r["args"].get("request") == rid]
+    assert len(queued_spans) >= 2       # initial + post-preempt requeue
+    admits = [r for r in recs if r["type"] == "event"
+              and r["kind"] == "serving_admitted" and r["request"] == rid]
+    assert len(admits) >= 2
+
+
+def test_idle_steps_do_not_flood_the_ring(model):
+    """A wait-for-traffic polling loop on an idle engine must not write to
+    the ring (no schedule spans, no unchanged-gauge republish) — idle
+    polling would otherwise evict the last incident's history from the
+    bounded black box."""
+    cfg, params = model
+    eng = _engine(params, cfg)
+    eng.submit(np.ones(4, np.int32), 2)
+    eng.drain()
+    total0 = flight.get_recorder().total
+    for _ in range(50):
+        assert not eng.step()           # idle: no progress
+    assert flight.get_recorder().total == total0
+
+
+def test_explain_request_timeline_with_registry_disabled(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    rng = np.random.RandomState(0)
+    rs = [eng.submit(rng.randint(1, cfg.vocab_size, size=L).astype(np.int32),
+                     3) for L in (5, 12)]
+    eng.drain()
+    report = observe.explain(eng.runner.decode_jit)
+    assert "== request timeline (flight recorder) ==" in report
+    for r in rs:
+        assert f"req {r.request_id}:" in report
+        assert "-> done (3 tokens)" in report
+    assert "slot occupancy (sampled):" in report
+
+
+# ---------------------------------------------------------------------------
+# Perfetto serving timeline
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_has_request_scheduler_and_counter_tracks(model):
+    """The registry-sourced export: per-request tracks with named phases,
+    the scheduler track, and counter tracks from the ring's gauge series —
+    and the whole object survives json serialization."""
+    cfg, params = model
+    observe.enable(clear=True)
+    try:
+        eng = _engine(params, cfg)
+        rng = np.random.RandomState(0)
+        rs = [eng.submit(rng.randint(1, cfg.vocab_size,
+                                     size=L).astype(np.int32), 3)
+              for L in (5, 17)]
+        eng.drain()
+        trace = observe.chrome_trace_dict()
+    finally:
+        observe.disable()
+    json.dumps(trace)                   # loads as valid Chrome-trace JSON
+    evs = trace["traceEvents"]
+    meta_names = {str(e["args"].get("name")) for e in evs
+                  if e.get("ph") == "M" and "name" in e.get("args", {})}
+    for r in rs:
+        assert f"request {r.request_id}" in meta_names
+    assert "serving scheduler" in meta_names
+    counters = {e["name"] for e in evs if e.get("ph") == "C"}
+    assert {"serving.queue_depth", "serving.active_requests",
+            "serving.kv_pages_free"} <= counters
+    # the phase spans ride the request track, not the raw thread track
+    req_tids = {e["tid"] for e in evs if e.get("ph") == "M"
+                and str(e["args"].get("name", "")).startswith("request ")}
+    phases = [e for e in evs if e.get("ph") == "X"
+              and e.get("cat") == "serving:request"]
+    assert phases and all(e["tid"] in req_tids for e in phases)
+
+
+def test_flight_trace_dict_works_registry_off(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    rng = np.random.RandomState(0)
+    eng.submit(rng.randint(1, cfg.vocab_size, size=9).astype(np.int32), 2)
+    eng.drain()
+    assert observe.snapshot()["spans"] == []    # registry really was off
+    trace = observe.flight_trace_dict()
+    json.dumps(trace)
+    phs = {e.get("ph") for e in trace["traceEvents"]}
+    assert {"X", "i", "C", "M"} <= phs
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_postmortem_bundle_on_engine_fault_registry_disabled(model, tmp_path):
+    """THE acceptance path: registry disabled, ``serving:engine`` fault
+    under the supervisor -> a bundle whose flight ring holds the pre-fault
+    lifecycle events, the engine summary shows the crashed state, and the
+    embedded timeline is valid Chrome-trace JSON; recovery then completes
+    token-identically and quiescent."""
+    cfg, params = model
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, size=L).astype(np.int32)
+               for L in (5, 9, 17)]
+    refs = [np.asarray(llama.generate(params, cfg, p[None], 6, n_layers=1))[0]
+            for p in prompts]
+    eng = _engine(params, cfg, retry_policy=_fast_retry())
+    sup = EngineSupervisor(eng, max_restarts=2, restart_window_s=600.0,
+                           postmortem_dir=str(tmp_path))
+    reqs = [sup.submit(p, 6) for p in prompts]
+    with faults.active(FaultPlan([FaultSpec("serving:engine",
+                                            at_steps={4})])):
+        sup.drain()
+    assert sup.restarts == 1
+    for r, ref in zip(reqs, refs):
+        assert r.done
+        np.testing.assert_array_equal(r.output(), ref)
+    eng.assert_quiescent()
+
+    bundles = [d for d in os.listdir(tmp_path) if d.startswith("postmortem-")]
+    assert len(bundles) == 1 and "EngineFault" in bundles[0]
+    bundle = tmp_path / bundles[0]
+    manifest = json.loads((bundle / "MANIFEST.json").read_text())
+    assert manifest["cause_type"] == "EngineFault"
+    assert manifest["registry_enabled"] is False
+    assert manifest["errors"] == []
+    assert manifest["flight_records"] > 0
+    with open(bundle / "flight.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    assert len(recs) == manifest["flight_records"]
+    kinds = {r.get("kind") for r in recs if r["type"] == "event"}
+    assert {"serving_submitted", "serving_admitted",
+            "serving_prefill_chunk", "serving_first_token"} <= kinds
+    state = json.loads((bundle / "engine.json").read_text())
+    assert state["pools_alive"] is False        # dumped while crashed
+    assert state["slots"] and "engine not idle" in state["quiescence"]
+    timeline = json.loads((bundle / "timeline.json").read_text())
+    assert isinstance(timeline["traceEvents"], list)
+    assert any(e.get("ph") == "C" for e in timeline["traceEvents"])
+    assert isinstance(json.loads((bundle / "decisions.json").read_text()),
+                      list)
+    # the dump itself is a recorded lifecycle edge
+    assert any(r.get("kind") == "serving_postmortem"
+               for r in flight.snapshot())
+
+
+@pytest.mark.chaos
+def test_restart_budget_exhaustion_dumps_bundle(model, tmp_path):
+    from thunder_tpu.serving import RestartBudgetExceeded
+    from thunder_tpu.runtime.retry import RestartBudget
+
+    cfg, params = model
+    eng = _engine(params, cfg, retry_policy=_fast_retry())
+    sup = EngineSupervisor(eng, restart_budget=RestartBudget(
+        max_restarts=1, window_s=3600.0), postmortem_dir=str(tmp_path))
+    sup.submit(np.ones(5, np.int32), 8)
+    with faults.active(FaultPlan([FaultSpec("serving:engine", every_n=3,
+                                            transient=False)])):
+        with pytest.raises(RestartBudgetExceeded):
+            sup.drain()
+    labels = sorted(d.split("-")[-1] for d in os.listdir(tmp_path))
+    # every EngineFault dumped, plus the budget-exhaustion escalation
+    assert "RestartBudgetExceeded" in labels
+    assert labels.count("EngineFault") == 2
+
+
+def test_slo_collapse_dumps_once_and_latches(model, tmp_path):
+    """SLO-attainment collapse below the floor is a typed serving failure:
+    one bundle per collapse episode (latched), with the collapse event in
+    the ring."""
+    cfg, params = model
+    eng = _engine(params, cfg)
+    sup = EngineSupervisor(eng, postmortem_dir=str(tmp_path), slo_floor=0.9,
+                           min_slo_samples=2)
+    # expired-on-arrival deadlines: every terminal is an SLO miss
+    for _ in range(3):
+        sup.submit(np.ones(4, np.int32), 2, deadline_s=0.0)
+        sup.step()
+    assert sup._slo_collapsed
+    bundles = [d for d in os.listdir(tmp_path) if "slo_collapse" in d]
+    assert len(bundles) == 1            # latched: no bundle per step
+    assert any(r.get("kind") == "serving_slo_collapse"
+               for r in flight.snapshot())
+    manifest = json.loads(
+        (tmp_path / bundles[0] / "MANIFEST.json").read_text())
+    assert "SLO attainment collapsed" in manifest["cause"]
+    # rearm starts a FRESH window: the historical misses are not re-judged,
+    # so no second bundle dumps on the next step
+    sup.rearm_slo()
+    sup.step()
+    assert not sup._slo_collapsed
+    assert len([d for d in os.listdir(tmp_path) if "slo_collapse" in d]) == 1
+
+
+def test_slo_window_reset_detected_even_after_counters_regrow(model,
+                                                              tmp_path):
+    """``reset_slo_window()`` between checks must re-base the supervisor
+    even when the engine's counters regrow PAST the old base before the
+    next check — totals alone can't tell 'reset then regrew' from 'kept
+    growing' (regression: the stale base produced a negative attainment
+    ratio and a bogus slo_collapse bundle for a healthy engine)."""
+    cfg, params = model
+    eng = _engine(params, cfg)
+    sup = EngineSupervisor(eng, postmortem_dir=str(tmp_path), slo_floor=0.5,
+                           min_slo_samples=2)
+    eng._slo_attained, eng._slo_total = 5, 8
+    sup.rearm_slo()                     # base = (5, 8, current generation)
+    eng.reset_slo_window()              # counters -> 0, generation bumps
+    eng._slo_attained = eng._slo_total = 9   # regrew past base_t in one step
+    sup._check_slo()
+    assert not sup._slo_collapsed       # 9/9 attained: healthy engine
+    assert sup._slo_base == (0, 0, eng._slo_resets)
+    assert os.listdir(tmp_path) == []   # no bogus bundle
+
+
+def test_slo_min_samples_zero_before_first_terminal_is_safe(model):
+    """``min_slo_samples=0`` means 'judge immediately' — but before the
+    first terminal request there is nothing to judge (regression: 0/0
+    ZeroDivisionError out of step(), killing the loop the supervisor
+    exists to protect)."""
+    cfg, params = model
+    eng = _engine(params, cfg)
+    sup = EngineSupervisor(eng, slo_floor=0.9, min_slo_samples=0)
+    sup._check_slo()
+    assert not sup._slo_collapsed
+
+
+def test_slo_baseline_armed_from_warm_engine(model, tmp_path):
+    """Attaching a supervisor to a warm engine must not judge
+    pre-supervisor history (regression: a zero baseline computed the
+    attainment ratio over terminals that predate the supervisor)."""
+    cfg, params = model
+    eng = _engine(params, cfg)
+    eng._slo_attained, eng._slo_total = 2, 10   # 20% attained, unsupervised
+    sup = EngineSupervisor(eng, postmortem_dir=str(tmp_path), slo_floor=0.5,
+                           min_slo_samples=2)
+    sup._check_slo()
+    assert not sup._slo_collapsed               # history is not re-judged
+    assert os.listdir(tmp_path) == []
+
+
+def test_postmortem_without_dir_is_noop(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    sup = EngineSupervisor(eng)
+    assert sup.dump_postmortem(RuntimeError("x")) is None
+
+
+# ---------------------------------------------------------------------------
+# marker audits (established pattern: tier-1 + chaos)
+# ---------------------------------------------------------------------------
+
+def test_flight_tests_stay_in_tier1():
+    """Marker audit: black-box regressions must fail the gate that runs on
+    every PR, so nothing here may carry the slow marker."""
+    with open(__file__) as f:
+        src = f.read()
+    marker = "mark." + "slow"  # split so this line doesn't trip the scan
+    assert marker not in src, "flight tests must stay in the tier-1 budget"

@@ -35,8 +35,14 @@ def test_disabled_recording_is_a_noop():
     snap = observe.snapshot()
     assert snap["counters"] == {} and snap["gauges"] == {}
     assert snap["histograms"] == {} and snap["events"] == []
-    # the span fast path hands back a shared no-op (no allocation per call)
-    assert observe.span("a") is observe.span("b")
+    # spans are NOT a registry no-op when disabled: the edge must reach the
+    # always-on flight ring (black-box contract), registry stays empty
+    with observe.span("disabled_span", cat="test"):
+        pass
+    assert observe.snapshot()["spans"] == []
+    from thunder_tpu.observe import flight
+    assert any(r["type"] == "span" and r["name"] == "disabled_span"
+               for r in flight.snapshot())
 
 
 def test_enabled_counters_gauges_histograms_events():
@@ -60,11 +66,58 @@ def test_enabled_counters_gauges_histograms_events():
     assert spans and spans[0]["dur_us"] >= 0 and spans[0]["cat"] == "test"
 
 
+def test_enabled_span_is_one_ring_record_plus_registry_histogram():
+    """An enabled span must not double into the flight ring: the span edge
+    IS the black-box record; the derived ``.ms`` histogram sample goes to
+    the registry only (doubling would halve the ring's usable history)."""
+    from thunder_tpu.observe import flight
+
+    observe.enable(clear=True)
+    try:
+        with observe.span("solo", cat="test"):
+            pass
+        recs = [r for r in flight.snapshot()
+                if r.get("name") in ("solo", "test.solo.ms")]
+        assert [r["type"] for r in recs] == ["span"]
+        h = observe.snapshot()["histograms"]["test.solo.ms"]
+        assert h["count"] == 1
+    finally:
+        observe.disable()
+
+
 def test_enable_clear_resets():
     observe.enable(clear=True)
     observe.inc("c")
     observe.enable(clear=True)
     assert observe.snapshot()["counters"] == {}
+
+
+def test_record_span_gates_on_enabled():
+    """Regression: ``record_span`` wrote to the registry unconditionally
+    while every other write path gated on the enabled flag — a disabled
+    process accumulated spans (bounded, but nonzero memory and a lock per
+    span). It must gate like ``inc``/``set_gauge``/``observe_value``/
+    ``event``; the flight ring still gets the edge (that is the always-on
+    black box, not a leak)."""
+    obs_registry.record_span("direct", "test", 1.0, 2.0, {"k": 1})
+    assert observe.snapshot()["spans"] == []
+    observe.enable()
+    obs_registry.record_span("direct", "test", 1.0, 2.0, {"k": 1})
+    spans = observe.snapshot()["spans"]
+    assert [s["name"] for s in spans] == ["direct"]
+
+
+def test_pass_sink_collects_with_registry_off_and_span_gated():
+    """The per-compile ``_pass_sink`` path (CompileStats.last_pass_times)
+    keeps working with the registry off AND leaks nothing into the
+    registry now that record_span gates."""
+    sink: dict = {}
+    with obs_registry.collect_pass_times(sink):
+        with observe.span("outer"):
+            with observe.span("inner"):
+                pass
+    assert sink.get("outer", 0) > 0 and sink.get("outer/inner", 0) > 0
+    assert observe.snapshot()["spans"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -300,6 +353,53 @@ def test_prometheus_export_format(tmp_path):
         metric, value = line.rsplit(" ", 1)
         assert metric.startswith("thunder_tpu_")
         float(value)
+
+
+def test_exports_roundtrip_non_jsonable_field_values(tmp_path):
+    """Events and spans carry arbitrary user values — exceptions, numpy
+    scalars/arrays, whole request objects. EVERY export path must coerce
+    them (``_jsonable``) rather than raise: one exotic value must not lose
+    a trace, a JSONL archive, or a postmortem."""
+    class Opaque:
+        def __repr__(self):
+            return "<Opaque>"
+
+    observe.enable(clear=True)
+    cyclic = {"x": 1}
+    cyclic["self"] = cyclic             # must not recurse forever
+    observe.event("incident", error=ValueError("boom"),
+                  scalar=np.float32(1.5), arr=np.arange(4),
+                  obj=Opaque(), nested={"deep": Opaque(), "n": np.int64(7)},
+                  seq=[np.float64(0.25), Opaque()], loop=cyclic)
+    with observe.span("weird", cat="test",
+                      args={"exc": RuntimeError("x"), "v": np.int32(3)}):
+        pass
+
+    jl = str(tmp_path / "weird.jsonl")
+    assert observe.export_jsonl(jl) > 0
+    recs = [json.loads(line) for line in open(jl)]
+    ev = next(r for r in recs if r["type"] == "event")
+    assert "boom" in ev["error"] and ev["scalar"] == 1.5
+    assert ev["nested"]["n"] == 7 and ev["nested"]["deep"] == "<Opaque>"
+    assert ev["seq"][0] == 0.25
+    # the cyclic container serialized finitely (json.loads above already
+    # proves no RecursionError and valid JSON)
+    assert ev["loop"]["x"] == 1
+    sp = next(r for r in recs if r["type"] == "span" and r["name"] == "weird")
+    assert sp["args"]["v"] == 3 and "x" in sp["args"]["exc"]
+
+    trace = observe.chrome_trace_dict()
+    json.dumps(trace)                   # fully serializable
+    inst = next(e for e in trace["traceEvents"]
+                if e.get("ph") == "i" and e["name"] == "incident")
+    assert inst["args"]["scalar"] == 1.5
+
+    from thunder_tpu.observe import flight
+
+    fl = str(tmp_path / "flight.jsonl")
+    assert flight.dump_jsonl(fl) > 0
+    for line in open(fl):
+        json.loads(line)
 
 
 # ---------------------------------------------------------------------------

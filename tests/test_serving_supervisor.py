@@ -4,6 +4,7 @@ drain/shutdown, the stall watchdog, and the seeded chaos soak spanning all
 four serving fault domains (``serving:prefill`` / ``serving:decode`` /
 ``serving:admission`` / ``serving:engine``)."""
 
+import os
 import re
 import time
 
@@ -218,7 +219,8 @@ def test_watchdog_escalates_stalled_engine(model, tmp_path):
     try:
         eng = _engine(params, cfg)
         sup = EngineSupervisor(eng, heartbeat_path=str(tmp_path / "hb.json"),
-                               stall_timeout_s=0.05, on_stall=stalls.append)
+                               stall_timeout_s=0.05, on_stall=stalls.append,
+                               postmortem_dir=str(tmp_path / "pm"))
         try:
             r = sup.submit(np.ones(4, np.int32), 4)
             sup.step()                          # publishes one heartbeat
@@ -236,6 +238,9 @@ def test_watchdog_escalates_stalled_engine(model, tmp_path):
     assert r.done and done == [r]
     assert snap["counters"]["runtime.watchdog_escalations"] >= 1
     assert any(e["kind"] == "serving_engine_stalled" for e in snap["events"])
+    # the stall dumped a black-box bundle before anyone killed the process
+    stall_bundles = [d for d in os.listdir(tmp_path / "pm") if "stall" in d]
+    assert len(stall_bundles) >= 1
 
 
 # ---------------------------------------------------------------------------
@@ -261,7 +266,8 @@ def test_serving_fault_injection_tests_carry_chaos_marker():
 
     here = os.path.dirname(os.path.abspath(__file__))
     needle = "faults." + "active("  # split so this audit doesn't flag itself
-    for fname in ("test_serving.py", "test_serving_supervisor.py"):
+    for fname in ("test_serving.py", "test_serving_supervisor.py",
+                  "test_flight.py"):
         with open(os.path.join(here, fname)) as f:
             src = f.read()
         tests = list(re.finditer(r"^\s*def (test_\w+)", src, re.M))

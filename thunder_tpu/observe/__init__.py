@@ -12,10 +12,14 @@ makes the compiler's *decisions* inspectable too:
   ``core/fusion_passes.py``, and ``core/rematerialization.py``,
 - runtime step metrics via a wrapper on ``CacheEntry.run_fn``
   (``runtime.py``),
-- exporters: JSONL, Chrome/Perfetto trace, Prometheus text
-  (``exporters.py``),
+- an ALWAYS-ON bounded flight recorder — events, gauge moves, and span
+  edges land in a fixed-size ring even when the registry is disabled, so
+  a serving fault leaves a black box to read back (``flight.py``),
+- exporters: JSONL, Chrome/Perfetto trace (with serving request/scheduler
+  tracks and counter tracks), Prometheus text (``exporters.py``),
 - ``explain(jfn)`` — the human report: who executes each op, why fusions
-  did or didn't fire, where compile time went (``explain.py``).
+  did or didn't fire, where compile time went, and the per-request serving
+  timeline (``explain.py``).
 
 Quick start::
 
@@ -29,11 +33,13 @@ Quick start::
 from __future__ import annotations
 
 from thunder_tpu.observe import decisions  # noqa: F401
+from thunder_tpu.observe import flight  # noqa: F401
 from thunder_tpu.observe.exporters import (  # noqa: F401
     chrome_trace_dict,
     export_chrome_trace,
     export_jsonl,
     export_prometheus,
+    flight_trace_dict,
 )
 from thunder_tpu.observe.explain import explain  # noqa: F401
 from thunder_tpu.observe.registry import (  # noqa: F401

@@ -8,8 +8,13 @@ Answers, from the last compilation of a ``thunder_tpu.jit`` function:
   inputs: token counts, widths, flops/bytes),
 - why each executor claim was accepted or rejected (checker, cost model,
   fuel),
-- where compile time went (per-pass walltimes), and
-- what a step is estimated to cost (liveness peak bytes, collective bytes).
+- where compile time went (per-pass walltimes),
+- what a step is estimated to cost (liveness peak bytes, collective bytes),
+  and
+- the serving request timeline — per-request queue/prefill/decode/TTFT
+  breakdown and the sampled slot-occupancy histogram, read from the
+  ALWAYS-ON flight ring (renders even with the registry disabled — the
+  postmortem reading of this report).
 
 Works without ``observe.enable()`` — the decision log and pass times are
 collected per compile into ``CompileStats`` unconditionally (they are
@@ -29,6 +34,86 @@ def _fmt_cost(cost: dict | None) -> str:
     if not cost:
         return ""
     return " (" + ", ".join(f"{k}={v}" for k, v in cost.items()) + ")"
+
+
+_TIMELINE_MAX_REQUESTS = 16
+
+
+def _request_timeline_lines() -> list[str]:
+    """Per-request lifecycle breakdown from the flight ring: queue time,
+    prefill time + chunk count, decode residency, TTFT, terminal state —
+    plus the sampled slot-occupancy histogram. Empty when the ring holds
+    no serving records."""
+    from thunder_tpu.observe import flight as _flight
+
+    recs = _flight.snapshot()
+    phases: dict[int, dict[str, float]] = {}      # rid -> phase -> total ms
+    chunks: dict[int, int] = {}
+    info: dict[int, dict] = {}                    # rid -> lifecycle facts
+    order: list[int] = []                         # by first appearance
+
+    def _req(rid: int) -> dict:
+        if rid not in info:
+            info[rid] = {}
+            order.append(rid)
+        return info[rid]
+
+    for r in recs:
+        if r["type"] == "span" and r.get("cat") == "serving:request":
+            rid = int(r["args"].get("request", -1))
+            if rid < 0:
+                continue
+            _req(rid)
+            name = r["name"]
+            if name == "prefill_chunk":
+                chunks[rid] = chunks.get(rid, 0) + 1
+            elif name in ("queued", "prefill", "decode"):
+                ph = phases.setdefault(rid, {})
+                ph[name] = ph.get(name, 0.0) + r["dur_us"] / 1e3
+        elif r["type"] == "event":
+            kind = r.get("kind", "")
+            if not str(kind).startswith("serving_") or "request" not in r:
+                continue
+            d = _req(int(r["request"]))
+            if kind == "serving_first_token":
+                d["ttft_ms"] = r.get("ttft_ms")
+            elif kind == "serving_complete":
+                d["terminal"] = f"done ({r.get('generated', '?')} tokens)"
+            elif kind == "serving_shed":
+                d["terminal"] = f"shed ({r.get('reason', '?')})"
+            elif kind == "serving_preempt":
+                d["preemptions"] = d.get("preemptions", 0) + 1
+    if not info:
+        return []
+    out: list[str] = []
+    shown = order[-_TIMELINE_MAX_REQUESTS:]
+    if len(order) > len(shown):
+        out.append(f"  (... {len(order) - len(shown)} earlier request(s) "
+                   f"aged out of this view)")
+    for rid in shown:
+        ph = phases.get(rid, {})
+        d = info[rid]
+        parts = [f"queued {ph.get('queued', 0.0):.1f} ms",
+                 f"prefill {ph.get('prefill', 0.0):.1f} ms "
+                 f"({chunks.get(rid, 0)} chunks)",
+                 f"decode {ph.get('decode', 0.0):.1f} ms"]
+        if d.get("ttft_ms") is not None:
+            parts.append(f"ttft {d['ttft_ms']:.1f} ms")
+        if d.get("preemptions"):
+            parts.append(f"preempted x{d['preemptions']}")
+        out.append(f"  req {rid}: " + ", ".join(parts)
+                   + f" -> {d.get('terminal', 'in flight')}")
+    # sampled slot-occupancy histogram (the engine's active_requests gauge
+    # time series lives in the ring even when the registry is off)
+    occ: dict[int, int] = {}
+    for r in recs:
+        if r["type"] == "gauge" and r.get("name") == "serving.active_requests":
+            v = int(r["value"])
+            occ[v] = occ.get(v, 0) + 1
+    if occ:
+        out.append("  slot occupancy (sampled): " + ", ".join(
+            f"{k} x{occ[k]}" for k in sorted(occ)))
+    return out
 
 
 def explain(jfn) -> str:
@@ -179,6 +264,15 @@ def explain(jfn) -> str:
             lines.append("")
             lines.append("== serving slo/supervision ==")
             lines.extend(slo_lines)
+
+    # -- request timeline (flight recorder) ---------------------------------
+    # sourced from the ALWAYS-ON flight ring, so it renders even when the
+    # registry was never enabled — the postmortem reading of explain()
+    timeline = _request_timeline_lines()
+    if timeline:
+        lines.append("")
+        lines.append("== request timeline (flight recorder) ==")
+        lines.extend(timeline)
 
     # -- step cost estimates ------------------------------------------------
     lines.append("")

@@ -4,12 +4,23 @@
   histogram, event, and span. The grep-able archival format.
 - ``export_chrome_trace(path)`` — a ``chrome://tracing`` / Perfetto-loadable
   JSON object: spans become complete (``ph: "X"``) events on per-thread
-  tracks, registry events become instants (``ph: "i"``). Open the file at
-  chrome://tracing or ui.perfetto.dev to see compile passes and runtime
-  steps on one timeline.
+  tracks, registry events become instants (``ph: "i"``). Serving spans get
+  dedicated tracks — one per request (``cat: "serving:request"``, the
+  request-lifecycle chain) and one for the scheduler iterations
+  (``cat: "serving:sched"``) — and the flight ring's recent gauge samples
+  render as Perfetto counter tracks (queue depth, active slots, free KV
+  pages), so a whole continuous-batching session reads as one timeline.
+- ``flight_trace_dict()`` — the same Chrome-trace object built from the
+  always-on flight ring instead of the registry: what a postmortem bundle
+  embeds when the registry was never enabled.
 - ``export_prometheus([path])`` — Prometheus text exposition format
   (``# TYPE`` comments, ``_count``/``_sum``/``_bucket`` histogram series),
   for scraping or pushing from a serving process.
+
+Every export path routes field values through ``_jsonable`` — events and
+spans carry arbitrary user values (exceptions, numpy scalars, request
+objects), and one non-serializable value must never lose a trace or a
+postmortem.
 """
 
 from __future__ import annotations
@@ -17,9 +28,46 @@ from __future__ import annotations
 import json
 import os
 
+from thunder_tpu.observe import flight as _flight
 from thunder_tpu.observe.registry import HIST_BOUNDS, snapshot
 
 _PREFIX = "thunder_tpu"
+
+# flight gauge samples rendered as Perfetto counter tracks (the registry
+# keeps only the latest gauge value; the ring keeps the time series)
+_COUNTER_TRACKS = ("serving.queue_depth", "serving.active_requests",
+                   "serving.kv_pages_free")
+
+# synthetic tids for the serving tracks (real thread ids land nowhere near)
+_SCHED_TID = 2
+_REQ_TID_BASE = 10_000_000
+
+
+def _jsonable(v, _seen=frozenset()):
+    """Coerce an arbitrary value to something ``json.dumps`` accepts:
+    primitives pass through, containers recurse (cycle-safe: a container
+    already on the current recursion path renders as its ``str`` instead
+    of recursing forever), numpy scalars unwrap via ``.item()``,
+    everything else (exceptions, arrays, request objects) becomes its
+    ``str``."""
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    if isinstance(v, dict):
+        if id(v) in _seen:
+            return str(v)
+        _seen = _seen | {id(v)}
+        return {str(k): _jsonable(x, _seen) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        if id(v) in _seen:
+            return str(v)
+        _seen = _seen | {id(v)}
+        return [_jsonable(x, _seen) for x in v]
+    if getattr(v, "shape", None) == () and callable(getattr(v, "item", None)):
+        try:
+            return _jsonable(v.item())
+        except Exception:
+            pass
+    return str(v)
 
 
 def export_jsonl(path: str) -> int:
@@ -37,55 +85,101 @@ def export_jsonl(path: str) -> int:
             f.write(json.dumps({"type": "histogram", "name": name, **h}) + "\n")
             n += 1
         for e in snap["events"]:
-            f.write(json.dumps({"type": "event", **e}, default=str) + "\n")
+            f.write(json.dumps(_jsonable({"type": "event", **e}),
+                               default=str) + "\n")
             n += 1
         for s in snap["spans"]:
-            f.write(json.dumps({"type": "span", **s}, default=str) + "\n")
+            f.write(json.dumps(_jsonable({"type": "span", **s}),
+                               default=str) + "\n")
             n += 1
     return n
 
 
-def _jsonable(v):
-    return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
-
-
-def chrome_trace_dict() -> dict:
-    """The Chrome Trace Event Format object (before serialization)."""
-    snap = snapshot()
+def _trace_from(spans, events, samples) -> dict:
+    """Build the Chrome Trace Event Format object from span/event/sample
+    record lists (registry- or flight-sourced)."""
     pid = os.getpid()
-    events: list[dict] = []
-    tids = set()
-    for s in snap["spans"]:
-        tids.add(s["tid"])
-        events.append({
-            "name": s["name"], "cat": s["cat"], "ph": "X",
+    out: list[dict] = []
+    tids: set = set()
+    req_tracks: set = set()
+    sched_track = False
+    for s in spans:
+        cat = s["cat"]
+        args = s.get("args") or {}
+        if cat == "serving:request":
+            rid = int(args.get("request", -1))
+            tid = _REQ_TID_BASE + max(rid, 0)
+            req_tracks.add(max(rid, 0))
+        elif cat == "serving:sched":
+            tid = _SCHED_TID
+            sched_track = True
+        else:
+            tid = s["tid"]
+            tids.add(tid)
+        out.append({
+            "name": s["name"], "cat": cat, "ph": "X",
             "ts": s["ts_us"], "dur": s["dur_us"],
-            "pid": pid, "tid": s["tid"],
+            "pid": pid, "tid": tid,
             # user spans take arbitrary args; one non-JSON value must not
             # lose the whole trace
-            "args": {k: _jsonable(v) for k, v in s["args"].items()},
+            "args": {k: _jsonable(v) for k, v in args.items()},
         })
-    for e in snap["events"]:
-        args = {k: v for k, v in e.items() if k not in ("kind", "ts_us")}
-        events.append({
+    for e in events:
+        args = {k: v for k, v in e.items() if k not in ("kind", "ts_us", "type")}
+        out.append({
             "name": e["kind"], "cat": "event", "ph": "i", "s": "p",
             "ts": e["ts_us"], "pid": pid, "tid": 0,
             "args": {k: _jsonable(v) for k, v in args.items()},
         })
+    for smp in samples:
+        if smp.get("name") not in _COUNTER_TRACKS:
+            continue
+        out.append({
+            "name": smp["name"], "ph": "C", "ts": smp["ts_us"],
+            "pid": pid, "args": {"value": _jsonable(smp["value"])},
+        })
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": "thunder_tpu"}}]
+    if sched_track:
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": _SCHED_TID, "args": {"name": "serving scheduler"}})
+        meta.append({"name": "thread_sort_index", "ph": "M", "pid": pid,
+                     "tid": _SCHED_TID, "args": {"sort_index": -2}})
+    for rid in sorted(req_tracks):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": _REQ_TID_BASE + rid,
+                     "args": {"name": f"request {rid}"}})
     for tid in sorted(tids):
         meta.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                      "args": {"name": f"thread-{tid}"}})
-    return {"traceEvents": meta + sorted(events, key=lambda e: e["ts"]),
+    return {"traceEvents": meta + sorted(out, key=lambda e: e["ts"]),
             "displayTimeUnit": "ms"}
+
+
+def chrome_trace_dict() -> dict:
+    """The Chrome Trace Event Format object (before serialization):
+    registry spans + events, plus counter tracks from the flight ring's
+    recent gauge samples."""
+    snap = snapshot()
+    samples = [r for r in _flight.snapshot() if r.get("type") == "gauge"]
+    return _trace_from(snap["spans"], snap["events"], samples)
+
+
+def flight_trace_dict() -> dict:
+    """The Chrome-trace object built ENTIRELY from the flight ring — the
+    postmortem timeline, available with the registry disabled."""
+    recs = _flight.snapshot()
+    spans = [r for r in recs if r.get("type") == "span"]
+    events = [r for r in recs if r.get("type") == "event"]
+    samples = [r for r in recs if r.get("type") == "gauge"]
+    return _trace_from(spans, events, samples)
 
 
 def export_chrome_trace(path: str) -> int:
     """Write a chrome://tracing-loadable trace; returns event count."""
     trace = chrome_trace_dict()
     with open(path, "w") as f:
-        json.dump(trace, f)
+        json.dump(trace, f, default=str)
     return len(trace["traceEvents"])
 
 

@@ -12,6 +12,14 @@ The instrumentation contract for the whole compiler/runtime stack:
 - **Bounded.** Events and spans live in deques with a max length — a
   long-running serving process with observability left on cannot grow
   memory without bound.
+- **Black-boxed.** Events, gauge sets, and span edges ALSO land in the
+  always-on flight recorder (``flight.py``) *before* the enabled gate —
+  one bounded deque append — so a postmortem after a fault has the recent
+  history even when the registry was never enabled. Counters and histogram
+  samples stay out of the ring: ``inc`` is the per-call hot path, every
+  counter-worthy incident also emits an event, and a histogram sample
+  duplicates an edge the ring already holds as a span or event (the
+  aggregate lives in the registry).
 
 Metric names are dotted (``cache.hits``, ``fusion.horizontal_merges``,
 ``step.walltime_ms``); exporters map them to their own conventions
@@ -26,6 +34,9 @@ from collections import deque
 from contextlib import contextmanager
 from contextvars import ContextVar
 from typing import Any
+
+from thunder_tpu.observe import flight as _flight
+from thunder_tpu.observe.flight import _now_us
 
 MAX_EVENTS = 65536
 MAX_SPANS = 65536
@@ -84,18 +95,14 @@ class Registry:
 _registry = Registry()
 _enabled = False
 
-# epoch anchor so span timestamps are wall-clock-meaningful while durations
-# come from the monotonic clock
-_EPOCH_US = time.time() * 1e6 - time.perf_counter_ns() / 1e3
-
-
-def _now_us() -> float:
-    return _EPOCH_US + time.perf_counter_ns() / 1e3
+# the wall-clock/monotonic epoch anchor lives in flight.py (imported above
+# as _now_us) — the registry and the flight ring must share one timeline
 
 
 def enable(*, clear: bool = False) -> None:
     """Turn instrumentation on process-wide. ``clear=True`` resets all
-    previously recorded metrics/events first."""
+    previously recorded metrics/events first (the flight ring is NOT
+    cleared — the black box survives registry resets)."""
     global _enabled
     if clear:
         _registry.clear()
@@ -132,13 +139,21 @@ def inc(name: str, value: float = 1.0) -> None:
 
 
 def set_gauge(name: str, value: float) -> None:
+    value = float(value)
+    # always-on: gauge moves are the flight ring's counter-track time series
+    _flight.append({"type": "gauge", "name": name, "value": value,
+                    "ts_us": _now_us()})
     if not _enabled:
         return
     with _registry._lock:
-        _registry.gauges[name] = float(value)
+        _registry.gauges[name] = value
 
 
 def observe_value(name: str, value: float) -> None:
+    # registry-only by design: histogram samples don't ring-append — every
+    # sample the serving layer records duplicates an edge the ring already
+    # holds as a span or event, and doubling lifecycle edges would halve
+    # the black box's usable pre-incident history
     if not _enabled:
         return
     with _registry._lock:
@@ -149,19 +164,25 @@ def observe_value(name: str, value: float) -> None:
 
 
 def event(kind: str, **fields: Any) -> None:
+    rec = {"kind": kind, "ts_us": _now_us(), **fields}
+    _flight.append({"type": "event", **rec})
     if not _enabled:
         return
-    rec = {"kind": kind, "ts_us": _now_us(), **fields}
     with _registry._lock:
         _registry.events.append(rec)
 
 
 def record_span(name: str, cat: str, ts_us: float, dur_us: float,
                 args: dict | None = None) -> None:
+    rec = {"name": name, "cat": cat, "ts_us": ts_us, "dur_us": dur_us,
+           "tid": threading.get_ident(), "args": args or {}}
+    _flight.append({"type": "span", **rec})
+    # gate like every other write path (this wrote to the registry
+    # unconditionally before — a disabled process accumulated spans)
+    if not _enabled:
+        return
     with _registry._lock:
-        _registry.spans.append({"name": name, "cat": cat, "ts_us": ts_us,
-                                "dur_us": dur_us, "tid": threading.get_ident(),
-                                "args": args or {}})
+        _registry.spans.append(rec)
 
 
 # ---------------------------------------------------------------------------
@@ -190,19 +211,6 @@ def collect_pass_times(sink: dict):
         _pass_sink.reset(tok)
 
 
-class _NullCM:
-    __slots__ = ()
-
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *exc):
-        return False
-
-
-_NULL_CM = _NullCM()
-
-
 class _SpanCM:
     __slots__ = ("name", "cat", "args", "sink", "_t0", "_ts", "_key", "_tok")
 
@@ -226,9 +234,12 @@ class _SpanCM:
         if self.sink is not None:
             _span_path.reset(self._tok)
             self.sink[self._key] = self.sink.get(self._key, 0.0) + dur_ns / 1e6
-        if _enabled:
-            record_span(self.name, self.cat, self._ts, dur_ns / 1e3, self.args)
-            observe_value(f"{self.cat}.{self.name}.ms", dur_ns / 1e6)
+        # record_span is itself always-on (flight ring) and gates the
+        # registry write; the derived histogram sample is registry-only
+        # (observe_value doesn't ring-append — the ring already holds the
+        # span edge with its duration)
+        record_span(self.name, self.cat, self._ts, dur_ns / 1e3, self.args)
+        observe_value(f"{self.cat}.{self.name}.ms", dur_ns / 1e6)
         return False
 
 
@@ -236,13 +247,13 @@ def span(name: str, cat: str = "compile", args: dict | None = None,
          record_pass_time: bool = True):
     """Timed span context manager. Records into the per-compile pass-time
     sink when one is active (always, during compilation; nested spans key
-    as ``parent/child``) and into the process registry when enabled;
-    otherwise a shared no-op. ``record_pass_time=False`` keeps a span out
-    of the sink (the whole-compile umbrella span, which would otherwise
-    parent — and double-count against — every pass)."""
+    as ``parent/child``), into the process registry when enabled, and into
+    the always-on flight ring regardless — a span edge is black-box
+    history, and span sites are compile-time paths where one deque append
+    is noise. ``record_pass_time=False`` keeps a span out of the sink (the
+    whole-compile umbrella span, which would otherwise parent — and
+    double-count against — every pass)."""
     sink = _pass_sink.get() if record_pass_time else None
-    if sink is None and not _enabled:
-        return _NULL_CM
     return _SpanCM(name, cat, args, sink)
 
 

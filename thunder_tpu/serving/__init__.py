@@ -34,6 +34,7 @@ The layers (ROADMAP item 1 + the serving containment story):
 measures shedding and SLO attainment past capacity).
 """
 
+from thunder_tpu.serving.events import EVENT_KINDS  # noqa: F401
 from thunder_tpu.serving.errors import (  # noqa: F401
     AdmissionRejected,
     DeadlineExceeded,

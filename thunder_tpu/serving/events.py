@@ -1,0 +1,47 @@
+"""The serving event vocabulary.
+
+Every ``observe.event()`` the serving layer emits uses a kind from
+``EVENT_KINDS`` — the names are an ops contract (postmortem triage
+scripts, dashboards, and the flight-recorder timeline all key on them),
+so the vocabulary is pinned here and enforced in BOTH directions by
+``tests/test_docs.py::test_serving_event_kinds_documented``: a kind
+emitted in code but missing from this set (or from the docs table) fails
+tier-1, and a kind registered here (or documented) that no code emits
+fails too — the same discipline as the block planner's
+``BLOCK_DECISION_KINDS``.
+
+Lifecycle kinds trace one request end to end (always recorded in the
+flight ring, registry on or off)::
+
+    serving_submitted -> serving_admitted -> serving_prefill_chunk(s)
+      -> serving_first_token -> serving_complete
+    (with serving_preempt / serving_engine_restart detours re-entering at
+     serving_admitted, and serving_shed as the error terminal)
+
+The remaining kinds describe the engine lifecycle: dispatch/admission
+faults, decode re-binds, supervisor restarts and their budget, drain
+bounds, stall escalation, SLO collapse, and postmortem bundle dumps.
+"""
+
+from __future__ import annotations
+
+EVENT_KINDS = frozenset({
+    # request lifecycle
+    "serving_submitted",            # request entered the admission queue
+    "serving_admitted",             # request took a decode slot (also resume)
+    "serving_prefill_chunk",        # one prompt chunk written to KV pages
+    "serving_first_token",          # first sampled token (TTFT edge)
+    "serving_complete",             # terminal: finished (EOS / max tokens)
+    "serving_shed",                 # terminal: removed with a typed error
+    "serving_preempt",              # evicted to the queue (page pressure)
+    # engine lifecycle / supervision
+    "serving_decode_bind",          # decode program (re)bound; launch shape
+    "serving_decode_rebind",        # re-bind forced by a quarantine-epoch move
+    "serving_admission_fault",      # contained admission-domain fault
+    "serving_engine_restart",       # supervisor crash recovery
+    "serving_engine_stalled",       # watchdog stall escalation
+    "serving_drain_bound_expired",  # drain wall-clock bound shed the rest
+    "serving_restart_budget_exhausted",  # restart rung refused; escalating
+    "serving_slo_collapse",         # rolling SLO attainment fell below floor
+    "serving_postmortem",           # black-box bundle written to disk
+})

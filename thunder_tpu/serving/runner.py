@@ -252,3 +252,8 @@ class PagedLlamaRunner:
         walk(trc.bound_symbols)
         _observe.set_gauge("serving.decode_pallas_launches", launches)
         _observe.set_gauge("serving.decode_layer_fusions", layers)
+        # lifecycle edge for the flight ring: WHICH program shape is now
+        # serving (a postmortem wants to know if the megakernel or a
+        # fallback rung was bound when the fault hit)
+        _observe.event("serving_decode_bind", launches=launches,
+                       decode_layer_fusions=layers)

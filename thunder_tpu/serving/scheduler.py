@@ -42,6 +42,15 @@ different sequence lengths served by ONE compiled decode program.
   in-flight request via :meth:`ServingEngine.rebuild_after_fault` — is the
   engine-level fallback rung.
 
+- **Always-on lifecycle tracing**: every request's phase chain (submitted
+  → queued → admitted → prefill chunk(s) → decode residency → preempt /
+  restart re-prefill → complete/shed) is recorded as spans + events
+  through ``observe.registry`` — which feeds the bounded flight ring
+  (``observe.flight``) even when the registry is disabled, so a fault
+  leaves a black box. Each iteration also records scheduler spans
+  (``schedule`` host work vs ``decode_dispatch``); the Perfetto exporter
+  renders per-request tracks, a scheduler track, and counter tracks.
+
 Greedy sampling (argmax) — the engine is a throughput/latency runtime, not
 a sampling library; temperature sampling stays in ``models.llama.generate``.
 """
@@ -72,6 +81,12 @@ from thunder_tpu.serving.runner import PagedLlamaRunner
 QUEUED, PREFILL, DECODE, DONE, SHED = \
     "queued", "prefill", "decode", "done", "shed"
 
+# request ids are PROCESS-unique (not per-engine): the flight recorder and
+# the Perfetto per-request tracks key on the id, and a bench that builds a
+# warm engine and a timed engine must not interleave two "request 0"s on
+# one timeline
+_REQUEST_IDS = itertools.count()
+
 
 @dataclass(eq=False)  # identity semantics: requests live in slot lists
 class Request:
@@ -98,6 +113,12 @@ class Request:
     restarts: int = 0                   # supervisor crash-recovery re-admits
     admit_seq: int = -1                 # admission order (preemption victim pick)
     pages_version: int = 0              # bumped when ``pages`` changes
+    # lifecycle tracing (flight recorder + Perfetto request tracks)
+    submitted_us: float = 0.0           # observe-epoch submit timestamp
+    queued_ms: float = 0.0              # total time spent queued (incl. resumes)
+    prefill_chunks: int = 0             # prefill dispatches (incl. re-prefill)
+    _phase: str = ""                    # open lifecycle phase span, if any
+    _phase_t0_us: float = 0.0
 
     @property
     def work_prompt(self) -> np.ndarray:
@@ -186,11 +207,11 @@ class ServingEngine:
         self.completed: list[Request] = []
         self.shed: list[Request] = []
         self.admitting = True           # stop_admissions() flips this
-        self._ids = itertools.count()
         self._admits = itertools.count()
         self._step_count = 0
         self._slo_attained = 0          # on-time completions
         self._slo_total = 0             # terminal requests (done + shed)
+        self._slo_resets = 0            # reset_slo_window() generation
         # serving is latency-sensitive: quick retries, no long backoff
         self._retry_policy = retry_policy or _retry.RetryPolicy(
             max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
@@ -241,11 +262,17 @@ class ServingEngine:
                 f"num_pages")
         now = time.perf_counter()
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
-                      request_id=next(self._ids), eos_id=eos_id,
+                      request_id=next(_REQUEST_IDS), eos_id=eos_id,
                       priority=int(priority),
                       deadline_at=None if deadline_s is None
                       else now + float(deadline_s),
-                      submitted_s=now)
+                      submitted_s=now, submitted_us=_observe._now_us())
+        # lifecycle edge 1: always in the flight ring, registry on or off
+        _observe.event("serving_submitted", request=req.request_id,
+                       prompt_tokens=int(prompt.size),
+                       max_new_tokens=int(max_new_tokens),
+                       priority=req.priority, deadline_s=deadline_s)
+        self._phase_begin(req, QUEUED)
         if not self.admitting:
             err = AdmissionRejected(
                 f"request {req.request_id} rejected: engine is draining, "
@@ -286,8 +313,18 @@ class ServingEngine:
         reach the decode batch quickly instead of trickling in one chunk
         per decode step."""
         self._step_count += 1
+        busy = bool(self.queue) or self.active_requests > 0
+        t0_us = _observe._now_us()
         worked = self._expire_deadlines()
         worked = self._admit() or worked
+        if busy:
+            # host-scheduling half of the iteration (deadlines + admission);
+            # the dispatch halves record their own spans. Idle polling steps
+            # stay out of the flight ring — a long idle stretch must not
+            # flush the last incident's history out of the bounded ring.
+            _observe.record_span("schedule", "serving:sched", t0_us,
+                                 _observe._now_us() - t0_us,
+                                 {"step": self._step_count})
         worked = self._decode_step() or worked
         decoding = sum(1 for r in self.slots
                        if r is not None and r.state == DECODE)
@@ -297,7 +334,13 @@ class ServingEngine:
                 break
             worked = True
             self._admit()  # a completed prefill may free queue back-pressure
-        self._gauges()
+        if busy or worked:
+            # gauges are unchanged on a no-op idle step, and set_gauge
+            # feeds the always-on flight ring — publishing them anyway
+            # would let an idle polling loop flush the last incident's
+            # history out of the bounded ring (same rule as the schedule
+            # span above; every real transition path publishes its own)
+            self._gauges()
         return worked
 
     def drain(self, max_steps: int = 1_000_000) -> list[Request]:
@@ -345,6 +388,7 @@ class ServingEngine:
                            key=lambda r: r.admit_seq, reverse=True)
         for req in residents:
             self.slots[self.slots.index(req)] = None
+            self._phase_end(req, reason="engine_restart")
             req.pages = []          # the pool they lived in is gone
             req.pages_version += 1
             req.prefilled = 0
@@ -353,6 +397,7 @@ class ServingEngine:
             req.state = QUEUED
             req.restarts += 1
             self.queue.appendleft(req)  # reverse admit order -> FIFO resume
+            self._phase_begin(req, QUEUED)
         self.cache = PagedKVCache(self.geom, self.cfg.dtype.jax)
         self._decode_bound = None
         self._bound_epoch = -1
@@ -376,6 +421,7 @@ class ServingEngine:
         """Restart SLO-attainment accounting (benchmarks: exclude warmup)."""
         self._slo_attained = 0
         self._slo_total = 0
+        self._slo_resets += 1
 
     @property
     def active_requests(self) -> int:
@@ -385,7 +431,67 @@ class ServingEngine:
     def idle(self) -> bool:
         return not self.queue and not any(s is not None for s in self.slots)
 
+    def describe_state(self) -> dict:
+        """Plain-dict engine/cache state summary — what a postmortem bundle
+        embeds: slot occupancy, queue, page accounting, block-table
+        liveness, and the ``assert_quiescent`` findings (the finding TEXT
+        when not quiescent — during a fault that is the interesting part)."""
+        try:
+            self.assert_quiescent()
+            quiescence = "quiescent"
+        except AssertionError as e:
+            quiescence = str(e)
+        return {
+            "step": self._step_count,
+            "admitting": self.admitting,
+            "slots": [{"slot": i, "request": r.request_id, "state": r.state,
+                       "pages": len(r.pages), "prefilled": r.prefilled,
+                       "length": r.length, "generated": len(r.generated),
+                       "priority": r.priority, "preemptions": r.preemptions,
+                       "restarts": r.restarts}
+                      for i, r in enumerate(self.slots) if r is not None],
+            "queued": [r.request_id for r in self.queue],
+            "completed": len(self.completed),
+            "shed": len(self.shed),
+            "pages_free": self.cache.pages_free,
+            "pages_total": self.cache.pages_total,
+            "peak_pages_used": self.cache.peak_pages_used,
+            "pools_alive": self.cache.pools_alive(),
+            "block_table_rows_live": int((self._np_bt != 0).any(1).sum()),
+            "quiescence": quiescence,
+            "slo": {"attained": self._slo_attained, "total": self._slo_total},
+        }
+
     # -- scheduling internals -----------------------------------------------
+    def _phase_begin(self, req: Request, phase: str) -> None:
+        req._phase = phase
+        req._phase_t0_us = _observe._now_us()
+
+    def _phase_end(self, req: Request, **args) -> None:
+        """Close the request's open lifecycle phase as a span on its
+        Perfetto track (queued / prefill / decode; always in the flight
+        ring). Queued time accumulates on the request for the timeline
+        report and the bench's queue-time percentiles."""
+        if not req._phase:
+            return
+        dur_us = _observe._now_us() - req._phase_t0_us
+        if req._phase == QUEUED:
+            req.queued_ms += dur_us / 1e3
+        _observe.record_span(req._phase, "serving:request", req._phase_t0_us,
+                             dur_us, {"request": req.request_id, **args})
+        req._phase = ""
+
+    def _close_request_span(self, req: Request) -> None:
+        """The terminal umbrella span: one bar covering submit -> terminal
+        on the request's track, phases nested inside it."""
+        _observe.record_span(
+            f"request {req.request_id}", "serving:request", req.submitted_us,
+            _observe._now_us() - req.submitted_us,
+            {"request": req.request_id, "state": req.state,
+             "tokens": len(req.generated), "queued_ms": round(req.queued_ms, 3),
+             "prefill_chunks": req.prefill_chunks,
+             "preemptions": req.preemptions, "restarts": req.restarts})
+
     def _stall_error(self, why: str) -> EngineStallError:
         stuck = [(r.request_id, r.state) for r in self.queue]
         stuck += [(r.request_id, r.state)
@@ -428,9 +534,11 @@ class ServingEngine:
             self.queue.remove(req)
         elif req in self.slots:
             self._release_slot(req)
+        self._phase_end(req, reason=type(error).__name__)
         req.state = SHED
         req.error = error
         req.finished_s = time.perf_counter()
+        self._close_request_span(req)
         self.shed.append(req)
         self._slo_total += 1
         _observe.inc("serving.shed_requests")
@@ -485,6 +593,11 @@ class ServingEngine:
             req.state = PREFILL
             req.admit_seq = next(self._admits)
             self.slots[slot] = req
+            self._phase_end(req)            # close "queued"
+            _observe.event("serving_admitted", request=req.request_id,
+                           slot=slot, preemptions=req.preemptions,
+                           restarts=req.restarts)
+            self._phase_begin(req, PREFILL)
             admitted = True
         return admitted
 
@@ -565,14 +678,25 @@ class ServingEngine:
                 page_writes, np.int32(real - 1), self.cache.pools)
 
         t0 = time.perf_counter()
+        t0_us = _observe._now_us()
         logits, pools = self._dispatch_guarded(dispatch, "serving:prefill")
         self.cache.update_pools(pools)
+        dur_us = _observe._now_us() - t0_us
         _observe.observe_value("serving.prefill_ms",
                                (time.perf_counter() - t0) * 1e3)
+        # the chunk dispatch on the request's own lifecycle track
+        _observe.record_span("prefill_chunk", "serving:request", t0_us, dur_us,
+                             {"request": req.request_id, "chunk": C,
+                              "pos0": pos0})
+        req.prefill_chunks += 1
+        _observe.event("serving_prefill_chunk", request=req.request_id,
+                       chunk=C, pos0=pos0, real=real)
         req.prefilled += real
         if req.prefilled == len(wp):                # prompt fully resident
             req.length = len(wp)
             req.state = DECODE
+            self._phase_end(req)                    # close "prefill"
+            self._phase_begin(req, DECODE)
             if req.decode_start_s is None:          # survive preempt-resume:
                 # decode_ms stays first-token -> completion, as documented
                 req.decode_start_s = time.perf_counter()
@@ -605,12 +729,14 @@ class ServingEngine:
         """Evict a resident request back to the queue head (recompute-on-
         resume). Its pages return to the free list immediately."""
         self._release_slot(req)
+        self._phase_end(req, reason="preempt")
         req.prefilled = 0
         req.length = 0
         req.next_token = None
         req.state = QUEUED
         req.preemptions += 1
         self.queue.appendleft(req)
+        self._phase_begin(req, QUEUED)
         _observe.inc("serving.preempted_requests")
         _observe.event("serving_preempt", request=req.request_id,
                        generated=len(req.generated))
@@ -697,9 +823,15 @@ class ServingEngine:
             return self._decode_bound(self.params, tokens, bt, lengths,
                                       write_pos, self.cache.pools)
 
+        t0_us = _observe._now_us()
         logits, pools = self._dispatch_guarded(dispatch, "serving:decode")
         self.cache.update_pools(pools)
         toks = np.asarray(logits).argmax(-1)    # host sync: honest step end
+        # the dispatch half of the iteration, on the scheduler track (the
+        # host sync above makes the duration an honest device-step bound)
+        _observe.record_span("decode_dispatch", "serving:sched", t0_us,
+                             _observe._now_us() - t0_us,
+                             {"step": self._step_count, "batch": len(active)})
         for i, r in active:
             r.length += 1
             self._on_token(r, int(toks[i]))
@@ -711,14 +843,18 @@ class ServingEngine:
         if req.ttft_s is None:
             req.ttft_s = time.perf_counter() - req.submitted_s
             _observe.observe_value("serving.ttft_ms", req.ttft_s * 1e3)
+            _observe.event("serving_first_token", request=req.request_id,
+                           ttft_ms=round(req.ttft_s * 1e3, 3))
         if (len(req.generated) >= req.max_new_tokens
                 or (req.eos_id is not None and tok == req.eos_id)):
             self._finish(req)
 
     def _finish(self, req: Request) -> None:
         self._release_slot(req)
+        self._phase_end(req)            # close "decode"
         req.state = DONE
         req.finished_s = time.perf_counter()
+        self._close_request_span(req)
         if req.decode_start_s is not None:
             # per-request decode-phase duration (first token -> completion)
             _observe.observe_value(

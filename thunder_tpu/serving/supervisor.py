@@ -40,31 +40,64 @@ numerics sentinel); :class:`EngineSupervisor` is the serving counterpart:
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Callable
 
 from thunder_tpu.observe import registry as _observe
 from thunder_tpu.runtime import retry as _retry
-from thunder_tpu.serving.errors import EngineFault, RestartBudgetExceeded
+from thunder_tpu.serving.errors import (
+    EngineFault,
+    EngineStallError,
+    RestartBudgetExceeded,
+)
 from thunder_tpu.serving.scheduler import Request, ServingEngine
 
 
 class EngineSupervisor:
     """Wraps a :class:`ServingEngine` with the restart/drain/watchdog
     lifecycle. All request traffic should flow through the supervisor
-    (``submit``/``step``/``drain``) so faults recover transparently."""
+    (``submit``/``step``/``drain``) so faults recover transparently.
+
+    With ``postmortem_dir=`` set, every typed serving failure —
+    ``EngineFault`` (even when the restart rung recovers it),
+    ``EngineStallError``, ``RestartBudgetExceeded``, and an SLO-attainment
+    collapse below ``slo_floor`` — dumps a **postmortem bundle**: the
+    always-on flight-recorder ring (the request-lifecycle black box, alive
+    even with the registry disabled), the decode program's decision log, a
+    registry snapshot, the engine/cache state summary
+    (:meth:`ServingEngine.describe_state`, including the
+    ``assert_quiescent`` findings and block-table occupancy), the restart
+    budget's ``describe()``, and the Perfetto serving timeline
+    (``timeline.json`` — built from the flight ring, loadable at
+    chrome://tracing). The PR 8 replay-bundle discipline, generalized from
+    numerics to serving."""
 
     def __init__(self, engine: ServingEngine, *,
                  restart_budget: _retry.RestartBudget | None = None,
                  max_restarts: int = 3, restart_window_s: float = 600.0,
                  heartbeat_path: str | None = None,
                  stall_timeout_s: float = 30.0,
-                 on_stall: Callable[[float], None] | None = None):
+                 on_stall: Callable[[float], None] | None = None,
+                 postmortem_dir: str | None = None,
+                 slo_floor: float | None = None, min_slo_samples: int = 8):
         self.engine = engine
         self.budget = restart_budget or _retry.RestartBudget(
             max_restarts=max_restarts, window_s=restart_window_s)
         self.restarts = 0
         self.on_stall = on_stall
+        self.postmortem_dir = postmortem_dir
+        self.slo_floor = slo_floor
+        self.min_slo_samples = int(min_slo_samples)
+        self._slo_collapsed = False     # latched: one bundle per collapse
+        # (attained, total, engine reset generation) at last (re)arm — the
+        # generation detects reset_slo_window() even when the counters have
+        # regrown past the base by the next check (totals alone can't).
+        # Armed from the engine's CURRENT counters: attaching to a warm
+        # engine must not judge pre-supervisor history
+        self._slo_base = (engine._slo_attained, engine._slo_total,
+                          engine._slo_resets)
         self.heartbeat = None
         self.watchdog = None
         if heartbeat_path is not None:
@@ -88,10 +121,15 @@ class EngineSupervisor:
         if self.heartbeat is not None:
             self.heartbeat.beat(self.engine._step_count)
         try:
-            return self.engine.step()
+            worked = self.engine.step()
         except EngineFault as e:
+            # black box FIRST, while the engine still shows the crashed
+            # state (consumed pools, stranded residents) — then recover
+            self.dump_postmortem(e)
             self._restart(e)
             return True
+        self._check_slo()
+        return worked
 
     def drain(self, *, deadline_s: float | None = None,
               max_steps: int = 1_000_000) -> list[Request]:
@@ -105,6 +143,7 @@ class EngineSupervisor:
         eng = self.engine
         eng.stop_admissions()
         t0 = time.perf_counter()
+        t0_us = _observe._now_us()
         try:
             for _ in range(max_steps):
                 if eng.idle:
@@ -122,9 +161,17 @@ class EngineSupervisor:
                 if not eng.idle:
                     raise eng._stall_error(
                         f"no completion in {max_steps} drain steps")
+        except EngineStallError as e:
+            self.dump_postmortem(e)     # a stall IS the black-box case
+            raise
         finally:
             _observe.observe_value("serving.drain_ms",
                                    (time.perf_counter() - t0) * 1e3)
+            # the drain episode on the scheduler track, next to its steps
+            _observe.record_span("drain", "serving:sched", t0_us,
+                                 _observe._now_us() - t0_us,
+                                 {"completed": len(eng.completed),
+                                  "shed": len(eng.shed)})
         return eng.completed
 
     def shutdown(self, *, deadline_s: float | None = None) -> list[Request]:
@@ -144,8 +191,120 @@ class EngineSupervisor:
     def _escalate_stall(self, age_s: float) -> None:
         _observe.event("serving_engine_stalled", age_s=age_s,
                        step=self.engine._step_count)
+        # a hung engine is the paradigm black-box case: dump the ring
+        # before the operator kills the process and it's gone (the
+        # watchdog escalates once per stall episode, so this is one
+        # bundle per stall, not one per poll)
+        self.dump_postmortem(
+            RuntimeError(f"engine stalled: heartbeat {age_s:.1f}s old at "
+                         f"step {self.engine._step_count}"), tag="stall")
         if self.on_stall is not None:
             self.on_stall(age_s)
+
+    def _check_slo(self) -> None:
+        """SLO-attainment collapse detector: when the on-time ratio over
+        terminal requests SINCE THE LAST (RE)ARM falls below ``slo_floor``
+        (with at least ``min_slo_samples`` terminals in that window), the
+        black box dumps once — silent degradation is the failure mode a
+        flight recorder exists for. Latched until :meth:`rearm_slo` (one
+        bundle per collapse, not one per step); the windowing means a
+        rearm after mitigation starts a FRESH measurement instead of
+        re-judging the historical misses that caused the first dump."""
+        if self.slo_floor is None or self._slo_collapsed:
+            return
+        eng = self.engine
+        base_a, base_t, base_gen = self._slo_base
+        if eng._slo_resets != base_gen:  # engine's window was reset under us
+            self._slo_base = (0, 0, eng._slo_resets)
+            base_a, base_t = 0, 0
+        total = eng._slo_total - base_t
+        # the max() also guards min_slo_samples=0 ("judge immediately")
+        # against a 0/0 before the first terminal request
+        if total < max(self.min_slo_samples, 1):
+            return
+        ratio = (eng._slo_attained - base_a) / total
+        if ratio < self.slo_floor:
+            self._slo_collapsed = True
+            _observe.event("serving_slo_collapse", attainment=round(ratio, 4),
+                           floor=self.slo_floor, samples=total)
+            self.dump_postmortem(
+                RuntimeError(f"SLO attainment collapsed: {ratio:.3f} < floor "
+                             f"{self.slo_floor:g} over {total} "
+                             f"terminal requests"), tag="slo_collapse")
+
+    def rearm_slo(self) -> None:
+        """Un-latch the SLO-collapse detector after mitigation and start a
+        fresh measurement window (past misses are not re-judged)."""
+        self._slo_collapsed = False
+        eng = self.engine
+        self._slo_base = (eng._slo_attained, eng._slo_total, eng._slo_resets)
+
+    def dump_postmortem(self, cause: BaseException | str,
+                        tag: str | None = None) -> str | None:
+        """Write the black-box bundle for ``cause`` under
+        ``postmortem_dir`` (no-op returning ``None`` when unset). Never
+        raises — a postmortem failure must not break the recovery path it
+        documents; partial bundles record their errors in the manifest."""
+        if self.postmortem_dir is None:
+            return None
+        from thunder_tpu.observe import exporters as _exporters
+        from thunder_tpu.observe import flight as _flight
+
+        label = tag or (type(cause).__name__
+                        if isinstance(cause, BaseException) else "incident")
+        try:
+            base = os.path.join(
+                self.postmortem_dir,
+                f"postmortem-step{self.engine._step_count:06d}-{label}")
+            path, i = base, 1
+            while os.path.exists(path):
+                path = f"{base}.{i}"
+                i += 1
+            os.makedirs(path)
+        except Exception:
+            return None
+        errors: list[str] = []
+
+        def part(fname: str, build) -> None:
+            try:
+                obj = build()
+                with open(os.path.join(path, fname), "w") as f:
+                    json.dump(_exporters._jsonable(obj), f, default=str)
+            except Exception as e:  # partial bundle beats no bundle
+                errors.append(f"{fname}: {e!r}")
+
+        try:
+            n_flight = _flight.dump_jsonl(os.path.join(path, "flight.jsonl"))
+        except Exception as e:
+            n_flight = 0
+            errors.append(f"flight.jsonl: {e!r}")
+        part("engine.json", self.engine.describe_state)
+        part("registry.json", _observe.snapshot)
+        part("timeline.json", _exporters.flight_trace_dict)
+
+        def decisions():
+            import thunder_tpu as tt
+
+            return tt.compile_stats(self.engine.runner.decode_jit) \
+                .last_decisions
+        part("decisions.json", decisions)
+        part("MANIFEST.json", lambda: {
+            "cause": repr(cause),
+            "cause_type": (type(cause).__name__
+                           if isinstance(cause, BaseException) else "str"),
+            "created_s": time.time(),
+            "step": self.engine._step_count,
+            "restarts": self.restarts,
+            "budget": self.budget.describe(),
+            "flight_records": n_flight,
+            "registry_enabled": _observe.is_enabled(),
+            "errors": errors,
+            "files": ["flight.jsonl", "engine.json", "registry.json",
+                      "timeline.json", "decisions.json"],
+        })
+        _observe.inc("serving.postmortems")
+        _observe.event("serving_postmortem", path=path, cause=repr(cause))
+        return path
 
     def _restart(self, cause: BaseException) -> None:
         """The engine-level fallback rung: charge the sliding-window
@@ -153,11 +312,13 @@ class EngineSupervisor:
         if not self.budget.record():
             _observe.event("serving_restart_budget_exhausted",
                            cause=repr(cause), budget=self.budget.describe())
-            raise RestartBudgetExceeded(
+            err = RestartBudgetExceeded(
                 f"engine restart budget exhausted "
                 f"({self.budget.describe()}); last fault: {cause!r}",
                 in_window=self.budget.in_window,
-                max_restarts=self.budget.max_restarts) from cause
+                max_restarts=self.budget.max_restarts)
+            self.dump_postmortem(err)
+            raise err from cause
         t0 = time.perf_counter()
         recovered = self.engine.rebuild_after_fault()
         self.restarts += 1
