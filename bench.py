@@ -45,8 +45,10 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
-# 6: bench_serve stamps request-timeline summary stats (queue_ms_p50/p99,
-# sched_host_ms_mean / decode_dispatch_ms_mean, prefill_chunks_total,
+# 7: bench_serve --prefix stamps prefix_hit_rate /
+# cached_prefill_skipped_tokens / cow_copies / bestof_page_amplification
+# (shared-prefix serving: in-graph sampling + COW paged prefix cache);
+# 6: bench_serve stamps the request-timeline summary (queue_ms percentiles,
 # flight_records) from the lifecycle tracing + flight recorder;
 # 5: bench_serve --overload stamps shed_rate / deadline_miss_rate /
 # slo_attainment (request SLOs + supervised engine lifecycle);
@@ -54,7 +56,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 6
+METRICS_SCHEMA = 7
 
 
 def main():

@@ -211,16 +211,24 @@ def test_megakernel_parity_vs_decomposition(model):
     # the decode step donates the pools: give each run its own copies
     copies = lambda: [{k: jnp.array(v) for k, v in kv.items()}
                       for kv in pools]
-    lf, pf = fused.decode_jit(params, tokens, bt, lengths, write_pos,
-                              copies())
-    lp, pp = plain.decode_jit(params, tokens, bt, lengths, write_pos,
-                              copies())
+    S = tokens.shape[0]
+    sampling = (np.zeros(S, np.float32), np.zeros(S, np.int32),
+                np.ones(S, np.float32), np.zeros((S, 2), np.uint32))
+    tf, lf, pf = fused.decode_jit(params, tokens, bt, lengths, write_pos,
+                                  copies(), *sampling)
+    tp, lp, pp = plain.decode_jit(params, tokens, bt, lengths, write_pos,
+                                  copies(), *sampling)
     names = _symbol_names(tt.last_execution_trace(fused.decode_jit))
     assert "pallas_decode_layer" in names
     assert "pallas_decode_layer" not in _symbol_names(
         tt.last_execution_trace(plain.decode_jit))
     np.testing.assert_allclose(np.asarray(lf), np.asarray(lp),
                                atol=2e-5, rtol=2e-5)
+    # greedy sampling rows: the in-graph token ids are the logits argmax
+    np.testing.assert_array_equal(np.asarray(tf),
+                                  np.asarray(lf).argmax(-1))
+    np.testing.assert_array_equal(np.asarray(tp),
+                                  np.asarray(lp).argmax(-1))
     for f_kv, p_kv in zip(pf, pp):
         for key in ("k", "v"):
             np.testing.assert_allclose(np.asarray(f_kv[key]),

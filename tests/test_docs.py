@@ -79,7 +79,10 @@ def test_serving_metric_names_documented():
                      "serving.decode_ms", "serving.preempted_requests",
                      "serving.engine_restarts", "serving.shed_requests",
                      "serving.deadline_misses", "serving.drain_ms",
-                     "serving.slo_attainment"):
+                     "serving.slo_attainment",
+                     # the shared-prefix serving family (ISSUE 14)
+                     "serving.prefix_hit_rate", "serving.cached_pages",
+                     "serving.cow_copies", "serving.cache_evictions"):
         assert required in names, f"code no longer emits {required}"
     with open(DOC) as f:
         doc = f.read()

@@ -184,7 +184,8 @@ def test_drain_bounds_wall_clock_and_stops_admissions(model):
         eng = _engine(params, cfg)
         sup = EngineSupervisor(eng)
         r1 = sup.submit(np.ones(5, np.int32), 30)
-        sup.step()
+        sup.step()                               # admit + prefill
+        sup.step()                               # first-token replay decode
         done = sup.drain(deadline_s=0.0)         # bound expires immediately
         snap = observe.snapshot()
     finally:
@@ -267,7 +268,8 @@ def test_serving_fault_injection_tests_carry_chaos_marker():
     here = os.path.dirname(os.path.abspath(__file__))
     needle = "faults." + "active("  # split so this audit doesn't flag itself
     for fname in ("test_serving.py", "test_serving_supervisor.py",
-                  "test_flight.py"):
+                  "test_flight.py", "test_prefix_cache.py",
+                  "test_serving_sampling.py"):
         with open(os.path.join(here, fname)) as f:
             src = f.read()
         tests = list(re.finditer(r"^\s*def (test_\w+)", src, re.M))

@@ -237,6 +237,11 @@ def explain(jfn) -> str:
         slo_keys = ("serving.engine_restarts", "serving.shed_requests",
                     "serving.deadline_misses", "serving.drain_ms",
                     "serving.slo_attainment")
+        # the shared-prefix family reads as one unit: hit rate + parked
+        # pages + COW copies + eviction pressure tell the whole
+        # cache-effectiveness story at a glance
+        prefix_keys = ("serving.prefix_hit_rate", "serving.cached_pages",
+                       "serving.cow_copies", "serving.cache_evictions")
         def metric_line(k):
             # one renderer for both serving sections, gauge/counter/histogram
             if k in snap["gauges"]:
@@ -253,12 +258,18 @@ def explain(jfn) -> str:
         generic = sorted(
             k for src in ("gauges", "counters", "histograms")
             for k in snap[src]
-            if k.startswith("serving.") and k not in slo_keys)
+            if k.startswith("serving.") and k not in slo_keys
+            and k not in prefix_keys)
         generic_lines = [ln for k in generic if (ln := metric_line(k))]
         if generic_lines:
             lines.append("")
             lines.append("== serving ==")
             lines.extend(generic_lines)
+        prefix_lines = [ln for k in prefix_keys if (ln := metric_line(k))]
+        if prefix_lines:
+            lines.append("")
+            lines.append("== serving prefix cache ==")
+            lines.extend(prefix_lines)
         slo_lines = [ln for k in slo_keys if (ln := metric_line(k))]
         if slo_lines:
             lines.append("")

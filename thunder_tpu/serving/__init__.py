@@ -6,11 +6,22 @@ The layers (ROADMAP item 1 + the serving containment story):
 - :mod:`thunder_tpu.serving.kv_cache` — block-allocated page pool +
   free-list + per-request block tables (requests at any mix of sequence
   lengths share one device allocation, one compiled decode shape), with
-  the :meth:`~kv_cache.PagedKVCache.assert_quiescent` leak audit.
+  per-page REFCOUNTS (copy-on-write ``fork`` shares full pages, copies
+  only the partial tail) and the refcount-aware
+  :meth:`~kv_cache.PagedKVCache.assert_quiescent` leak audit.
+- :mod:`thunder_tpu.serving.sampling` — in-graph sampling:
+  :class:`~sampling.SamplingParams` per request, sort-free top-k/top-p
+  threshold masking + Gumbel-max draw fused into the decode program
+  (greedy == ``temperature 0``; the scheduler reads tokens, not logits).
+- :mod:`thunder_tpu.serving.prefix_cache` — cross-request prefix cache: a
+  page-granularity token trie; admission probes it, completed requests
+  donate their prompt pages, the allocator evicts parked pages under
+  pressure (the cache can never starve live traffic).
 - :mod:`thunder_tpu.serving.runner` — the compiled paged prefill/decode
   step programs (``bind()``-dispatched decode; ``LengthBucketer``-laddered
   prefill chunks; ragged attention via ``nn.paged_decode_attention``,
-  Pallas-claimed on TPU).
+  Pallas-claimed on TPU; sampling as the decode epilogue — prefill carries
+  no lm_head, first tokens ride a decode replay step).
 - :mod:`thunder_tpu.serving.scheduler` — admission (priority-ordered,
   optionally bounded, infeasibility-checked), deadline-aware continuous
   batching with chunked prefill interleaving, mid-flight join/evict,
@@ -49,6 +60,12 @@ from thunder_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
     PageGeometry,
 )
+from thunder_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from thunder_tpu.serving.runner import PagedLlamaRunner  # noqa: F401
+from thunder_tpu.serving.sampling import (  # noqa: F401
+    GREEDY,
+    SamplingParams,
+    sample_tokens,
+)
 from thunder_tpu.serving.scheduler import Request, ServingEngine  # noqa: F401
 from thunder_tpu.serving.supervisor import EngineSupervisor  # noqa: F401

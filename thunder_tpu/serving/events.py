@@ -34,6 +34,12 @@ EVENT_KINDS = frozenset({
     "serving_complete",             # terminal: finished (EOS / max tokens)
     "serving_shed",                 # terminal: removed with a typed error
     "serving_preempt",              # evicted to the queue (page pressure)
+    "serving_prefix_hit",           # admission probe matched cached prompt
+    #                                 pages; prefill starts past them
+    "serving_fork",                 # best-of clone forked a primary's block
+    #                                 table copy-on-write into a slot
+    "serving_cache_evict",          # allocator reclaimed parked prefix-cache
+    #                                 pages (trie subtree dropped)
     # engine lifecycle / supervision
     "serving_decode_bind",          # decode program (re)bound; launch shape
     "serving_decode_rebind",        # re-bind forced by a quarantine-epoch move

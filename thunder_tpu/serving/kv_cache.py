@@ -11,11 +11,27 @@ Page 0 is reserved as the scratch page: it is never allocated, inactive
 decode slots write their (discarded) K/V there, and unallocated block-table
 entries point at it — every table entry is always a valid pool index, which
 is what lets the Pallas kernel's scalar-prefetch index map run unguarded.
+
+Pages are REFCOUNTED (copy-on-write substrate): ``alloc`` hands out pages
+at refcount 1, ``retain`` lets a second block table share a page, and
+``free`` only returns a page to the free list when its last reference
+drops. :meth:`fork` builds a forked block table that shares every full
+page of a context and copies only the partial tail page — the page the
+fork will keep appending into — which is what makes best-of-N share ONE
+prefill across N decode slots, and draft rollback a refcount decrement.
+A page can additionally be REGISTERED by the cross-request prefix cache
+(:mod:`~thunder_tpu.serving.prefix_cache`): a registered page whose
+refcount reaches zero parks in the *cached* set (evictable, its K/V
+preserved for future prefix hits) instead of the free list, and
+``alloc`` reclaims cached pages through the registered ``evict_cb``
+before ever raising ``OutOfPages`` — cached prefixes can never starve
+live traffic.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from collections import Counter
+from dataclasses import dataclass
 
 
 @dataclass
@@ -39,7 +55,7 @@ class PageGeometry:
 
 
 class PagedKVCache:
-    """Device page pools + host free list.
+    """Device page pools + host free list + per-page refcounts.
 
     ``pools`` is a list (per layer) of ``{"k": array, "v": array}`` with
     shape ``(kv_heads, num_pages, page_size, head_dim)``. The arrays are
@@ -65,6 +81,15 @@ class PagedKVCache:
         self._free: list[int] = list(range(g.num_pages - 1, 0, -1))
         self._free_set: set[int] = set(self._free)
         self._min_free = len(self._free)  # high-water tracking (peak usage)
+        # copy-on-write substrate: per-page reference counts (0 == free or
+        # cached), the prefix cache's registration set, and the parked
+        # rc-0 registered pages in eviction (insertion) order
+        self._rc: list[int] = [0] * g.num_pages
+        self._registered: set[int] = set()
+        self._cached: dict[int, None] = {}   # ordered: oldest parked first
+        self.evict_cb = None        # page -> list[int]: prefix-cache hook
+        self.cow_copies = 0         # tail-page copies made by fork()
+        self.pages_allocated = 0    # lifetime alloc count (page amplification)
 
     # -- allocation ---------------------------------------------------------
     @property
@@ -77,6 +102,12 @@ class PagedKVCache:
         return self.geometry.num_pages - 1
 
     @property
+    def cached_pages(self) -> int:
+        """Pages parked by the prefix cache: refcount 0, K/V preserved,
+        reclaimable by :meth:`alloc` under pressure."""
+        return len(self._cached)
+
+    @property
     def peak_pages_used(self) -> int:
         return self.pages_total - self._min_free
 
@@ -87,32 +118,159 @@ class PagedKVCache:
         """Restart high-water tracking (benchmarks: exclude warmup)."""
         self._min_free = len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._rc[page]
+
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        # cached pages count: alloc() reclaims them before back-pressuring
+        return n <= len(self._free) + len(self._cached)
 
     def alloc(self, n: int) -> list[int]:
-        """Pop ``n`` pages off the free list. Raises ``OutOfPages`` when the
-        pool can't satisfy the request — the scheduler turns that into
-        admission back-pressure or preemption, never a crash."""
+        """Pop ``n`` pages off the free list, reclaiming parked prefix-cache
+        pages (oldest first, via ``evict_cb``) when the list runs short.
+        Raises ``OutOfPages`` when free + cached can't satisfy the request —
+        the scheduler turns that into admission back-pressure or preemption,
+        never a crash."""
+        while n > len(self._free) and self._cached:
+            victim = next(iter(self._cached))
+            # the prefix cache drops the victim's trie node AND its subtree
+            # (descendants of an unreferenced prefix are unreferenced too);
+            # without a registered cache the parked page reclaims alone
+            pages = self.evict_cb(victim) if self.evict_cb is not None \
+                else [victim]
+            for p in pages:
+                self._reclaim(p)
         if n > len(self._free):
             raise OutOfPages(
                 f"requested {n} KV pages with {len(self._free)} free "
-                f"(pool: {self.pages_total}); admission should have "
-                f"back-pressured or preempted first")
+                f"(pool: {self.pages_total}, cached: {len(self._cached)}); "
+                f"admission should have back-pressured or preempted first")
         pages = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(pages)
+        for p in pages:
+            self._rc[p] = 1
+        self.pages_allocated += len(pages)
         self._min_free = min(self._min_free, len(self._free))
         return pages
 
-    def free(self, pages) -> None:
-        """Return pages to the free list (eviction / completion path)."""
+    def retain(self, pages) -> None:
+        """Add a reference to already-allocated pages (block-table fork /
+        prefix-cache hit). A parked cached page leaves the evictable set —
+        it is live again."""
         for p in pages:
             if not (0 < p < self.geometry.num_pages):
-                raise ValueError(f"freeing invalid page id {p}")
+                raise ValueError(f"retaining invalid page id {p}")
             if p in self._free_set:
-                raise ValueError(f"double free of page {p}")
-        self._free.extend(pages)
-        self._free_set.update(pages)
+                raise ValueError(f"retain of free page {p}")
+            if self._rc[p] == 0:
+                self._cached.pop(p, None)    # parked -> live
+            self._rc[p] += 1
+
+    def free(self, pages) -> None:
+        """Drop one reference per page. A page whose last reference drops
+        returns to the free list — unless the prefix cache registered it,
+        in which case it parks in the cached set with its K/V intact."""
+        drops = Counter(pages)
+        for p, n in drops.items():
+            if not (0 < p < self.geometry.num_pages):
+                raise ValueError(f"freeing invalid page id {p}")
+            if p in self._free_set or self._rc[p] < n:
+                raise ValueError(
+                    f"double free of page {p} ({n} drops against "
+                    f"{self._rc[p]} held references)")
+        for p in pages:
+            self._rc[p] -= 1
+            if self._rc[p] > 0:
+                continue                     # another block table still holds it
+            if p in self._registered:
+                self._cached[p] = None       # park for future prefix hits
+            else:
+                self._free.append(p)
+                self._free_set.add(p)
+
+    # -- copy-on-write forks ------------------------------------------------
+    def fork(self, pages: list[int], length: int) -> list[int]:
+        """Fork a block table covering ``length`` context tokens: full
+        pages are SHARED (refcount bump, zero bytes moved) and only the
+        partial tail page — the one the fork will keep writing into — is
+        copied onto a fresh page. Page-aligned contexts fork with no copy
+        at all (the next append opens a fresh page anyway). Raises
+        ``OutOfPages`` if the tail copy can't allocate (after cached-page
+        reclaim); the caller falls back to an ordinary re-prefill."""
+        ps = self.geometry.page_size
+        if length < 1:
+            raise ValueError(f"cannot fork an empty context ({length=})")
+        n_ctx = -(-length // ps)
+        if len(pages) < n_ctx:
+            raise ValueError(
+                f"fork needs {n_ctx} pages for {length} tokens, got {len(pages)}")
+        tail_partial = (length % ps) != 0
+        shared = pages[:n_ctx - 1] if tail_partial else pages[:n_ctx]
+        self.retain(shared)
+        forked = list(shared)
+        if tail_partial:
+            try:
+                [tail] = self.alloc(1)
+            except OutOfPages:
+                self.free(shared)            # undo: fork must be atomic
+                raise
+            self.copy_page(pages[n_ctx - 1], tail)
+            self.cow_copies += 1
+            forked.append(tail)
+        return forked
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Copy one page's K/V across every layer (the COW tail copy —
+        rare host-side path, one fork at a time, never in the compiled
+        step). The update runs through one jitted dynamic-update-slice
+        with the pool DONATED, so on backends with buffer donation the
+        copy really is one page's bytes in place; without donation (CPU)
+        XLA falls back to a pool copy, which only the toy smoke pays.
+        Page ids ride in as traced scalars — one compile covers every
+        (src, dst) pair."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = _page_copy_fn(jax.default_backend())
+        for kv in self.pools:
+            for key in ("k", "v"):
+                kv[key] = fn(kv[key], jnp.int32(src), jnp.int32(dst))
+
+    # -- prefix-cache registration ------------------------------------------
+    def register_cached(self, page: int) -> None:
+        """Mark a page as held by the prefix cache: when its refcount
+        drops to zero it parks (K/V preserved, evictable) instead of
+        returning to the free list."""
+        if not (0 < page < self.geometry.num_pages):
+            raise ValueError(f"registering invalid page id {page}")
+        if page in self._free_set:
+            raise ValueError(f"registering free page {page}")
+        self._registered.add(page)
+        if self._rc[page] == 0:
+            self._cached[page] = None
+
+    def unregister_cached(self, page: int) -> None:
+        """Drop a page's prefix-cache registration (trie reset): a parked
+        page returns to the free list immediately; a live page simply
+        stops parking when its last reference drops."""
+        self._registered.discard(page)
+        if page in self._cached:
+            del self._cached[page]
+            self._free.append(page)
+            self._free_set.add(page)
+
+    def _reclaim(self, page: int) -> None:
+        """Eviction: un-register a parked rc-0 page and return it to the
+        free list (allocator pressure path; the trie entry is already
+        gone)."""
+        if self._rc[page] != 0 or page not in self._registered:
+            raise ValueError(
+                f"reclaiming page {page} that is live (rc={self._rc[page]}) "
+                f"or unregistered")
+        self._registered.discard(page)
+        self._cached.pop(page, None)
+        self._free.append(page)
+        self._free_set.add(page)
 
     def update_pools(self, new_pools) -> None:
         """Store the updated pools returned by a compiled step (the step
@@ -142,20 +300,32 @@ class PagedKVCache:
                     pass
 
     def assert_quiescent(self, block_tables=None) -> None:
-        """Leak audit for an idle pool: every allocatable page is back on
-        the free list, the mirror set agrees with the list exactly, every
-        listed page id is a valid non-scratch pool index, and (when the
-        engine hands its block tables over) no table entry references
-        anything but the reserved scratch page 0. Raises ``AssertionError``
-        naming the violation — the chaos-soak / eviction / supervisor-
-        restart tests call this after every run, so a single leaked page or
-        a diverged mirror fails loudly instead of surfacing later as an
-        allocator mystery."""
-        leaked = self.pages_total - len(self._free)
-        if leaked:
+        """Leak audit for an idle pool, refcount-aware: every allocatable
+        page is either on the free list or parked at refcount 0 by the
+        prefix cache (its K/V deliberately preserved for future hits), no
+        page holds a live reference, the free-list mirror set agrees with
+        the list exactly, every listed page id is a valid non-scratch pool
+        index, and (when the engine hands its block tables over) no table
+        entry references anything but the reserved scratch page 0. Raises
+        ``AssertionError`` naming the violation — the chaos-soak /
+        eviction / supervisor-restart tests call this after every run, so
+        a single leaked page or refcount, or a diverged mirror, fails
+        loudly instead of surfacing later as an allocator mystery."""
+        live = [p for p in range(1, self.geometry.num_pages) if self._rc[p]]
+        if live:
             raise AssertionError(
-                f"KV page leak: {leaked} of {self.pages_total} pages still "
-                f"allocated on an idle pool")
+                f"KV page leak: {len(live)} pages still hold live "
+                f"references on an idle pool (first ids: {live[:8]}, "
+                f"refcounts: {[self._rc[p] for p in live[:8]]})")
+        accounted = len(self._free) + len(self._cached)
+        if accounted != self.pages_total:
+            raise AssertionError(
+                f"KV page leak: free ({len(self._free)}) + cached "
+                f"({len(self._cached)}) != allocatable ({self.pages_total})")
+        stray = sorted(set(self._free) & set(self._cached))
+        if stray:
+            raise AssertionError(
+                f"pages on the free list AND in the cached set: {stray}")
         if len(self._free) != len(self._free_set) or \
                 set(self._free) != self._free_set:
             raise AssertionError(
@@ -177,6 +347,26 @@ class PagedKVCache:
                     f"{nz.size} block-table entries still reference "
                     f"non-scratch pages on an idle engine (first flat "
                     f"indices: {nz[:8].tolist()})")
+
+
+_PAGE_COPY_FNS: dict = {}
+
+
+def _page_copy_fn(backend: str):
+    """Jitted single-page pool copy, donated where the backend supports
+    aliasing (donating on CPU only buys a warning per call)."""
+    fn = _PAGE_COPY_FNS.get(backend)
+    if fn is None:
+        import jax
+
+        def _copy(pool, src, dst):
+            page = jax.lax.dynamic_index_in_dim(pool, src, axis=1)
+            return jax.lax.dynamic_update_slice_in_dim(pool, page, dst, axis=1)
+
+        donate = () if backend == "cpu" else (0,)
+        fn = jax.jit(_copy, donate_argnums=donate)
+        _PAGE_COPY_FNS[backend] = fn
+    return fn
 
 
 class OutOfPages(RuntimeError):

@@ -1,0 +1,200 @@
+"""Cross-request prefix cache: a page-granularity token trie over the
+paged KV pool.
+
+The block-table indirection makes shared-prefix KV free in principle — a
+page shared is a prefill skipped — and this module makes it free in
+practice across REQUESTS: completed requests donate their full prompt
+pages back keyed by token content, admission probes the trie with the new
+prompt, and prefill starts at the first uncached page. For the
+shared-system-prompt workload ("millions of users", ROADMAP 5(c)) a warm
+cache collapses TTFT to one tail-chunk prefill.
+
+Structure: one trie node per FULL page of prompt tokens, keyed by that
+page's ``page_size`` token ids under its parent (so a node's path spells
+the whole prefix — two prompts share a chain exactly as far as their
+token ids agree on page boundaries). Each node owns one pool page whose
+K/V holds those positions; positions are absolute from 0, and RoPE is
+applied before K is written, so a cached page is valid for ANY request
+whose prompt starts with the same tokens.
+
+Lifecycle (see :class:`~thunder_tpu.serving.kv_cache.PagedKVCache`):
+
+- **probe** walks the trie over the prompt's full pages (capped one short
+  of the prompt so the tail always re-prefills and produces the rows the
+  first decode step attends), retains every matched page into the
+  request's block table, and returns the chain.
+- **donate** registers a completed request's full prompt pages as trie
+  nodes (first donor wins; identical-content duplicates from concurrent
+  requests just stay unregistered and free normally). Registration parks
+  the page in the allocator's *cached* set when its refcount drops —
+  K/V preserved, evictable.
+- **eviction** is driven by the ALLOCATOR, not the cache: when the free
+  list runs dry, ``PagedKVCache.alloc`` reclaims parked pages oldest-
+  first through :meth:`evict`, which drops the victim's trie node and its
+  whole subtree (a live request using a descendant holds references on
+  every ancestor, so an rc-0 page's subtree is rc-0 too). The cache can
+  therefore never starve live traffic — ``OutOfPages`` only fires once
+  the cache is empty.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.observe import registry as _observe
+from thunder_tpu.serving.kv_cache import PagedKVCache
+
+
+class _Node:
+    __slots__ = ("page", "parent", "chunk", "children")
+
+    def __init__(self, page: int, parent, chunk: tuple):
+        self.page = page
+        self.parent = parent          # _Node | None (root children)
+        self.chunk = chunk            # the page's token ids (trie edge key)
+        self.children: dict[tuple, _Node] = {}
+
+
+class PrefixCache:
+    """Token-content trie mapping prompt prefixes to cached KV pages."""
+
+    def __init__(self, cache: PagedKVCache):
+        self.cache = cache
+        self.page_size = cache.geometry.page_size
+        self._root: dict[tuple, _Node] = {}
+        self._by_page: dict[int, _Node] = {}
+        # admission accounting for the serving.prefix_hit_rate gauge
+        self.hit_tokens = 0
+        self.probed_tokens = 0
+        cache.evict_cb = self.evict
+
+    # -- stats --------------------------------------------------------------
+    @property
+    def registered_pages(self) -> int:
+        """Trie-held pages (live + parked) — the ``serving.cached_pages``
+        gauge reads the parked count off the allocator; this is the trie's
+        own footprint."""
+        return len(self._by_page)
+
+    def hit_rate(self) -> float:
+        """Cumulative prompt-token hit ratio over every probe so far."""
+        return self.hit_tokens / self.probed_tokens if self.probed_tokens \
+            else 0.0
+
+    # -- admission ----------------------------------------------------------
+    def lookup(self, tokens) -> list[int]:
+        """Longest cached page chain for ``tokens``, WITHOUT retaining —
+        capped at the last full page strictly before the final token, so
+        the request always prefills at least its tail (the rows the first
+        decode step needs must exist, and a zero-work prefill has no
+        program to run). Pair with :meth:`claim` once admission commits."""
+        ps = self.page_size
+        max_pages = (len(tokens) - 1) // ps
+        chain: list[int] = []
+        level = self._root
+        for i in range(max_pages):
+            key = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            node = level.get(key)
+            if node is None:
+                break
+            chain.append(node.page)
+            level = node.children
+        return chain
+
+    def claim(self, pages: list[int]) -> None:
+        """Retain a probed chain into a request's block table (hit commit).
+        Parked pages leave the evictable set while claimed; when the
+        request later releases them they re-park at the LRU tail — so a
+        hot prefix's recency refreshes through use, with no extra
+        bookkeeping here."""
+        self.cache.retain(pages)
+
+    def probe(self, tokens, request_id=None, chain=None) -> list[int]:
+        """Admission-path probe: look up, claim, count, and emit the
+        ``serving_prefix_hit`` lifecycle event. Returns the retained page
+        chain (possibly empty). Callers that already ran :meth:`lookup`
+        for sizing pass the result back as ``chain`` — the commit then
+        provably claims the same pages the sizing saw, with no second
+        trie walk."""
+        if chain is None:
+            chain = self.lookup(tokens)
+        self.probed_tokens += len(tokens)
+        if chain:
+            self.claim(chain)
+            self.hit_tokens += len(chain) * self.page_size
+            _observe.event("serving_prefix_hit", request=request_id,
+                           pages=len(chain),
+                           tokens=len(chain) * self.page_size,
+                           prompt_tokens=len(tokens))
+        _observe.set_gauge("serving.prefix_hit_rate", self.hit_rate())
+        return chain
+
+    # -- donation -----------------------------------------------------------
+    def donate(self, tokens, pages: list[int]) -> int:
+        """Register a completed request's full prompt pages as trie nodes.
+        Call BEFORE freeing the request's pages: registration is what
+        parks them (K/V preserved) when their refcount drops. Pages whose
+        prefix is already cached (another donor got there first) are left
+        unregistered — they free normally; the trie never holds two pages
+        for one prefix. Returns the number of newly registered pages.
+
+        Donation is capped at the last full page strictly before the
+        FINAL token: the final token of a completed request never has a
+        K/V row (it was sampled but never fed back — prefill writes
+        positions < len(prompt), each decode step writes the PREVIOUS
+        sample's row), so for a page-aligned ``tokens`` the last full
+        page holds one unwritten row and caching it would hand garbage
+        K/V to every future prefix hit. Symmetric with
+        :meth:`lookup`'s cap."""
+        ps = self.page_size
+        n_full = min((len(tokens) - 1) // ps, len(pages))
+        level, parent, added = self._root, None, 0
+        for i in range(n_full):
+            key = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            node = level.get(key)
+            if node is None:
+                node = _Node(pages[i], parent, key)
+                level[key] = node
+                self._by_page[node.page] = node
+                self.cache.register_cached(node.page)
+                added += 1
+            elif node.page != pages[i]:
+                # duplicate content under a different page: keep the
+                # incumbent, stop descending — a child registered under
+                # OUR page would be unreachable through the incumbent
+                break
+            level, parent = node.children, node
+        return added
+
+    def clear(self) -> None:
+        """Drop the whole trie and un-register every page (parked pages
+        return to the free list; live ones stop parking on release). Used
+        by benchmarks to re-run the cold-cache scenario, and by the engine
+        restart path when the pool the pages lived in is gone."""
+        for page in list(self._by_page):
+            self.cache.unregister_cached(page)
+        self._root.clear()
+        self._by_page.clear()
+        self.hit_tokens = 0
+        self.probed_tokens = 0
+
+    # -- eviction (allocator pressure callback) -----------------------------
+    def evict(self, page: int) -> list[int]:
+        """Drop the trie node owning ``page`` plus its whole subtree and
+        return every owned page for the allocator to reclaim. Only ever
+        called by ``PagedKVCache.alloc`` on parked rc-0 pages; subtree
+        pages are rc-0 by the ancestor-reference invariant."""
+        node = self._by_page.get(page)
+        if node is None:
+            return [page]        # unregistered parked page (defensive)
+        (node.parent.children if node.parent is not None
+         else self._root).pop(node.chunk, None)
+        dropped: list[int] = []
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            self._by_page.pop(n.page, None)
+            dropped.append(n.page)
+            stack.extend(n.children.values())
+        _observe.inc("serving.cache_evictions", len(dropped))
+        _observe.event("serving_cache_evict", pages=dropped,
+                       trigger_page=page)
+        return dropped

@@ -7,13 +7,25 @@ process:
 - ``decode``: ONE batched step over every slot — ``(S, 1)`` tokens against
   the shared page pools, ragged per-slot context lengths handled in-graph
   by ``nn.paged_decode_attention`` (claimed by the Pallas scalar-prefetch
-  kernel on TPU; XLA decomposition otherwise). Dispatched through
-  ``bind()`` — the serving fast path pays zero guard cost per step.
+  kernel on TPU; XLA decomposition otherwise), and SAMPLING fused in-graph
+  as the epilogue: per-slot sampling-parameter rows + raw threefry keys
+  ride in as plain arrays and the program returns sampled TOKEN IDS
+  (:func:`~thunder_tpu.serving.sampling.sample_tokens`; greedy is the
+  ``temperature == 0`` degenerate case, bit-identical to the host argmax
+  it replaced). The scheduler reads tokens, not logits — the prerequisite
+  for a fully device-side token loop. Dispatched through ``bind()`` — the
+  serving fast path pays zero guard cost per step.
 - ``prefill``: one CHUNK of one request's prompt — ``(1, C)`` tokens with
   ``C`` drawn from a ``LengthBucketer`` ladder (multiples of the page
   size), writing the chunk's K/V into the request's pages and attending
   the paged context so far. Ragged prompt lengths compile at most
-  ``len(ladder)`` prefill programs, ever.
+  ``len(ladder)`` prefill programs, ever. Prefill emits NO logits at all:
+  every request's first token comes from a decode REPLAY step (the
+  scheduler re-feeds the last prompt token with the write redirected to
+  the scratch page), so the lm_head matmul leaves the prefill program
+  entirely and the first token is sampled on the exact same program path
+  as every later one — which is what makes best-of-N forks and
+  recompute-on-resume token-streams line up with the unforked path.
 
 K/V writes address the pools through host-computed flat positions
 (``page_id * page_size + offset``) — the host owns the block tables, so the
@@ -35,6 +47,7 @@ from __future__ import annotations
 from thunder_tpu.core import dtypes, prims
 from thunder_tpu import ops
 from thunder_tpu.ops import nn as tnn
+from thunder_tpu.serving.sampling import sample_tokens
 
 
 def _rope_tables_at(cfg, positions, dtype):
@@ -103,7 +116,7 @@ class PagedLlamaRunner:
                                  fn_name="serving_decode", donate_argnums=(5,),
                                  **opts)
         self.prefill_jit = tt.jit(self._prefill_fn, executors=executors,
-                                  fn_name="serving_prefill", donate_argnums=(6,),
+                                  fn_name="serving_prefill", donate_argnums=(5,),
                                   **opts)
 
     # -- traced bodies ------------------------------------------------------
@@ -121,12 +134,18 @@ class PagedLlamaRunner:
 
         return _mlp(h, layer, cfg)
 
-    def _decode_fn(self, params, tokens, block_tables, lengths, write_pos, pools):
+    def _decode_fn(self, params, tokens, block_tables, lengths, write_pos,
+                   pools, temps, top_ks, top_ps, rng):
         """One continuous-batching decode step for every slot.
 
         tokens (S, 1) int32; block_tables (S, npg) int32; lengths (S,) int32
         context length INCLUDING this token; write_pos (S,) int32 flat pool
-        position of this token's K/V row. Returns (logits (S, V), pools)."""
+        position of this token's K/V row (the scratch position 0 for replay
+        rows, whose K/V already exists). Sampling inputs: temps (S,) f32,
+        top_ks (S,) int32, top_ps (S,) f32, rng (S, 2) uint32 raw threefry
+        keys. Returns (sampled token ids (S,) int32, logits (S, V), pools)
+        — the logits output exists for parity tests and future logprob
+        surfacing; the scheduler fetches only the token ids."""
         cfg = self.cfg
         g = self.geom
         h = ops.embedding(tokens, params["tok_embedding"])             # (S,1,D)
@@ -143,8 +162,11 @@ class PagedLlamaRunner:
             new_pools.append(kv)
             h = self._attn_block(h, layer, q, block_tables, lengths, kv)
         h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
-        logits = ops.linear(h, params["lm_head"])                      # (S,1,V)
-        return ops.squeeze(logits, 1), new_pools
+        logits = ops.squeeze(ops.linear(h, params["lm_head"]), 1)      # (S,V)
+        # in-graph sampling epilogue: one more fused tail on the program we
+        # already dispatch once per token (greedy == temperature 0)
+        toks = sample_tokens(logits, temps, top_ks, top_ps, rng)
+        return toks, logits, new_pools
 
     def _qkv(self, x, layer, cos, sin):
         """RoPE'd q/k/v heads (decode layout: T == x.shape[1])."""
@@ -162,16 +184,17 @@ class PagedLlamaRunner:
         return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
 
     def _prefill_fn(self, params, tokens, block_tables, lengths, page_writes,
-                    last_idx, pools):
-        """One prefill chunk of one request.
+                    pools):
+        """One prefill chunk of one request — K/V writes only, no logits.
 
         tokens (1, C) int32 (C from the bucket ladder, multiple of the page
         size; padded past the prompt tail); block_tables (1, npg); lengths
         (1,) int32 = chunk_start + C (context including the padded chunk);
-        page_writes (C//ps,) int32 flat positions of the chunk's pages;
-        last_idx 0-d int32 row of the final REAL token within the chunk
-        (meaningful on the last chunk; earlier chunks' logits are ignored).
-        Returns (logits (1, V) at last_idx, pools)."""
+        page_writes (C//ps,) int32 flat positions of the chunk's pages.
+        Returns the updated pools. The first token is sampled by a decode
+        REPLAY step after the final chunk lands, so prefill carries no
+        lm_head work at all (the old last-row logits slice is gone with
+        its host argmax)."""
         cfg = self.cfg
         g = self.geom
         C = tokens.shape[1]
@@ -183,7 +206,6 @@ class PagedLlamaRunner:
         new_pools = []
         flat = (g.kv_heads, g.num_pages * g.page_size, g.head_dim)
         paged = (g.kv_heads, g.num_pages, g.page_size, g.head_dim)
-        zero = ops.full((), 0, dtype=dtypes.int32)
         for layer, kv in zip(params["layers"], pools):
             x = ops.rms_norm(h, layer["attn_norm"], eps=cfg.norm_eps)
             q, k, v = _project_qkv(x, layer, cfg, cos, sin)
@@ -194,12 +216,7 @@ class PagedLlamaRunner:
             kv = {"k": ops.reshape(kp, paged), "v": ops.reshape(vp, paged)}
             new_pools.append(kv)
             h = self._attn_block(h, layer, q, block_tables, lengths, kv)
-        h = ops.rms_norm(h, params["norm_f"], eps=cfg.norm_eps)
-        # logits only at the final real row (pre-lm_head slice: the r4
-        # prefill lesson — never materialize (1, C, vocab))
-        h = prims.dynamic_slice(h, (zero, last_idx, zero), (1, 1, cfg.dim))
-        logits = ops.linear(h, params["lm_head"])                      # (1,1,V)
-        return ops.squeeze(logits, 1), new_pools
+        return new_pools
 
     # -- dispatch -----------------------------------------------------------
     def bind_decode(self, *args):

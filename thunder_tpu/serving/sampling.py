@@ -1,0 +1,222 @@
+"""In-graph sampling for the serving engine (ROADMAP 5(c) / 2(c)).
+
+The decode program's epilogue: per-slot sampling parameters and RNG keys
+ride into the compiled step as plain arrays, and the program emits sampled
+TOKEN IDS — the scheduler never sees logits, which is the prerequisite for
+the fully device-side token loop (a stop-condition word + batched token
+drain can only exist once the host stops argmax-ing every step).
+
+Design constraints, in order:
+
+- **Greedy is the ``temperature == 0`` degenerate case of the SAME
+  program.** A greedy slot's token is ``argmax(logits)`` computed in-graph
+  — bit-identical to the host argmax it replaces — so every existing
+  token-identity-vs-``llama.generate`` pin survives with sampling compiled
+  in. One program serves mixed greedy/sampled batches.
+- **Sort-free filtering.** ``top_k`` and ``top_p`` are implemented as
+  threshold masks found by fixed-iteration bisection (count / probability-
+  mass predicates), not by sorting the vocabulary: a V-length sort is the
+  classic TPU sampling bottleneck, while bisection is a handful of
+  elementwise-compare+reduce passes with a compile-time trip count.
+  Top-k bisects on the RAW logits (the top-k set is temperature-invariant)
+  so the threshold resolution doesn't degrade at small temperatures.
+  Ties at the converged threshold are all admitted (the mask keeps *at
+  least* k / *at least* mass p) — same tie semantics either side of the
+  threshold as a sort-based cutoff, documented rather than hidden.
+- **Batch-composition-independent streams.** Each slot's randomness is a
+  counter-based hash ``mix(seed, counter, vocab_index)`` — seed from the
+  request's :class:`SamplingParams`, counter = tokens sampled so far — so
+  a request's token stream is a pure function of (seed, counter, logits):
+  reproducible across recompiles, engine restarts, preemption
+  (recompute-on-resume replays the same counters), and whatever else
+  happens to share the batch. The mix is the murmur3 finalizer over
+  independently Weyl-multiplied inputs: ONE fused elementwise pass over
+  the (slots, vocab) grid, where per-slot keyed threefry uniforms would
+  cost a separate V-wide sweep per slot (measured 4x the whole epilogue's
+  cost on the CPU smoke geometry).
+- **Gumbel-max draw.** The sample itself is ``argmax(masked_logits + g)``
+  with iid Gumbel noise — the ``ops.multinomial`` trick, fused into the
+  decode epilogue instead of dispatched as its own program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from thunder_tpu import ops
+from thunder_tpu.core import dtypes
+
+# masked-out vocabulary entries: finite (NaN-free through softmax/add) but
+# below any real logit by enough that Gumbel noise can never resurrect one
+_MASKED = -1e30
+
+# bisection trip counts (compile-time unrolled). Top-k runs on raw logits
+# (range ~1e2), top-p on probabilities in [0, 1]; 18 halvings put the
+# threshold within ~range * 4e-6 of the exact order statistic — only
+# values tied at that resolution can be admitted past k / past mass p,
+# and each extra iteration is a full (S, V) compare+reduce pass, so the
+# count is the sampler's cost knob (the whole epilogue must stay noise
+# next to the lm_head matmul even on toy geometries).
+_TOPK_ITERS = 18
+_TOPP_ITERS = 18
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration.
+
+    ``temperature == 0`` selects greedy decoding (the default) — the
+    in-graph sampler degenerates to ``argmax``. ``top_k == 0`` disables
+    top-k filtering; ``top_p == 1.0`` disables nucleus filtering. ``seed``
+    pins the request's RNG stream (reproducible run-to-run); ``None``
+    derives a stream from the process-unique request id instead (distinct
+    per request, NOT reproducible across runs).
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int | None = None
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def stream_seed(self, request_id: int) -> int:
+        """The uint32 seed of this request's RNG stream (explicit seed, or
+        a request-id-derived one — Weyl-scrambled so adjacent ids don't
+        get adjacent threefry keys)."""
+        if self.seed is not None:
+            return self.seed & 0xFFFFFFFF
+        return (request_id * 0x9E3779B1 + 0x7F4A7C15) & 0xFFFFFFFF
+
+    def fork(self, branch: int) -> "SamplingParams":
+        """Sampling params for best-of-N branch ``branch`` (1-based for
+        clones): the same filtering config on a shifted seed, so each
+        branch draws an independent stream while staying reproducible
+        when the parent's seed is pinned."""
+        seed = None if self.seed is None else (self.seed + branch) & 0xFFFFFFFF
+        return SamplingParams(temperature=self.temperature, top_k=self.top_k,
+                              top_p=self.top_p, seed=seed)
+
+
+GREEDY = SamplingParams()
+
+
+def _u32(value: int):
+    return ops.full((), value, dtype=dtypes.uint32)
+
+
+def _gumbel(rng, V: int):
+    """Per-slot iid Gumbel noise over the vocabulary from raw
+    ``[seed, counter]`` uint32 rows: murmur3-finalizer avalanche over the
+    Weyl-multiplied (seed, counter, vocab_index) triple, mapped through
+    the top 24 bits to a (0, 1) uniform, then the double-log transform.
+    Pure elementwise — one fused pass over (S, V) — and a pure function
+    of the key row, so streams never depend on batch composition."""
+    S = rng.shape[0]
+    seed = ops.getitem(rng, (slice(None), 0))              # (S,)
+    ctr = ops.getitem(rng, (slice(None), 1))
+    v = ops.convert_element_type(ops.arange(0, V, dtype=dtypes.int32),
+                                 dtypes.uint32)
+    h = ops.bitwise_xor(ops.mul(seed, _u32(0x9E3779B1)),
+                        ops.mul(ctr, _u32(0x85EBCA77)))
+    h = ops.bitwise_xor(ops.reshape(h, (S, 1)),
+                        ops.mul(ops.reshape(v, (1, V)), _u32(0xC2B2AE3D)))
+    h = ops.bitwise_xor(h, ops.shift_right(h, 16))
+    h = ops.mul(h, _u32(0x85EBCA6B))
+    h = ops.bitwise_xor(h, ops.shift_right(h, 13))
+    h = ops.mul(h, _u32(0xC2B2AE35))
+    h = ops.bitwise_xor(h, ops.shift_right(h, 16))
+    u = ops.add(ops.mul(ops.convert_element_type(ops.shift_right(h, 8),
+                                                 dtypes.float32),
+                        1.0 / (1 << 24)), 1e-9)            # (0, 1)
+    return ops.neg(ops.log(ops.neg(ops.log(u))))
+
+
+def _topk_threshold(l32, k_col):
+    """Largest threshold t with ``count(l >= t) >= k``, per row, by
+    bisection (sort-free). Returns the (S, 1) threshold; masking
+    ``l >= t`` keeps the k largest entries plus any ties at t."""
+    lo = ops.sub(ops.amin(l32, dim=-1, keepdim=True), 1.0)   # count == V >= k
+    hi = ops.add(ops.amax(l32, dim=-1, keepdim=True), 1.0)   # count == 0 <  k
+    for _ in range(_TOPK_ITERS):
+        mid = ops.mul(ops.add(lo, hi), 0.5)
+        cnt = ops.sum(ops.convert_element_type(ops.ge(l32, mid),
+                                               dtypes.float32),
+                      dim=-1, keepdim=True)
+        keep = ops.ge(cnt, k_col)            # can the threshold be raised?
+        lo = ops.where(keep, mid, lo)
+        hi = ops.where(keep, hi, mid)
+    return lo
+
+
+def _topp_threshold(probs, p_col):
+    """Largest probability threshold t with ``sum(probs[probs >= t]) >=
+    top_p``, per row, by bisection on [0, 1] (sort-free nucleus cutoff).
+    Masking ``probs >= t`` keeps the smallest high-probability set with
+    at least ``top_p`` mass (plus ties at t)."""
+    zero = ops.zeros_like(p_col)
+    lo = zero                                  # mass == 1 >= top_p
+    hi = ops.add(zero, 1.0 + 1e-6)             # mass == 0 <  top_p
+    for _ in range(_TOPP_ITERS):
+        mid = ops.mul(ops.add(lo, hi), 0.5)
+        mass = ops.sum(ops.where(ops.ge(probs, mid), probs, zero),
+                       dim=-1, keepdim=True)
+        keep = ops.ge(mass, p_col)
+        lo = ops.where(keep, mid, lo)
+        hi = ops.where(keep, hi, mid)
+    return lo
+
+
+def sample_tokens(logits, temps, top_ks, top_ps, rng):
+    """Traced sampling epilogue: ``(S, V)`` logits -> ``(S,)`` int32 tokens.
+
+    ``temps`` (S,) f32, ``top_ks`` (S,) int32 (0 disables), ``top_ps``
+    (S,) f32 (1 disables), ``rng`` (S, 2) uint32 — each row the
+    ``[stream_seed, counter]`` key of the slot's hash-based RNG stream.
+    Rows with ``temps == 0`` return the
+    plain in-graph ``argmax`` (greedy), bit-identical to the host argmax
+    this epilogue replaces; the sampled path for those rows is computed
+    and discarded by ``where`` (O(S*V) elementwise work, noise next to
+    the lm_head matmul that produced the logits).
+    """
+    S, V = logits.shape
+    l32 = ops.convert_element_type(logits, dtypes.float32)
+    greedy = ops.convert_element_type(ops.argmax(l32, dim=-1), dtypes.int32)
+
+    # top-k threshold mask on the RAW logits (temperature-invariant set)
+    k_col = ops.convert_element_type(ops.reshape(top_ks, (S, 1)),
+                                     dtypes.float32)
+    need_k = ops.logical_and(ops.ge(k_col, 1.0), ops.lt(k_col, float(V)))
+    k_mask = ops.ge(l32, _topk_threshold(l32, k_col))
+    masked = ops.where(ops.logical_and(need_k, ops.logical_not(k_mask)),
+                       ops.full((), _MASKED, dtype=dtypes.float32), l32)
+
+    # temperature scaling (sampled path only; the floor keeps the scaled
+    # range bounded so downstream float math stays well-conditioned —
+    # temperatures at or below it are what the greedy path is for)
+    t_col = ops.clamp(ops.reshape(temps, (S, 1)), min=1e-3)
+    scaled = ops.true_divide(masked, t_col)
+
+    # nucleus (top-p) threshold mask on the scaled distribution
+    p_col = ops.reshape(top_ps, (S, 1))
+    need_p = ops.lt(p_col, 1.0)
+    probs = ops.softmax(scaled, dim=-1, dtype=dtypes.float32)
+    p_mask = ops.ge(probs, _topp_threshold(probs, p_col))
+    scaled = ops.where(ops.logical_and(need_p, ops.logical_not(p_mask)),
+                       ops.full((), _MASKED, dtype=dtypes.float32), scaled)
+
+    # Gumbel-max categorical draw, one independent hash stream per slot
+    sampled = ops.convert_element_type(
+        ops.argmax(ops.add(scaled, _gumbel(rng, V)), dim=-1), dtypes.int32)
+
+    return ops.where(ops.gt(temps, 0.0), sampled, greedy)

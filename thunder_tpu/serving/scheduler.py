@@ -51,8 +51,33 @@ different sequence lengths served by ONE compiled decode program.
   (``schedule`` host work vs ``decode_dispatch``); the Perfetto exporter
   renders per-request tracks, a scheduler track, and counter tracks.
 
-Greedy sampling (argmax) — the engine is a throughput/latency runtime, not
-a sampling library; temperature sampling stays in ``models.llama.generate``.
+- **In-graph sampling**: every request carries
+  :class:`~thunder_tpu.serving.sampling.SamplingParams`; the compiled
+  decode step samples temperature/top-k/top-p tokens IN-GRAPH (per-slot
+  parameter rows + threefry keys, sort-free threshold masking, Gumbel-max
+  draw) and the scheduler reads token ids, never logits. Greedy is the
+  ``temperature == 0`` degenerate case of the same program — bit-identical
+  to the host argmax it replaced, so token-identity-vs-``generate()`` pins
+  hold. Every request's FIRST token comes from a decode *replay* step (the
+  last prompt token re-fed with its K/V write redirected to the scratch
+  page), so prefill carries no lm_head at all and first tokens ride the
+  batched decode program like every other token.
+- **Best-of-N via copy-on-write forks**: ``submit(best_of=N)`` prefills
+  ONCE; when the primary's prompt is resident, N-1 clones fork its block
+  table — full pages shared by refcount, only the partial tail page
+  copied — and branch with independent RNG streams
+  (``SamplingParams.fork``). A clone that can't fork yet (no free slot /
+  no tail page) waits on the primary and spills to the ordinary queue if
+  the primary terminates first.
+- **Cross-request prefix cache** (``prefix_cache=True``): admission probes
+  a page-granularity token trie
+  (:class:`~thunder_tpu.serving.prefix_cache.PrefixCache`) with the
+  prompt, prefill starts at the first uncached page, and completed
+  requests donate their full prompt pages back. Cached pages are parked
+  at refcount 0 — evicted oldest-first by the allocator under page
+  pressure, so the cache can never starve live traffic. A warm hit
+  collapses TTFT to one tail-chunk prefill
+  (``serving.prefix_hit_rate`` / ``serving.cached_pages``).
 """
 
 from __future__ import annotations
@@ -75,8 +100,10 @@ from thunder_tpu.serving.errors import (
     EngineStallError,
     InfeasibleRequest,
 )
-from thunder_tpu.serving.kv_cache import PagedKVCache, PageGeometry
+from thunder_tpu.serving.kv_cache import OutOfPages, PagedKVCache, PageGeometry
+from thunder_tpu.serving.prefix_cache import PrefixCache
 from thunder_tpu.serving.runner import PagedLlamaRunner
+from thunder_tpu.serving.sampling import GREEDY, SamplingParams
 
 QUEUED, PREFILL, DECODE, DONE, SHED = \
     "queued", "prefill", "decode", "done", "shed"
@@ -113,6 +140,18 @@ class Request:
     restarts: int = 0                   # supervisor crash-recovery re-admits
     admit_seq: int = -1                 # admission order (preemption victim pick)
     pages_version: int = 0              # bumped when ``pages`` changes
+    # in-graph sampling: per-request params + derived uint32 stream seed
+    sampling: SamplingParams = GREEDY
+    stream_seed: int = 0
+    _replay: bool = False               # next decode step re-feeds the last
+    #                                     prompt token (write -> scratch) to
+    #                                     sample the FIRST token in-graph
+    # best-of-N copy-on-write forks
+    fork_parent: "Request | None" = None
+    fork_pending: list = field(default_factory=list)  # clones awaiting fork
+    fork_group: list = field(default_factory=list)    # primary + clones
+    # cross-request prefix cache
+    prefix_hit_tokens: int = 0          # prompt tokens served from the trie
     # lifecycle tracing (flight recorder + Perfetto request tracks)
     submitted_us: float = 0.0           # observe-epoch submit timestamp
     queued_ms: float = 0.0              # total time spent queued (incl. resumes)
@@ -163,7 +202,8 @@ class ServingEngine:
                  num_pages: int | None = None, max_context: int | None = None,
                  prefill_chunk: int | None = None, n_layers: int | None = None,
                  max_queue: int | None = None, executors=None,
-                 retry_policy=None, block_fusion=None):
+                 retry_policy=None, block_fusion=None,
+                 prefix_cache: bool = False):
         self.params = params
         self.cfg = cfg
         n_layers_eff = n_layers if n_layers is not None else cfg.n_layers
@@ -197,6 +237,9 @@ class ServingEngine:
             pages_per_request=pages_per_req)
         self.geom = geometry
         self.cache = PagedKVCache(geometry, cfg.dtype.jax)
+        # cross-request prefix cache (opt-in): completed prompts donate
+        # their full pages into a token trie; admission probes it
+        self.prefix = PrefixCache(self.cache) if prefix_cache else None
         self.runner = PagedLlamaRunner(cfg, geometry, n_layers=n_layers,
                                        executors=executors,
                                        block_fusion=block_fusion)
@@ -226,14 +269,32 @@ class ServingEngine:
         self._np_len = np.ones(S, np.int32)
         self._np_wp = np.zeros(S, np.int32)
         self._bt_slot_version: list = [None] * S
+        # per-slot sampling rows fed to the in-graph sampler: temperature /
+        # top-k / top-p plus a raw threefry key [stream_seed, counter].
+        # Idle slots are greedy rows on the zero key (their token is
+        # computed and discarded)
+        self._np_temp = np.zeros(S, np.float32)
+        self._np_topk = np.zeros(S, np.int32)
+        self._np_topp = np.ones(S, np.float32)
+        self._np_rng = np.zeros((S, 2), np.uint32)
 
     # -- public API ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: int | None = None, deadline_s: float | None = None,
-               priority: int = 0) -> Request:
+               priority: int = 0, sampling: SamplingParams | None = None,
+               best_of: int = 1) -> Request:
         """Enqueue a request. ``deadline_s`` is the SLO budget from now
         (expiry sheds the request with ``DeadlineExceeded``); ``priority``
         orders admission and shedding (higher survives longer).
+
+        ``sampling`` selects the in-graph sampler's per-request config
+        (default greedy). ``best_of=N`` runs N branches over ONE prefill:
+        the primary prefills normally and N-1 clones fork its block table
+        copy-on-write once the prompt is resident, each on an independent
+        RNG stream (``sampling.fork``). Returns the primary; the whole
+        group is ``request.fork_group``. Clones bypass the admission
+        queue (they ride the primary) but count as ordinary requests
+        everywhere else — slots, pages, SLO accounting, shedding.
 
         Raises ``InfeasibleRequest`` when the request could never run on
         this engine (capacity contract, checked up front — an infeasible
@@ -245,6 +306,9 @@ class ServingEngine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if best_of < 1:
+            raise ValueError(f"best_of must be >= 1, got {best_of}")
+        sampling = GREEDY if sampling is None else sampling
         total = int(prompt.size) + int(max_new_tokens)
         if total > self.max_context:
             raise InfeasibleRequest(
@@ -261,18 +325,32 @@ class ServingEngine:
                 f"the pool only has {self.cache.pages_total} — enlarge "
                 f"num_pages")
         now = time.perf_counter()
-        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
-                      request_id=next(_REQUEST_IDS), eos_id=eos_id,
-                      priority=int(priority),
-                      deadline_at=None if deadline_s is None
-                      else now + float(deadline_s),
-                      submitted_s=now, submitted_us=_observe._now_us())
-        # lifecycle edge 1: always in the flight ring, registry on or off
-        _observe.event("serving_submitted", request=req.request_id,
-                       prompt_tokens=int(prompt.size),
-                       max_new_tokens=int(max_new_tokens),
-                       priority=req.priority, deadline_s=deadline_s)
-        self._phase_begin(req, QUEUED)
+
+        def new_request(sp: SamplingParams, parent=None) -> Request:
+            r = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                        request_id=next(_REQUEST_IDS), eos_id=eos_id,
+                        priority=int(priority),
+                        deadline_at=None if deadline_s is None
+                        else now + float(deadline_s),
+                        submitted_s=now, submitted_us=_observe._now_us(),
+                        sampling=sp, fork_parent=parent)
+            r.stream_seed = sp.stream_seed(r.request_id)
+            # lifecycle edge 1: always in the flight ring, registry on/off
+            _observe.event("serving_submitted", request=r.request_id,
+                           prompt_tokens=int(prompt.size),
+                           max_new_tokens=int(max_new_tokens),
+                           priority=r.priority, deadline_s=deadline_s,
+                           best_of=best_of if parent is None else None,
+                           fork_of=None if parent is None
+                           else parent.request_id)
+            self._phase_begin(r, QUEUED)
+            return r
+
+        req = new_request(sampling)
+        if best_of > 1:
+            req.fork_pending = [new_request(sampling.fork(i), parent=req)
+                                for i in range(1, best_of)]
+            req.fork_group = [req, *req.fork_pending]
         if not self.admitting:
             err = AdmissionRejected(
                 f"request {req.request_id} rejected: engine is draining, "
@@ -316,6 +394,11 @@ class ServingEngine:
         busy = bool(self.queue) or self.active_requests > 0
         t0_us = _observe._now_us()
         worked = self._expire_deadlines()
+        # pending best-of forks take slots before fresh admissions (they
+        # are older traffic, and forking is cheaper than a prefill)
+        for r in self.slots:
+            if r is not None and r.fork_pending:
+                worked = self._materialize_forks(r) or worked
         worked = self._admit() or worked
         if busy:
             # host-scheduling half of the iteration (deadlines + admission);
@@ -394,11 +477,17 @@ class ServingEngine:
             req.prefilled = 0
             req.length = 0
             req.next_token = None
+            req._replay = False
             req.state = QUEUED
             req.restarts += 1
             self.queue.appendleft(req)  # reverse admit order -> FIFO resume
             self._phase_begin(req, QUEUED)
         self.cache = PagedKVCache(self.geom, self.cfg.dtype.jax)
+        if self.prefix is not None:
+            # the trie's pages died with the consumed pools: start a fresh
+            # cache attached to the rebuilt allocator (re-donation refills
+            # it as recovered requests complete)
+            self.prefix = PrefixCache(self.cache)
         self._decode_bound = None
         self._bound_epoch = -1
         self._np_bt[:] = 0
@@ -457,6 +546,10 @@ class ServingEngine:
             "pages_total": self.cache.pages_total,
             "peak_pages_used": self.cache.peak_pages_used,
             "pools_alive": self.cache.pools_alive(),
+            "cached_pages": self.cache.cached_pages,
+            "cow_copies": self.cache.cow_copies,
+            "prefix_hit_rate": (round(self.prefix.hit_rate(), 4)
+                                if self.prefix is not None else None),
             "block_table_rows_live": int((self._np_bt != 0).any(1).sum()),
             "quiescence": quiescence,
             "slo": {"attained": self._slo_attained, "total": self._slo_total},
@@ -505,6 +598,8 @@ class ServingEngine:
         _observe.set_gauge("serving.queue_depth", len(self.queue))
         _observe.set_gauge("serving.active_requests", self.active_requests)
         _observe.set_gauge("serving.kv_pages_free", self.cache.pages_free)
+        if self.prefix is not None:
+            _observe.set_gauge("serving.cached_pages", self.cache.cached_pages)
         if self._slo_total:
             _observe.set_gauge("serving.slo_attainment",
                                self._slo_attained / self._slo_total)
@@ -518,6 +613,10 @@ class ServingEngine:
         expired += [r for r in self.slots
                     if r is not None and r.deadline_at is not None
                     and now > r.deadline_at]
+        # pending fork clones expire too (they ride a resident primary)
+        expired += [c for r in self.slots if r is not None
+                    for c in r.fork_pending
+                    if c.deadline_at is not None and now > c.deadline_at]
         for req in expired:
             self._shed(req, DeadlineExceeded(
                 f"request {req.request_id} missed its deadline "
@@ -528,12 +627,28 @@ class ServingEngine:
 
     def _shed(self, req: Request, error: BaseException) -> None:
         """Terminal removal with a typed error: from the queue, from a
-        slot (pages freed, block-table row zeroed), or pre-admission."""
+        slot (pages freed through the refcount path, block-table row
+        zeroed), from a primary's pending-fork list, or pre-admission.
+        Pending clones die with their primary (they can't fork from a
+        terminal request and were never independently queued)."""
+        if req.state in (DONE, SHED):   # cascades can re-reach a terminal
+            return
         shed_from = req.state           # the state it was shed FROM
         if req in self.queue:
             self.queue.remove(req)
         elif req in self.slots:
             self._release_slot(req)
+        elif req.fork_parent is not None and \
+                req in req.fork_parent.fork_pending:
+            req.fork_parent.fork_pending.remove(req)
+        for clone in list(req.fork_pending):
+            kind = DeadlineExceeded if isinstance(error, DeadlineExceeded) \
+                else AdmissionRejected
+            self._shed(clone, kind(
+                f"request {clone.request_id} shed with its fork primary "
+                f"{req.request_id} ({type(error).__name__})",
+                request_id=clone.request_id))
+        req.fork_pending = []
         self._phase_end(req, reason=type(error).__name__)
         req.state = SHED
         req.error = error
@@ -570,8 +685,20 @@ class ServingEngine:
             # priority-ordered admission: highest priority first, FIFO among
             # equals (all-default-priority traffic keeps the old strict FIFO)
             req = max(self.queue, key=lambda r: r.priority)
-            first_chunk = self._chunk_size(len(req.work_prompt))
-            if not self.cache.can_alloc(first_chunk // self.geom.page_size):
+            wp = req.work_prompt
+            # prefix-cache probe (sizing pass, nothing retained yet):
+            # prefill starts at the first uncached page, so a hit shrinks
+            # both the first chunk and the fresh-page demand
+            hit = self.prefix.lookup(wp) if self.prefix is not None else []
+            hit_tokens = len(hit) * self.geom.page_size
+            first_chunk = self._chunk_size(len(wp) - hit_tokens)
+            need_new = (hit_tokens + first_chunk) // self.geom.page_size \
+                - len(hit)
+            # availability check: hit pages parked at rc 0 are about to be
+            # claimed, so they must not double-count as evictable headroom
+            parked_hits = sum(1 for p in hit if self.cache.refcount(p) == 0)
+            if self.cache.pages_free + self.cache.cached_pages \
+                    - parked_hits < need_new:
                 break   # page back-pressure: wait for completions/evictions
             try:
                 _faults.maybe_fail("serving:admission", step=self._step_count)
@@ -586,9 +713,15 @@ class ServingEngine:
                 admitted = True
                 break
             self.queue.remove(req)
-            req.pages = self.cache.alloc(first_chunk // self.geom.page_size)
+            # commit: claim the probed chain FIRST (retained pages can't be
+            # evicted out from under us by the alloc below), then the fresh
+            # pages for the first uncached chunk
+            chain = self.prefix.probe(wp, req.request_id, chain=hit) \
+                if self.prefix is not None else []
+            req.pages = chain + self.cache.alloc(need_new)
             req.pages_version += 1
-            req.prefilled = 0
+            req.prefilled = len(chain) * self.geom.page_size
+            req.prefix_hit_tokens = req.prefilled
             req.length = 0
             req.state = PREFILL
             req.admit_seq = next(self._admits)
@@ -596,7 +729,8 @@ class ServingEngine:
             self._phase_end(req)            # close "queued"
             _observe.event("serving_admitted", request=req.request_id,
                            slot=slot, preemptions=req.preemptions,
-                           restarts=req.restarts)
+                           restarts=req.restarts,
+                           prefix_hit_tokens=req.prefilled)
             self._phase_begin(req, PREFILL)
             admitted = True
         return admitted
@@ -675,11 +809,11 @@ class ServingEngine:
             _faults.maybe_fail("serving:prefill", step=self._step_count)
             return self.runner.prefill_jit(
                 self.params, chunk, self._block_table(req)[None], lengths,
-                page_writes, np.int32(real - 1), self.cache.pools)
+                page_writes, self.cache.pools)
 
         t0 = time.perf_counter()
         t0_us = _observe._now_us()
-        logits, pools = self._dispatch_guarded(dispatch, "serving:prefill")
+        pools = self._dispatch_guarded(dispatch, "serving:prefill")
         self.cache.update_pools(pools)
         dur_us = _observe._now_us() - t0_us
         _observe.observe_value("serving.prefill_ms",
@@ -693,15 +827,23 @@ class ServingEngine:
                        chunk=C, pos0=pos0, real=real)
         req.prefilled += real
         if req.prefilled == len(wp):                # prompt fully resident
+            # no logits left prefill: the FIRST token comes from the next
+            # batched decode step as a REPLAY — re-feed the last prompt
+            # token (its K/V row already exists; the write goes to the
+            # scratch page) and sample in-graph on the same program path
+            # as every later token
             req.length = len(wp)
+            req.next_token = int(wp[-1])
+            req._replay = True
             req.state = DECODE
             self._phase_end(req)                    # close "prefill"
             self._phase_begin(req, DECODE)
             if req.decode_start_s is None:          # survive preempt-resume:
                 # decode_ms stays first-token -> completion, as documented
                 req.decode_start_s = time.perf_counter()
-            tok = int(np.asarray(logits)[0].argmax())
-            self._on_token(req, tok)
+            if req.fork_pending:
+                # the prompt is resident: best-of clones can fork it now
+                self._materialize_forks(req)
         return True
 
     def _grow_pages(self, req: Request, n: int) -> bool:
@@ -733,6 +875,7 @@ class ServingEngine:
         req.prefilled = 0
         req.length = 0
         req.next_token = None
+        req._replay = False
         req.state = QUEUED
         req.preemptions += 1
         self.queue.appendleft(req)
@@ -748,7 +891,10 @@ class ServingEngine:
         for req in list(self.slots):
             if req is None or req.state != DECODE:
                 continue
-            need = req.length // g.page_size + 1
+            # a replay row writes nothing (scratch page): it only needs its
+            # existing context pages, not the next append page yet
+            need = (-(-req.length // g.page_size) if req._replay
+                    else req.length // g.page_size + 1)
             if len(req.pages) < need:
                 self._grow_pages(req, need - len(req.pages))
         active = [(i, r) for i, r in enumerate(self.slots)
@@ -757,16 +903,23 @@ class ServingEngine:
             return False
         tokens, bt = self._np_tokens, self._np_bt
         lengths, write_pos = self._np_len, self._np_wp
+        temps, topk = self._np_temp, self._np_topk
+        topp, rng = self._np_topp, self._np_rng
         for i in range(self.max_slots):
             r = self.slots[i]
             if r is None or r.state != DECODE:
                 # idle slots attend + scribble on the reserved page 0 only
                 # (their block-table row is zeroed when the slot is
                 # released, so the documented invariant holds exactly:
-                # idle slots never read a live request's pages)
+                # idle slots never read a live request's pages); their
+                # sampling row is greedy on the zero key
                 tokens[i, 0] = 0
                 lengths[i] = 1
                 write_pos[i] = 0
+                temps[i] = 0.0
+                topk[i] = 0
+                topp[i] = 1.0
+                rng[i] = 0
                 if self._bt_slot_version[i] is not None:
                     bt[i] = 0
                     self._bt_slot_version[i] = None
@@ -777,9 +930,24 @@ class ServingEngine:
                 bt[i, :len(r.pages)] = r.pages
                 bt[i, len(r.pages):] = 0
                 self._bt_slot_version[i] = key
-            lengths[i] = r.length + 1
-            write_pos[i] = (r.pages[r.length // g.page_size] * g.page_size
-                            + r.length % g.page_size)
+            if r._replay:
+                # first-token replay: the fed token's K/V row already
+                # exists at position length-1 (prefill wrote it, or the
+                # fork copied it), so the context length is unchanged and
+                # the recomputed row is discarded on the scratch page —
+                # shared COW pages are never written
+                lengths[i] = r.length
+                write_pos[i] = 0
+            else:
+                lengths[i] = r.length + 1
+                write_pos[i] = (r.pages[r.length // g.page_size] * g.page_size
+                                + r.length % g.page_size)
+            sp = r.sampling
+            temps[i] = sp.temperature
+            topk[i] = sp.top_k
+            topp[i] = sp.top_p
+            rng[i, 0] = r.stream_seed
+            rng[i, 1] = len(r.generated)    # counter: tokens sampled so far
 
         def dispatch():
             # injected faults fire BEFORE the device dispatch, so a retried
@@ -818,22 +986,29 @@ class ServingEngine:
                 _observe.set_gauge("serving.quarantine_epoch", ep)
                 self._decode_bound = self.runner.bind_decode(
                     self.params, tokens, bt, lengths, write_pos,
-                    self.cache.pools)
+                    self.cache.pools, temps, topk, topp, rng)
                 self._bound_epoch = ep
             return self._decode_bound(self.params, tokens, bt, lengths,
-                                      write_pos, self.cache.pools)
+                                      write_pos, self.cache.pools,
+                                      temps, topk, topp, rng)
 
         t0_us = _observe._now_us()
-        logits, pools = self._dispatch_guarded(dispatch, "serving:decode")
+        tok_ids, _logits, pools = \
+            self._dispatch_guarded(dispatch, "serving:decode")
         self.cache.update_pools(pools)
-        toks = np.asarray(logits).argmax(-1)    # host sync: honest step end
-        # the dispatch half of the iteration, on the scheduler track (the
-        # host sync above makes the duration an honest device-step bound)
+        # tokens were sampled IN-GRAPH; fetching the (S,) id vector is the
+        # host sync that makes the span below an honest device-step bound
+        # (the (S, V) logits output stays on device, unread)
+        toks = np.asarray(tok_ids)
+        # the dispatch half of the iteration, on the scheduler track
         _observe.record_span("decode_dispatch", "serving:sched", t0_us,
                              _observe._now_us() - t0_us,
                              {"step": self._step_count, "batch": len(active)})
         for i, r in active:
-            r.length += 1
+            if r._replay:
+                r._replay = False   # context length unchanged; row existed
+            else:
+                r.length += 1
             self._on_token(r, int(toks[i]))
         return True
 
@@ -849,7 +1024,88 @@ class ServingEngine:
                 or (req.eos_id is not None and tok == req.eos_id)):
             self._finish(req)
 
+    def _materialize_forks(self, primary: Request) -> bool:
+        """Fork pending best-of clones off a resident primary whose prompt
+        is fully resident: full prompt pages SHARED by refcount (zero bytes
+        moved), only a partial tail page copied (``serving.cow_copies``).
+        Each clone takes a free slot and enters decode in replay mode — its
+        first token samples from the prompt's last-position logits on its
+        own RNG stream, exactly like an independently-submitted request
+        would. Clones that can't fork yet (no free slot, no page for the
+        tail copy) stay pending and retry next step; the primary's terminal
+        transition spills any remainder to the ordinary queue."""
+        g = self.geom
+        L = len(primary.prompt)
+        n_ctx = g.pages_for(L)
+        if primary.state != DECODE or len(primary.pages) < n_ctx:
+            return False
+        # priority-ordered slot acquisition applies to clones too: a
+        # strictly higher-priority queued request gets the free slot (via
+        # the admission pass that follows); equal priority favors the
+        # clone — it is older traffic and forking is cheaper than prefill
+        top_queued = max((r.priority for r in self.queue), default=None)
+        worked = False
+        while primary.fork_pending:
+            if top_queued is not None and \
+                    top_queued > primary.fork_pending[0].priority:
+                break
+            slot = next((i for i, s in enumerate(self.slots) if s is None),
+                        None)
+            if slot is None:
+                break
+            clone = primary.fork_pending[0]
+            cow_before = self.cache.cow_copies
+            try:
+                pages = self.cache.fork(primary.pages, L)
+            except OutOfPages:
+                break       # tail copy can't allocate; retry under less load
+            primary.fork_pending.pop(0)
+            # the allocator owns the copy decision; read the count back
+            # rather than re-deriving it (the two can't drift)
+            copied = self.cache.cow_copies - cow_before
+            if copied:
+                _observe.inc("serving.cow_copies", copied)
+            clone.pages = pages
+            clone.pages_version += 1
+            clone.prefilled = L
+            clone.length = L
+            clone.next_token = int(clone.prompt[-1])
+            clone._replay = True
+            clone.state = DECODE
+            clone.admit_seq = next(self._admits)
+            self.slots[slot] = clone
+            self._phase_end(clone)          # close "queued" (fork-pending)
+            _observe.event("serving_fork", request=clone.request_id,
+                           parent=primary.request_id, slot=slot,
+                           shared_pages=len(pages) - copied, copied=copied)
+            self._phase_begin(clone, DECODE)
+            if clone.decode_start_s is None:
+                clone.decode_start_s = time.perf_counter()
+            worked = True
+        return worked
+
     def _finish(self, req: Request) -> None:
+        if self.prefix is not None and req.pages:
+            # donate the full prompt pages back BEFORE freeing: the
+            # registration is what parks them (K/V preserved) when the
+            # release below drops their last reference
+            self.prefix.donate(req.work_prompt, req.pages)
+        for clone in list(req.fork_pending):   # _shed mutates the list
+            # never-forked clones fall back to the ordinary queue (full
+            # prefill — which may now prefix-hit the donated prompt), but
+            # the bounded-admission contract still applies: spill only up
+            # to max_queue and shed the overflow typed, so best_of can't
+            # grow the queue past the overload bound submit() enforces
+            if self.max_queue is not None and \
+                    len(self.queue) >= self.max_queue:
+                self._shed(clone, AdmissionRejected(
+                    f"request {clone.request_id} shed: fork primary "
+                    f"{req.request_id} finished before the clone could "
+                    f"fork and the admission queue is full "
+                    f"({self.max_queue})", request_id=clone.request_id))
+            else:
+                self.queue.appendleft(clone)
+        req.fork_pending = []
         self._release_slot(req)
         self._phase_end(req)            # close "decode"
         req.state = DONE
