@@ -45,6 +45,10 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 8: bench.py stamps the compiled-program census (census_* fields from
+# observe.census: HLO collective instructions, async fraction, fusion
+# instructions, flops, peak live HBM, sentinel findings) and bench_serve
+# stamps the decode program's census alongside its launch shape;
 # 7: bench_serve --prefix stamps prefix_hit_rate /
 # cached_prefill_skipped_tokens / cow_copies / bestof_page_amplification
 # (shared-prefix serving: in-graph sampling + COW paged prefix cache);
@@ -56,7 +60,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 7
+METRICS_SCHEMA = 8
 
 
 def main():
@@ -370,6 +374,20 @@ def main():
         _bd.save(rows, {"model": model, "layers": n_layers, "batch": batch,
                         "seq": seq, "remat": use_remat})
 
+    # compiled-program census (schema 8): the executable's OWN accounting,
+    # stamped so a collective sneaking into the single-chip program, a
+    # fusion-count regression, or a sentinel finding is a diff in CI.
+    # Computed AFTER the timed runs — the first access pays the census's
+    # one memoized AOT compile (observe.census), which must not sit between
+    # the warmup and the timing loop.
+    cens = tt.compile_stats(jstep).last_census or {}
+    cens_async = cens.get("async") or {}
+    print(f"census: {int(cens_async.get('count', 0))} collective instr, "
+          f"{int(cens.get('hlo_fusions', 0))} hlo fusions, "
+          f"{len(cens.get('findings') or [])} finding(s), "
+          f"{int(cens.get('census_errors', 0))} guarded error(s)",
+          file=sys.stderr)
+
     tokens_per_sec = batch * seq / t_ours
     fpt = llama.flops_per_token(cfg, seq, n_layers)
     # v5e ≈ 197 TFLOP/s bf16, v5p ≈ 459
@@ -401,6 +419,16 @@ def main():
         # numerics-sentinel cost: guarded step time vs unguarded, same trace
         # (in-graph health word + skip select + one scalar fetch per step)
         "sentinel_overhead_pct": round(sentinel_overhead_pct, 2),
+        # schema-8 compiled-program census (observe.census)
+        "census_collective_instructions": int(cens_async.get("count", 0)),
+        "census_async_fraction": round(float(cens_async.get("fraction", 0.0)), 4),
+        "census_hlo_fusions": int(cens.get("hlo_fusions", 0)),
+        "census_pallas_launches": int(cens.get("pallas_launches", 0)),
+        "census_xla_flops": float(cens.get("xla_flops", 0.0)),
+        "census_peak_hbm_bytes": int(cens.get("live_bytes", 0)),
+        "census_errors": int(cens.get("census_errors", 0)),
+        "census_pessimizations": sorted(
+            {f["kind"] for f in (cens.get("findings") or [])}),
     }))
 
 

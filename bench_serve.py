@@ -53,6 +53,13 @@ best-of-1 — the gate wants < 1.5x, because N branches share ONE
 prefill). Warm outputs are checked token-identical to cold, and the
 fixed-seed sampled best-of outputs reproduce run-to-run.
 
+The schema-8 continuous line additionally stamps the DECODE PROGRAM's
+compiled-program census (``observe.census``):
+``census_decode_collective_instructions`` (0 is the healthy single-chip
+value — nonzero IS the regression), ``census_decode_hlo_fusions``,
+guarded ``census_decode_errors``, and any sentinel
+``census_decode_pessimizations`` kinds.
+
 Env: SERVE_MODEL, SERVE_LAYERS, SERVE_REQUESTS, SERVE_DECODE, SERVE_SLOTS,
 SERVE_CONTEXT, SERVE_PAGE, SERVE_CHUNK, SERVE_RATE, SERVE_DEADLINE_S,
 SERVE_QUEUE, SERVE_SYS, SERVE_BESTOF, SERVE_TRACE. ``--smoke``: tiny GQA
@@ -464,6 +471,14 @@ def main():
         w, stats = run_continuous()
         if cont is None or w < cont["wall"]:
             cont = stats
+    # decode-program census (schema 8): the compiled decode step's HLO-level
+    # accounting next to the trace-level launch gauges stamped above — a
+    # collective appearing in the single-chip decode program or a fusion
+    # regression is a diff in CI. After the timed rounds: the first access
+    # pays the census's one memoized AOT compile (observe.census).
+    dec_cens = tt.compile_stats(eng.runner.decode_jit).last_census or {}
+    dec_async = dec_cens.get("async") or {}
+
     seq_tps = total_tokens / seq_wall
     wall = cont["wall"]
     cont_tps = total_tokens / wall
@@ -510,7 +525,14 @@ def main():
         "sched_host_ms_mean": round(cont["sched_host_ms_mean"], 3),
         "decode_dispatch_ms_mean": round(cont["decode_dispatch_ms_mean"], 3),
         "prefill_chunks_total": int(cont["prefill_chunks"]),
-        "flight_records": int(cont["flight_records"])}))
+        "flight_records": int(cont["flight_records"]),
+        # schema-8 decode-program census (observe.census)
+        "census_decode_collective_instructions": int(
+            dec_async.get("count", 0)),
+        "census_decode_hlo_fusions": int(dec_cens.get("hlo_fusions", 0)),
+        "census_decode_errors": int(dec_cens.get("census_errors", 0)),
+        "census_decode_pessimizations": sorted(
+            {f["kind"] for f in (dec_cens.get("findings") or [])})}))
 
     if trace_path:
         with open(trace_path, "w") as f:

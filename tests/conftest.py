@@ -48,3 +48,38 @@ def eight_devices():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs
+
+
+@pytest.fixture(scope="session")
+def fsdp_smoke_step():
+    """ONE tiny fsdp zero-2 smoke compile (llama tiny, 2 layers, 8-device
+    CPU mesh — the NORTHSTAR smoke config) shared by test_northstar's
+    evidence-pipeline smoke and test_census's census/budget gates: the
+    compile plus its memoized AOT executable are the expensive parts, and
+    both files read the same entry. Returns (jstep, entry)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu.core.devices import MeshSpec
+    from thunder_tpu.distributed import fsdp
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import AdamW
+
+    cfg = llama.CONFIGS["tiny"]
+    opt = AdamW(lr=1e-4)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_p, new_s = opt.update(params, grads, opt_state)
+        return loss, new_p, new_s
+
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    jstep = fsdp(train_step, MeshSpec.make(fsdp=8), zero=2)
+    entry = jstep.compile(params, opt.init(params), tokens, targets)
+    return jstep, entry

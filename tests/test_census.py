@@ -1,0 +1,466 @@
+"""Per-compile executable census (thunder_tpu.observe.census): the shared
+HLO collective parser on hand-built HLO, the pessimization sentinel's typed
+findings, the CPU-mesh fsdp smoke (census byte-identical to what the
+northstar bench computes through the same parser), the committed
+CENSUS_BUDGETS.json regression gates, the guarded-error counter (a census
+can never fail a compile), and the last_hlo no-recompile memoization."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import observe, ops
+from thunder_tpu.benchmarks import northstar as ns
+from thunder_tpu.observe import census
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGETS_PATH = os.path.join(REPO, "CENSUS_BUDGETS.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    observe.disable()
+    observe.reset()
+    yield
+    observe.disable()
+    observe.reset()
+    census.configure(**census.DEFAULT_THRESHOLDS)
+
+
+def _budgets() -> dict:
+    with open(BUDGETS_PATH) as f:
+        return json.load(f)["configs"]
+
+
+# ---------------------------------------------------------------------------
+# the shared parser on hand-built HLO
+# ---------------------------------------------------------------------------
+
+class TestHloCollectivesParser:
+    def test_parser_is_the_northstar_parser(self):
+        # one owner: the bench imports the census module's function object
+        assert ns.hlo_collectives is census.hlo_collectives
+
+    def test_async_start_done_pairing_across_fusions(self):
+        """A start/done pair separated by a fusion counts ONE async
+        instruction: the `-start` carries the payload, the `-done` is not a
+        collective opcode (the alternation requires `(` right after the
+        base name), and the fusion between them is never miscounted."""
+        hlo = """
+  %ags = (bf16[128,8]{1,0}, bf16[1024,8]{1,0}) all-gather-start(bf16[128,8]{1,0} %p0), dimensions={0}
+  %fused = bf16[8]{0} fusion(bf16[8]{0} %x), kind=kLoop, calls=%fc
+  %agd = bf16[1024,8]{1,0} all-gather-done((bf16[128,8]{1,0}, bf16[1024,8]{1,0}) %ags)
+"""
+        c = census.hlo_collectives(hlo, n_dev=8)
+        ag = c["per_kind"]["all-gather"]
+        assert ag["count"] == 1 and ag["async_count"] == 1
+        # destination payload: the largest array of the start tuple
+        assert ag["out_bytes"] == 1024 * 8 * 2
+        assert ag["recv_bytes_per_dev"] == 1024 * 8 * 2 * 7 // 8
+        assert c["async_fraction"]["all-gather"] == 1.0
+        assert list(c["per_kind"]) == ["all-gather"]  # the fusion: not one
+
+    def test_multi_operand_all_gather(self):
+        """A multi-operand all-gather emits a tuple output; the parser's
+        pinned semantics charge the LARGEST output as the destination
+        payload (one instruction, not one per operand)."""
+        hlo = """
+  %ag = (f32[512,4]{1,0}, f32[256,4]{1,0}) all-gather(f32[64,4]{1,0} %a, f32[32,4]{1,0} %b), dimensions={0}
+"""
+        c = census.hlo_collectives(hlo, n_dev=8)
+        ag = c["per_kind"]["all-gather"]
+        assert ag["count"] == 1
+        assert ag["out_bytes"] == 512 * 4 * 4
+        assert ag["recv_bytes_per_dev"] == 512 * 4 * 4 * 7 // 8
+
+    def test_degenerate_zero_collective_program(self):
+        hlo = """
+  %m = f32[64,64]{1,0} dot(f32[64,64]{1,0} %a, f32[64,64]{1,0} %b)
+  %fused = f32[64]{0} fusion(f32[64]{0} %x), kind=kLoop, calls=%fc
+"""
+        c = census.hlo_collectives(hlo, n_dev=8)
+        assert c["per_kind"] == {}
+        assert c["recv_bytes_per_device_total"] == 0
+        assert c["async_fraction"] == {}
+
+
+# ---------------------------------------------------------------------------
+# pessimization sentinel: typed findings on synthetic censuses
+# ---------------------------------------------------------------------------
+
+def _synthetic(per_kind, expected, async_n=0):
+    total = sum(e["count"] for e in per_kind.values())
+    return {
+        "collectives": {"per_kind": per_kind,
+                        "recv_bytes_per_device_total": 0,
+                        "async_fraction": {}},
+        "async": {"async": async_n, "count": total,
+                  "fraction": (async_n / total) if total else 0.0},
+        "expected_collectives": expected,
+        "expected_collective_count": sum(expected.values()),
+    }
+
+
+class TestPessimizationFindings:
+    def test_reduce_scatter_rewrite_flagged(self):
+        c = _synthetic({"all-reduce": {"count": 21}},
+                       {"reduce_scatter": 21, "synchronize": 21})
+        kinds = [f["kind"] for f in census.findings(c)]
+        assert "reduce-scatter-rewritten" in kinds
+
+    def test_surviving_reduce_scatters_are_clean(self):
+        c = _synthetic({"reduce-scatter": {"count": 21},
+                        "all-gather": {"count": 21}},
+                       {"reduce_scatter": 21, "synchronize": 21})
+        assert census.findings(c) == []
+
+    def test_sync_fraction_below_floor_flagged(self):
+        c = _synthetic({"all-gather": {"count": 10}}, {"synchronize": 10},
+                       async_n=1)
+        assert census.findings(c) == []   # disarmed by default (CPU mesh)
+        kinds = [f["kind"] for f in
+                 census.findings(c, {"async_fraction_min": 0.5})]
+        assert kinds == ["sync-collective-fraction"]
+
+    def test_collective_count_inflation_flagged(self):
+        c = _synthetic({"all-gather": {"count": 50}}, {"synchronize": 10})
+        kinds = [f["kind"] for f in census.findings(c)]
+        assert "collective-count-inflation" in kinds
+
+    def test_decode_launch_growth(self):
+        f = census.launch_growth_finding(8, 2, 1.0)   # 4 launches/layer > 1
+        assert f is not None and f["kind"] == "decode-launch-growth"
+        assert census.launch_growth_finding(2, 2, 1.0) is None
+        assert census.launch_growth_finding(8, 2, None) is None
+
+    def test_every_kind_is_registered(self):
+        provoked = set()
+        provoked.update(f["kind"] for f in census.findings(
+            _synthetic({"all-reduce": {"count": 99}}, {"reduce_scatter": 3}),
+            {"async_fraction_min": 1.0}))
+        provoked.add(census.launch_growth_finding(9, 1, 0.5)["kind"])
+        assert provoked == set(census.PESSIMIZATION_KINDS)
+
+    def test_configure_rejects_unknown_threshold(self):
+        with pytest.raises(KeyError):
+            census.configure(async_floor=0.5)
+
+
+# ---------------------------------------------------------------------------
+# the CPU-mesh fsdp smoke: census == northstar, budgets gate, explain
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fsdp_smoke(fsdp_smoke_step):
+    """The session-shared tiny fsdp zero-2 compile (conftest
+    ``fsdp_smoke_step`` — also consumed by test_northstar's evidence
+    smoke, so the expensive compile + memoized AOT executable are paid
+    once per suite run)."""
+    return fsdp_smoke_step
+
+
+class TestFsdpSmokeCensus:
+    def test_reduce_scatters_survive_and_counts_match_northstar(self, fsdp_smoke):
+        """The zero-2 grad reduction survives as reduce-scatter on the CPU
+        path AND the per-compile census is byte-identical to what the
+        northstar evidence pack computes from the same executable through
+        the same shared parser."""
+        jstep, entry = fsdp_smoke
+        c = tt.hlo_census(jstep)
+        assert c is not None and c["hlo_unavailable"] is None
+        kinds = set(c["collectives"]["per_kind"])
+        assert "reduce-scatter" in kinds and "all-gather" in kinds, kinds
+        assert c["async"]["count"] > 0
+        assert 0 <= c["async"]["async"] <= c["async"]["count"]
+        # northstar's analyze() over the SAME memoized executable
+        m = ns.analyze(census.compiled_for_entry(entry), n_dev=8,
+                       analytic_flops=1e9)
+        assert json.dumps(m["hlo_collectives"], sort_keys=True) \
+            == json.dumps(c["collectives"], sort_keys=True)
+        # the trace-level expectation rode along (the sentinel's baseline)
+        assert c["expected_collectives"].get("reduce_scatter", 0) > 0
+
+    def test_census_within_committed_budget(self, fsdp_smoke):
+        """The regression gate: this compile drifting outside its committed
+        CENSUS_BUDGETS.json bounds fails tier-1."""
+        jstep, _ = fsdp_smoke
+        budget = _budgets()["tiny-fsdp-cpu8-zero2"]
+        violations = census.check_budget(tt.hlo_census(jstep), budget)
+        assert not violations, violations
+
+    def test_budget_violation_is_detected(self, fsdp_smoke):
+        """check_budget actually bites: a budget this compile cannot meet
+        reports violations (so the gate above is a live gate, not a
+        tautology)."""
+        jstep, _ = fsdp_smoke
+        c = tt.hlo_census(jstep)
+        assert census.check_budget(c, {"forbid_kinds": ["all-gather"]})
+        assert census.check_budget(c, {"max_total_collectives": 0})
+        assert census.check_budget(c, {"async_fraction_min": 1.1})
+        assert census.check_budget(c, {"min_counts": {"reduce-scatter": 10**6}})
+
+    def test_explain_shows_census_with_denominators(self, fsdp_smoke):
+        jstep, _ = fsdp_smoke
+        rep = observe.explain(jstep)
+        assert "== compiled program (HLO census) ==" in rep
+        c = tt.hlo_census(jstep)
+        a = c["async"]
+        assert f"async fraction: {a['async']}/{a['count']}" in rep
+        assert "reduce-scatter x" in rep
+        assert "recv/device" in rep
+
+    def test_provoked_pessimization_lands_everywhere(self, fsdp_smoke):
+        """Arm an async floor no CPU HLO can meet: the typed finding shows
+        in the census, in explain(), in last_decisions, and (as an event)
+        in the always-on flight ring."""
+        from thunder_tpu.observe import flight
+
+        jstep, _ = fsdp_smoke
+        observe.enable(clear=True)
+        census.configure(async_fraction_min=1.1)
+        try:
+            c = tt.hlo_census(jstep)
+            kinds = [f["kind"] for f in c["findings"]]
+            assert "sync-collective-fraction" in kinds
+            rep = observe.explain(jstep)
+            assert "[sync-collective-fraction]" in rep
+            decs = [d for d in tt.compile_stats(jstep).last_decisions
+                    if d["kind"] == "pessimization"]
+            assert any(d["op"] == "sync-collective-fraction" for d in decs)
+            assert any(r.get("kind") == "pessimization"
+                       and r.get("pessimization") == "sync-collective-fraction"
+                       for r in flight.snapshot() if r["type"] == "event")
+            assert observe.snapshot()["counters"]["compile.pessimizations"] >= 1
+        finally:
+            census.configure(async_fraction_min=0.0)
+        # disarming clears the finding on the next evaluation (idempotent
+        # re-ensure; the decision log follows)
+        c = tt.hlo_census(jstep)
+        assert all(f["kind"] != "sync-collective-fraction"
+                   for f in c["findings"])
+        assert all(d["op"] != "sync-collective-fraction"
+                   for d in tt.compile_stats(jstep).last_decisions
+                   if d["kind"] == "pessimization")
+        # and a kind that cleared and later RE-FIRES is re-exported (the
+        # flagged-set tracks the current findings, it does not grow forever)
+        n_before = sum(1 for e in observe.snapshot()["events"]
+                       if e["kind"] == "pessimization")
+        census.configure(async_fraction_min=1.1)
+        try:
+            tt.hlo_census(jstep)
+        finally:
+            census.configure(async_fraction_min=0.0)
+        n_after = sum(1 for e in observe.snapshot()["events"]
+                      if e["kind"] == "pessimization")
+        assert n_after == n_before + 1
+
+    def test_census_gauges_exported(self, fsdp_smoke):
+        """The hlo.* gauges reach the registry (and so the Prometheus/JSONL
+        exporters). The census is memoized, so force a fresh publish by
+        clearing the entry's memo under an enabled registry."""
+        jstep, entry = fsdp_smoke
+        observe.enable(clear=True)
+        entry.census = None
+        c = tt.hlo_census(jstep)
+        snap = observe.snapshot()
+        assert snap["gauges"]["hlo.collective_instructions"] \
+            == c["async"]["count"]
+        assert snap["gauges"]["hlo.recv_bytes_per_device"] \
+            == c["collectives"]["recv_bytes_per_device_total"]
+        assert 0.0 <= snap["gauges"]["hlo.async_fraction"] <= 1.0
+        assert snap["counters"]["compile.census_runs"] >= 1
+        prom = observe.export_prometheus()
+        assert "thunder_tpu_hlo_collective_instructions" in prom
+        assert "thunder_tpu_hlo_async_fraction" in prom
+
+
+# ---------------------------------------------------------------------------
+# guarded errors: the census can never fail (or re-lower) a compile
+# ---------------------------------------------------------------------------
+
+class _RaisingJit:
+    def lower(self, *a, **k):
+        raise RuntimeError("synthetic lowering explosion")
+
+
+class TestGuardedErrors:
+    def _jfn(self):
+        jfn = tt.jit(lambda a, b: ops.matmul(a, b))
+        jfn(np.ones((4, 5), np.float32), np.ones((5, 3), np.float32))
+        return jfn
+
+    def test_census_error_is_counted_and_surfaced_not_raised(self):
+        jfn = self._jfn()
+        entry = tt.compile_stats(jfn).last_entry
+        entry.jit_obj = _RaisingJit()          # poison the AOT path
+        observe.enable(clear=True)
+        c = tt.hlo_census(jfn)                 # must NOT raise
+        assert c is not None
+        assert c["collectives"] is None
+        assert c["census_errors"] >= 1 and c["errors"]
+        assert observe.snapshot()["counters"]["compile.census_errors"] >= 1
+        rep = observe.explain(jfn)             # must not raise either
+        assert "guarded census errors" in rep
+
+    def test_trace_half_errors_survive_executable_census(self, monkeypatch):
+        """An error in the cheap trace half must not be clobbered when the
+        executable half succeeds — merged, counted, surfaced."""
+        jfn = self._jfn()
+
+        def boom(trc):
+            raise RuntimeError("synthetic trace walk explosion")
+
+        monkeypatch.setattr(census, "trace_census", boom)
+        observe.enable(clear=True)
+        c = tt.hlo_census(jfn)
+        assert c is not None
+        assert c["collectives"] is not None       # executable half intact
+        assert any(str(e).startswith("trace:") for e in c["errors"])
+        assert c["census_errors"] >= 1
+        assert observe.snapshot()["counters"]["compile.census_errors"] >= 1
+
+    def test_comm_report_failure_is_surfaced_not_swallowed(self, monkeypatch):
+        """A comm_report failure zeroes the trace expectation — which
+        silently disarms the rewrite/inflation sentinels — so it must be
+        counted and surfaced like every other guarded census error."""
+        from thunder_tpu import examine
+
+        def boom(trc):
+            raise RuntimeError("synthetic comm_report explosion")
+
+        monkeypatch.setattr(examine, "comm_report", boom)
+        jfn = self._jfn()
+        observe.enable(clear=True)
+        c = tt.hlo_census(jfn)
+        assert c is not None and c["collectives"] is not None
+        assert any("comm_report" in str(e) for e in c["errors"])
+        assert c["census_errors"] >= 1
+        assert observe.snapshot()["counters"]["compile.census_errors"] >= 1
+
+    def test_unavailable_executable_is_not_an_error(self):
+        """symbolic-values / no-jit entries report hlo_unavailable with a
+        reason — NOT through the error counter (nothing went wrong)."""
+        jfn = self._jfn()
+        entry = tt.compile_stats(jfn).last_entry
+        entry.census = None
+        entry.jit_obj = None
+        observe.enable(clear=True)
+        c = tt.hlo_census(jfn)
+        assert c is not None and c["hlo_unavailable"]
+        assert c["census_errors"] == 0
+        assert "compile.census_errors" not in observe.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# last_hlo memoization: no recompile, no re-lowering
+# ---------------------------------------------------------------------------
+
+class _CountingJit:
+    def __init__(self, inner):
+        self._inner = inner
+        self.lower_calls = 0
+
+    def lower(self, *a, **k):
+        self.lower_calls += 1
+        return self._inner.lower(*a, **k)
+
+
+class TestLastHloNoRecompile:
+    def test_optimized_hlo_is_memoized_per_entry(self):
+        jfn = tt.jit(lambda a, b: ops.matmul(a, b))
+        jfn(np.ones((4, 5), np.float32), np.ones((5, 3), np.float32))
+        entry = tt.compile_stats(jfn).last_entry
+        entry.jit_obj = _CountingJit(entry.jit_obj)
+        first = tt.last_hlo(jfn, optimized=True)
+        assert entry.jit_obj.lower_calls == 1
+        assert "HloModule" in first
+        # the second call must not lower (and so cannot recompile)
+        second = tt.last_hlo(jfn, optimized=True)
+        assert entry.jit_obj.lower_calls == 1
+        assert second == first
+        # unoptimized StableHLO shares the same memoized Lowered
+        tt.last_hlo(jfn, optimized=False)
+        assert entry.jit_obj.lower_calls == 1
+        # so do examine + the census: ONE executable for every consumer
+        from thunder_tpu.examine import xla_memory
+
+        xla_memory(jfn)
+        assert tt.hlo_census(jfn)["collectives"] is not None
+        assert entry.jit_obj.lower_calls == 1
+
+
+# ---------------------------------------------------------------------------
+# serving decode program: census-fed gauges + decode budget gate
+# ---------------------------------------------------------------------------
+
+class TestDecodeProgramCensus:
+    @pytest.fixture(scope="class")
+    def engine_run(self):
+        from thunder_tpu.models import llama
+        from thunder_tpu.serving import ServingEngine
+
+        cfg = llama.CONFIGS["tiny-gqa"]
+        params = llama.init_params(cfg, seed=0, scale_layers=1)
+        observe.enable(clear=True)
+        try:
+            # launch_budget_per_layer=-0.5 is unmeetable by construction
+            # (launches >= 0 > -0.5 always): the point is to prove the
+            # decode-launch-growth finding fires, CPU included
+            eng = ServingEngine(params, cfg, max_slots=2, page_size=16,
+                                max_context=64, n_layers=1, prefill_chunk=32,
+                                launch_budget_per_layer=-0.5)
+            rng = np.random.RandomState(0)
+            eng.submit(rng.randint(1, cfg.vocab_size, 5).astype(np.int32), 3)
+            eng.drain()
+            # materialize the decode census (derives the budget finding);
+            # call twice — the finding must export exactly ONCE
+            tt.hlo_census(eng.runner.decode_jit)
+            tt.hlo_census(eng.runner.decode_jit)
+            snap = observe.snapshot()
+        finally:
+            observe.disable()
+        return eng, snap
+
+    def test_launch_gauges_fed_from_census(self, engine_run):
+        eng, snap = engine_run
+        trc = tt.last_execution_trace(eng.runner.decode_jit)
+        tc = census.trace_census(trc)
+        assert snap["gauges"]["serving.decode_pallas_launches"] \
+            == tc["pallas_launches"]
+        assert snap["gauges"]["serving.decode_layer_fusions"] \
+            == tc["decode_layer_fusions"]
+
+    def test_unmeetable_launch_budget_fires_finding_exactly_once(self, engine_run):
+        """The finding reaches the event stream and counter ONCE for one
+        persistent condition — re-evaluating the census must not
+        double-count (the bind path publishes only the launch gauges; the
+        census owns the finding)."""
+        _, snap = engine_run
+        events = [e for e in snap["events"] if e["kind"] == "pessimization"
+                  and e.get("pessimization") == "decode-launch-growth"]
+        assert len(events) == 1
+        assert snap["counters"]["compile.pessimizations"] == 1
+
+    def test_decode_census_within_committed_budget(self, engine_run):
+        eng, _ = engine_run
+        c = tt.hlo_census(eng.runner.decode_jit)
+        assert c is not None and c["hlo_unavailable"] is None
+        budget = _budgets()["tiny-gqa-decode-1l"]
+        violations = census.check_budget(c, budget)
+        assert not violations, violations
+
+    def test_launch_budget_finding_regenerates_in_census(self, engine_run):
+        """The decode-launch-growth finding is not a bind-time-only event:
+        the runner stashes its layer count + budget on the decode jit's
+        census_context, so the census / explain / decision log all carry
+        the finding whenever they are evaluated."""
+        eng, _ = engine_run
+        c = tt.hlo_census(eng.runner.decode_jit)
+        assert any(f["kind"] == "decode-launch-growth" for f in c["findings"])
+        assert "[decode-launch-growth]" in observe.explain(eng.runner.decode_jit)
+        decs = tt.compile_stats(eng.runner.decode_jit).last_decisions
+        assert any(d["kind"] == "pessimization"
+                   and d["op"] == "decode-launch-growth" for d in decs)

@@ -134,6 +134,71 @@ def test_serving_event_kinds_documented():
         f"registers: {stale}")
 
 
+def test_census_metric_names_documented():
+    """Every ``compile.*`` / ``hlo.*`` metric name the code emits must
+    appear in the docs' census metrics table, and every name the table
+    documents must still be emitted — the census gauges are what dashboards
+    and the ROADMAP-3 overlap work key on (same both-direction pattern as
+    the serving event vocabulary)."""
+    import glob
+
+    import thunder_tpu
+
+    pkg_root = os.path.dirname(thunder_tpu.__file__)
+    sources = glob.glob(os.path.join(pkg_root, "**", "*.py"), recursive=True)
+    names: set = set()
+    for path in sources:
+        with open(path) as f:
+            names |= set(re.findall(
+                r"[\"']((?:compile|hlo)\.[a-z0-9_]+)[\"']", f.read()))
+    # the census family must all be present (a refactor that stops
+    # emitting them should fail loudly here)
+    for required in ("compile.count", "compile.census_runs",
+                     "compile.census_errors", "compile.pessimizations",
+                     "compile.pallas_launches", "compile.fusion_regions",
+                     "hlo.collective_instructions", "hlo.async_fraction",
+                     "hlo.recv_bytes_per_device", "hlo.peak_hbm_bytes"):
+        assert required in names, f"code no longer emits {required}"
+    with open(DOC) as f:
+        doc = f.read()
+    missing = [n for n in sorted(names) if f"`{n}`" not in doc]
+    assert not missing, (
+        "compile/hlo census metrics emitted by the code but missing from "
+        f"the docs metrics table (docs/zero_to_thunder_tpu.md): {missing}")
+    # reverse direction: table rows documenting names nothing emits
+    table_names = set(re.findall(r"^\| `((?:compile|hlo)\.[a-z0-9_]+)` \|",
+                                 doc, re.M))
+    assert table_names, "docs lost the census metrics table"
+    stale = sorted(table_names - names)
+    assert not stale, (
+        f"docs census metrics table documents names the code no longer "
+        f"emits: {stale}")
+
+
+def test_pessimization_kinds_documented():
+    """The pessimization-sentinel vocabulary is an ops contract both ways:
+    every kind in ``census.PESSIMIZATION_KINDS`` must be documented in
+    NORTHSTAR.md's pessimization table, and every table row must name a
+    registered kind (stale docs teach triage scripts to match findings
+    that never fire)."""
+    from thunder_tpu.observe.census import PESSIMIZATION_KINDS
+
+    assert PESSIMIZATION_KINDS, "census lost its pessimization vocabulary"
+    northstar_doc = os.path.join(REPO, "NORTHSTAR.md")
+    with open(northstar_doc) as f:
+        doc = f.read()
+    missing = [k for k in sorted(PESSIMIZATION_KINDS) if f"`{k}`" not in doc]
+    assert not missing, (
+        "pessimization kinds the sentinel can emit but missing from the "
+        f"NORTHSTAR.md table: {missing}")
+    table_kinds = set(re.findall(r"^\| `([a-z][a-z-]*)` \|", doc, re.M))
+    assert table_kinds, "NORTHSTAR.md lost its pessimization-kinds table"
+    stale = sorted(table_kinds - set(PESSIMIZATION_KINDS))
+    assert not stale, (
+        "NORTHSTAR.md pessimization table documents kinds the sentinel "
+        f"no longer registers: {stale}")
+
+
 def test_block_planner_decision_kinds_documented():
     """Every verdict kind the block planner can emit must appear in the
     KERNELS.md "Reading planner decisions" table — the decision log is an

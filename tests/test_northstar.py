@@ -141,33 +141,16 @@ class TestMixtral8x7BEp:
 # libtpu) fails fast.
 # ---------------------------------------------------------------------------
 
-def test_evidence_pipeline_smoke_cpu():
-    import numpy as np
-
-    import thunder_tpu as tt
-    from thunder_tpu.core.devices import MeshSpec
-    from thunder_tpu.distributed import fsdp
+def test_evidence_pipeline_smoke_cpu(fsdp_smoke_step):
     from thunder_tpu.models import llama
-    from thunder_tpu.optim import AdamW
+    from thunder_tpu.observe import census
 
     n_dev = 8
     cfg = llama.CONFIGS["tiny"]
-    opt = AdamW(lr=1e-4)
-
-    def train_step(params, opt_state, tokens, targets):
-        loss, grads = tt.value_and_grad(
-            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
-        new_p, new_s = opt.update(params, grads, opt_state)
-        return loss, new_p, new_s
-
-    params = llama.init_params(cfg, seed=0, scale_layers=2)
-    rng = np.random.RandomState(0)
-    tokens = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
-    targets = np.roll(tokens, -1, 1).astype(np.int32)
-
-    jstep = fsdp(train_step, MeshSpec.make(fsdp=n_dev), zero=2)
-    entry = jstep.compile(params, opt.init(params), tokens, targets)
-    compiled = entry.jit_obj.lower(*entry.input_avals).compile()
+    jstep, entry = fsdp_smoke_step
+    # the shared memoized accessor: ONE AOT compile per suite run, shared
+    # with test_census (and with tt.last_hlo / examine on this entry)
+    compiled = census.compiled_for_entry(entry)
 
     n = ns.n_params_llama(cfg)
     m = ns.analyze(compiled, n_dev=n_dev,

@@ -129,6 +129,52 @@ class TestCommReport:
         assert rep["comm"]["collectives"] == {}  # single-device: no comm
 
 
+class TestCommReorderReport:
+    def test_sort_waits_reports_what_it_did(self, eight_devices):
+        """The comm_reorder pass records its schedule as decisions: a
+        summary (hoisted-issue / sunk-wait counts) plus one
+        ``overlap_window`` record per collective with the issue→wait
+        distance before vs after — the baseline the ROADMAP-3 overlap pass
+        is judged against — and explain() renders the section."""
+        from thunder_tpu import observe
+
+        cfg = llama.CONFIGS["tiny"]
+        opt, args = _args(cfg, n_layers=1)
+        jstep = fsdp(_step_fn(cfg, opt), MeshSpec.make(fsdp=8),
+                     comm_reorder=True)
+        jstep.compile(*args)
+        decs = [d for d in tt.compile_stats(jstep).last_decisions
+                if d["kind"] == "comm"]
+        assert decs, "comm_reorder recorded no decisions"
+        summary = [d for d in decs if d["op"] == "comm_reorder"]
+        assert len(summary) == 1
+        cost = summary[0]["cost"]
+        assert cost["issues"] > 0 and cost["waits"] > 0
+        assert 0 <= cost["hoisted_issues"] <= cost["issues"]
+        assert 0 <= cost["sunk_waits"] <= cost["waits"]
+        windows = [d for d in decs if d["decision"] == "overlap_window"]
+        assert windows, "no per-collective issue->wait distances recorded"
+        for d in windows:
+            c = d["cost"]
+            assert c["issue_at"] < c["wait_at"]
+            assert c["distance"] == c["wait_at"] - c["issue_at"]
+            assert c["distance"] >= 1 and c["distance_before"] >= 1
+        # the reschedule actually widened at least one window
+        assert any(d["cost"]["distance"] > d["cost"]["distance_before"]
+                   for d in windows)
+        rep = observe.explain(jstep)
+        assert "== comm reorder ==" in rep
+        assert "issue@" in rep and "wait@" in rep
+
+    def test_plain_compile_has_no_comm_section(self):
+        from thunder_tpu import observe
+        from thunder_tpu.ops import matmul
+
+        jfn = tt.jit(lambda a, b: matmul(a, b))
+        jfn(np.ones((4, 5), np.float32), np.ones((5, 3), np.float32))
+        assert "== comm reorder ==" not in observe.explain(jfn)
+
+
 @pytest.fixture
 def eight_devices():
     import jax

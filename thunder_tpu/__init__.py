@@ -140,6 +140,26 @@ class CompileStats:
         # per-pass walltimes (ms) — always collected, see thunder_tpu.observe
         self.last_decisions: list[dict] = []
         self.last_pass_times: dict[str, float] = {}
+        self.fn_name = "fn"  # set by the owning ThunderTPUFunction
+        # census knobs for this function's compiles (observe.census.ensure
+        # reads them): the serving runner stashes its decode layer count +
+        # launch budget here so the decode-launch-growth finding regenerates
+        # on every census evaluation, not only at bind time
+        self.census_context: dict = {}
+
+    @property
+    def last_census(self):
+        """The executable census of the most recently compiled entry
+        (``thunder_tpu.observe.census``): HLO collective instructions with
+        ring-model recv bytes and async fractions (denominators included),
+        kernel-launch / fusion-region counts, XLA cost/memory analysis, and
+        the pessimization sentinel's findings. Lazy — the first access pays
+        one memoized AOT compile of the entry (jax exposes no handle to the
+        executable the run path built); never raises (census errors are
+        counted and surfaced, not thrown). ``None`` before any compile."""
+        from thunder_tpu.observe import census as _census
+
+        return _census.ensure(self, fn_name=self.fn_name)
 
     @property
     def last_interpreted_ms(self) -> float:
@@ -180,7 +200,8 @@ class CompileStats:
 class CacheEntry:
     __slots__ = ("computation_fn", "run_fn", "tensor_indices", "uses_rng", "traces",
                  "prologue_trace", "prologue_fn", "out_spec", "arg_of_flat",
-                 "input_avals", "jit_obj", "is_sharded", "_examine_compiled")
+                 "input_avals", "jit_obj", "is_sharded", "_examine_compiled",
+                 "_examine_lowered", "census", "n_dev")
 
     def __init__(self, computation_fn, tensor_indices, uses_rng, traces, prologue_trace,
                  prologue_fn, out_spec):
@@ -196,6 +217,13 @@ class CacheEntry:
         self.input_avals = None  # jax.ShapeDtypeStructs of run_fn's inputs
         self.jit_obj = None      # the jax.jit object (lowerable), when one exists
         self.is_sharded = False  # True for shard_map-wrapped (distributed) entries
+        # introspection caches: the ONE AOT lowering/executable every
+        # consumer (census, last_hlo, examine.xla_memory/xla_cost) shares —
+        # the no-recompile discipline lives in observe.census
+        self._examine_lowered = None
+        self._examine_compiled = None
+        self.census = None       # memoized executable census (observe.census)
+        self.n_dev = 1           # mesh size (distributed finalize overrides)
 
 
 def _is_arraylike(x) -> bool:
@@ -247,6 +275,7 @@ class ThunderTPUFunction:
         self.fn_name = fn_name or getattr(fn, "__name__", "fn")
         self._cache: dict = {}
         self._stats = CompileStats()
+        self._stats.fn_name = self.fn_name
         # Frontends may stash call-varying specialization context here (the
         # torch dialect's input-alias pattern: which args share a storage —
         # reference guards aliases via the prologue, thunder/__init__.py:
@@ -960,8 +989,14 @@ def last_hlo(jfn, *, optimized: bool = False) -> str:
     """StableHLO (or XLA-optimized HLO with ``optimized=True``) of the most
     recently compiled entry — the per-stage dump SURVEY §7 calls out as the
     multi-host debugging essential (the trace prints Python; this is what XLA
-    actually receives/produces)."""
-    import jax
+    actually receives/produces).
+
+    Both stages are memoized per entry through ``observe.census``'s shared
+    accessors: ``optimized=True`` used to pay a FULL second XLA compile via
+    ``lowered.compile()`` on every call — now the first caller (here, the
+    census, or ``examine.xla_memory/xla_cost``) builds the one AOT
+    executable and everyone after reuses it."""
+    from thunder_tpu.observe import census as _census
 
     entry = _as_tfn(jfn)._stats.last_entry
     check(entry is not None, "no compilation has run yet")
@@ -970,10 +1005,17 @@ def last_hlo(jfn, *, optimized: bool = False) -> str:
     check(entry.jit_obj is not None,
           "entry is not whole-program-jitted (device-sync ops in the trace or "
           "whole_program_jit=False); no HLO available")
-    lowered = entry.jit_obj.lower(*entry.input_avals)
     if optimized:
-        return lowered.compile().as_text()
-    return lowered.as_text()
+        return _census.compiled_for_entry(entry).as_text()
+    return _census.lowered_for_entry(entry).as_text()
+
+
+def hlo_census(jfn) -> dict | None:
+    """The per-compile executable census of ``jfn``'s most recent entry —
+    ``CompileStats.last_census`` as a function (see
+    ``thunder_tpu.observe.census`` for the dict shape and the
+    pessimization-sentinel findings it carries)."""
+    return _as_tfn(jfn)._stats.last_census
 
 
 def last_jaxpr(jfn):

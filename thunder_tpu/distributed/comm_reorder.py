@@ -123,11 +123,54 @@ def sort_waits(trc: TraceCtx) -> TraceCtx:
     if len(order) != n:  # cycle (malformed trace): bail out unchanged
         return trc
 
+    _report(groups, order, produced_by)
+
     new = from_trace(trc)
     for gi in order:
         new.bound_symbols.extend(groups[gi])
     new.set_provenance("Comm reorder (hoist collective issues, sink waits)")
     return new
+
+
+def _report(groups, order, produced_by) -> None:
+    """Record what the reschedule DID as decisions (kind ``comm``): how
+    many collective issues were hoisted, how many waits sunk, and the
+    per-collective issue→wait distance before vs after — the overlap
+    window independent compute can slide into. This is the baseline the
+    ROADMAP-3 overlap-scheduling pass will be judged against, rendered by
+    ``observe.explain()``'s compiled-program section."""
+    from thunder_tpu.observe import decisions as _decisions
+
+    if not _decisions.active():
+        return
+    new_pos = {gi: pos for pos, gi in enumerate(order)}
+    # group index == original position (groups were built in trace order)
+    issues = [gi for gi in range(len(groups)) if _is_issue(groups[gi][0])]
+    waits = [gi for gi in range(len(groups)) if _is_wait(groups[gi][0])]
+    if not issues and not waits:
+        return
+    hoisted = sum(1 for gi in issues if new_pos[gi] < gi)
+    sunk = sum(1 for gi in waits if new_pos[gi] > gi)
+    _decisions.record(
+        "comm", "comm_reorder", None, "scheduled",
+        reason=f"{hoisted} issue(s) hoisted, {sunk} wait(s) sunk",
+        cost={"hoisted_issues": hoisted, "sunk_waits": sunk,
+              "issues": len(issues), "waits": len(waits)})
+    for wg in waits:
+        src = None
+        for v in consumed_vars(groups[wg][0]):
+            src = produced_by.get(v)
+            if src is not None and _is_issue(groups[src][0]):
+                break
+            src = None
+        if src is None:
+            continue
+        _decisions.record(
+            "comm", groups[src][0].sym.name, None, "overlap_window",
+            reason=f"issue@{new_pos[src]} wait@{new_pos[wg]}",
+            cost={"issue_at": new_pos[src], "wait_at": new_pos[wg],
+                  "distance": new_pos[wg] - new_pos[src],
+                  "distance_before": wg - src})
 
 
 class CommReorderTransform(Transform):

@@ -517,6 +517,11 @@ class DistributedFunction(ThunderTPUFunction):
         entry.run_fn = run
         entry.jit_obj = jitted  # lowerable for tt.last_hlo
         entry.is_sharded = True
+        # mesh size for the census's ring-model recv bytes (observe.census
+        # divides collective payloads by the FULL mesh population)
+        entry.n_dev = 1
+        for s in self.mesh_spec.axis_sizes:
+            entry.n_dev *= int(s)
 
 
 # ---------------------------------------------------------------------------

@@ -254,21 +254,16 @@ def examine_torch(fn, *args, claims: bool = False, **kwargs) -> dict:
 def _compiled_entry(jfn):
     """The XLA-compiled executable of the most recent entry, memoized on the
     entry — a full model compile is seconds-to-minutes, so xla_memory +
-    xla_cost must share one."""
+    xla_cost must share ONE with the census and ``last_hlo`` (the shared
+    accessor in ``observe.census`` owns the memoization)."""
     import thunder_tpu as tt
+    from thunder_tpu.observe import census as _census
 
     entry = tt.compile_stats(jfn).last_entry
     if entry is None or entry.jit_obj is None or entry.input_avals is None:
         raise RuntimeError("no whole-program-jitted entry to analyze "
                            "(compile first; device-sync ops disable the outer jit)")
-    compiled = getattr(entry, "_examine_compiled", None)
-    if compiled is None:
-        compiled = entry.jit_obj.lower(*entry.input_avals).compile()
-        try:
-            entry._examine_compiled = compiled
-        except AttributeError:  # __slots__: cache beside the stats instead
-            pass
-    return compiled
+    return _census.compiled_for_entry(entry)
 
 
 def xla_memory(jfn) -> dict:

@@ -15,6 +15,11 @@ makes the compiler's *decisions* inspectable too:
 - an ALWAYS-ON bounded flight recorder — events, gauge moves, and span
   edges land in a fixed-size ring even when the registry is disabled, so
   a serving fault leaves a black box to read back (``flight.py``),
+- a per-compile EXECUTABLE CENSUS (``census.py``): what XLA actually
+  scheduled — collective instructions with ring-model recv bytes and
+  async fractions, launch/fusion counts, cost/memory analysis — plus a
+  pessimization sentinel diffing the HLO against the trace's expectation
+  (typed findings, ``compile.*``/``hlo.*`` gauges, budget gates),
 - exporters: JSONL, Chrome/Perfetto trace (with serving request/scheduler
   tracks and counter tracks), Prometheus text (``exporters.py``),
 - ``explain(jfn)`` — the human report: who executes each op, why fusions
@@ -32,6 +37,7 @@ Quick start::
 
 from __future__ import annotations
 
+from thunder_tpu.observe import census  # noqa: F401
 from thunder_tpu.observe import decisions  # noqa: F401
 from thunder_tpu.observe import flight  # noqa: F401
 from thunder_tpu.observe.exporters import (  # noqa: F401

@@ -9,6 +9,10 @@ Answers, from the last compilation of a ``thunder_tpu.jit`` function:
 - why each executor claim was accepted or rejected (checker, cost model,
   fuel),
 - where compile time went (per-pass walltimes),
+- what XLA actually compiled — the per-compile executable census
+  (``observe.census``): collective instructions with async fractions and
+  ring-model recv bytes, launch/fusion counts, cost/memory analysis, any
+  pessimization-sentinel findings, and the comm-reorder schedule report,
 - what a step is estimated to cost (liveness peak bytes, collective bytes),
   and
 - the serving request timeline — per-request queue/prefill/decode/TTFT
@@ -212,6 +216,80 @@ def explain(jfn) -> str:
         why = f": {reason}" if reason else ""
         mult = f"  x{n}" if n > 1 else ""
         lines.append(f"  {op} -> {decision}{who}{why}{mult}")
+
+    # -- compiled program (HLO census + pessimization sentinel) --------------
+    # the executable's OWN accounting — what XLA actually scheduled, not
+    # what the trace asked for. Lazy/memoized and guarded (observe.census):
+    # rendering this section can never fail or re-lower a compile.
+    lines.append("")
+    lines.append("== compiled program (HLO census) ==")
+    census = stats.last_census
+    if census is None:
+        lines.append("  (no compiled entry)")
+    else:
+        coll = census.get("collectives")
+        if census.get("hlo_unavailable"):
+            lines.append(f"  ({census['hlo_unavailable']})")
+        elif coll is None:
+            lines.append("  (executable analysis failed — see guarded "
+                         "errors below)")
+        else:
+            asyn = census["async"]
+            pk = coll["per_kind"]
+            if pk:
+                lines.append(
+                    f"  collectives: {asyn['count']} instruction(s), "
+                    f"{len(pk)} kind(s), "
+                    f"{coll['recv_bytes_per_device_total'] / 1e6:.2f} MB "
+                    f"recv/device (ring model, n_dev={census['n_dev']})")
+                for k in sorted(pk):
+                    e = pk[k]
+                    lines.append(
+                        f"    {k} x{e['count']} (async "
+                        f"{e['async_count']}/{e['count']}), "
+                        f"{e['recv_bytes_per_dev'] / 1e6:.2f} MB recv/dev")
+                lines.append(f"  async fraction: "
+                             f"{asyn['async']}/{asyn['count']} "
+                             f"({asyn['fraction']:.2f})")
+            else:
+                lines.append("  collectives: none (single-device program)")
+            lines.append(f"  hlo fusions: {census['hlo_fusions']}, "
+                         f"custom calls: {census['hlo_custom_calls']}; "
+                         f"trace: {census.get('pallas_launches', 0)} pallas "
+                         f"launch(es), {census.get('xla_regions', 0)} xla "
+                         f"region(s)")
+            lines.append(f"  xla flops: {census['xla_flops']:.4g}, "
+                         f"peak HBM (live): "
+                         f"{census['live_bytes'] / 1e6:.2f} MB")
+        if census.get("errors"):
+            lines.append(f"  guarded census errors: {len(census['errors'])} "
+                         f"(counted on compile.census_errors): "
+                         + "; ".join(str(e) for e in census["errors"]))
+        fnd = census.get("findings") or []
+        if fnd:
+            lines.append("  pessimizations:")
+            for f in fnd:
+                lines.append(f"    [{f['kind']}] {f['detail']}")
+        else:
+            lines.append("  pessimizations: none")
+
+    # -- comm reorder (sort_waits report) ------------------------------------
+    comm_dec = [d for d in decisions if d["kind"] == "comm"]
+    if comm_dec:
+        lines.append("")
+        lines.append("== comm reorder ==")
+        for d in comm_dec:
+            cost = d.get("cost") or {}
+            if d["op"] == "comm_reorder":
+                lines.append(f"  {d.get('reason', '')} "
+                             f"({cost.get('issues', 0)} issue(s), "
+                             f"{cost.get('waits', 0)} wait(s) total)")
+            else:
+                lines.append(
+                    f"  {d['op']}: issue@{cost.get('issue_at', '?')} -> "
+                    f"wait@{cost.get('wait_at', '?')} "
+                    f"(distance {cost.get('distance', '?')}, "
+                    f"was {cost.get('distance_before', '?')})")
 
     # -- numerics sentinel ---------------------------------------------------
     for tr in getattr(jfn, "transforms", ()):

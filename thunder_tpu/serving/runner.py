@@ -99,12 +99,20 @@ class PagedLlamaRunner:
     """Builds + owns the compiled paged step functions for one engine."""
 
     def __init__(self, cfg, geometry, *, n_layers: int | None = None,
-                 executors=None, block_fusion=None):
+                 executors=None, block_fusion=None,
+                 launch_budget_per_layer: float | None = None):
         import thunder_tpu as tt
 
         self.cfg = cfg
         self.geom = geometry
         self.n_layers = n_layers if n_layers is not None else cfg.n_layers
+        # decode-launch budget: when set (via census_context below), a
+        # decode program dispatching more Pallas launches per layer per
+        # token than the budget yields a typed `decode-launch-growth`
+        # pessimization finding whenever its census is evaluated
+        # (observe.census) — a megakernel falling back to its
+        # decomposition becomes a finding, not just a throughput regression
+        self.launch_budget_per_layer = launch_budget_per_layer
         # block planner passthrough: unset lets the decode cost model decide
         # (at T==1 serving shapes the launch-amortization objective plans the
         # whole-decode-layer megakernel whenever an executor claims it);
@@ -118,6 +126,14 @@ class PagedLlamaRunner:
         self.prefill_jit = tt.jit(self._prefill_fn, executors=executors,
                                   fn_name="serving_prefill", donate_argnums=(5,),
                                   **opts)
+        # census context: lets observe.census derive launches-per-layer and
+        # re-evaluate the decode-launch-growth finding whenever the decode
+        # program's census is taken (explain(), postmortems), not only at
+        # the bind-time publication below
+        self.decode_jit._stats.census_context = {
+            "decode_layers": self.n_layers,
+            "decode_launches_per_layer_max": launch_budget_per_layer,
+        }
 
     # -- traced bodies ------------------------------------------------------
     def _attn_block(self, h, layer, q, block_tables, lengths, pools_kv):
@@ -234,12 +250,15 @@ class PagedLlamaRunner:
 
     def _publish_decode_fusion_shape(self) -> None:
         """Gauges describing the compiled decode step's per-token launch
-        shape, read from the execution trace's executor assignments (NOT
-        from trace-source grepping): how many Pallas launches one decode
-        step dispatches, and how many of them are whole-decode-layer
-        megakernels. ``bench_serve.py`` stamps both; the fusion-shape
-        acceptance test reads launches-per-layer from them."""
+        shape, fed from the SAME census walk the per-compile observe
+        surface uses (``observe.census.trace_census`` — one owner, so the
+        serving gauges and ``CompileStats.last_census`` can never disagree):
+        how many Pallas launches one decode step dispatches, and how many
+        of them are whole-decode-layer megakernels. ``bench_serve.py``
+        stamps both; the fusion-shape acceptance test reads
+        launches-per-layer from them."""
         import thunder_tpu as tt
+        from thunder_tpu.observe import census as _census
         from thunder_tpu.observe import registry as _observe
 
         try:
@@ -248,27 +267,18 @@ class PagedLlamaRunner:
             return
         if trc is None:
             return
-        launches = 0
-        layers = 0
-
-        def walk(bsyms):
-            nonlocal launches, layers
-            for b in bsyms:
-                ex = b.sym.executor
-                if ex is not None and ex.name == "pallas":
-                    # one claimed kernel = one launch; its subsymbols are
-                    # the decomposition (never dispatched), don't recurse
-                    launches += 1
-                    if b.sym.name == "decode_layer":
-                        layers += 1
-                    continue
-                # XLA regions ABSORB claimed pallas calls (Fusion 2.0);
-                # the launches live one level down
-                walk(b.subsymbols)
-
-        walk(trc.bound_symbols)
+        tc = _census.trace_census(trc)
+        launches = tc["pallas_launches"]
+        layers = tc["decode_layer_fusions"]
         _observe.set_gauge("serving.decode_pallas_launches", launches)
         _observe.set_gauge("serving.decode_layer_fusions", layers)
+        # launch-budget enforcement lives in the census (the census_context
+        # stashed at construction): the decode-launch-growth finding is
+        # derived — ONCE — whenever the decode program's census is
+        # evaluated (explain, postmortems, budget tests), while the
+        # serving_decode_bind event below already lands the launch shape
+        # in the flight ring at bind time. Recording the finding here too
+        # would double-count compile.pessimizations for one condition.
         # lifecycle edge for the flight ring: WHICH program shape is now
         # serving (a postmortem wants to know if the megakernel or a
         # fallback rung was bound when the fault hit)

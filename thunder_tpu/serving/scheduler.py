@@ -203,7 +203,8 @@ class ServingEngine:
                  prefill_chunk: int | None = None, n_layers: int | None = None,
                  max_queue: int | None = None, executors=None,
                  retry_policy=None, block_fusion=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 launch_budget_per_layer: float | None = None):
         self.params = params
         self.cfg = cfg
         n_layers_eff = n_layers if n_layers is not None else cfg.n_layers
@@ -240,9 +241,10 @@ class ServingEngine:
         # cross-request prefix cache (opt-in): completed prompts donate
         # their full pages into a token trie; admission probes it
         self.prefix = PrefixCache(self.cache) if prefix_cache else None
-        self.runner = PagedLlamaRunner(cfg, geometry, n_layers=n_layers,
-                                       executors=executors,
-                                       block_fusion=block_fusion)
+        self.runner = PagedLlamaRunner(
+            cfg, geometry, n_layers=n_layers, executors=executors,
+            block_fusion=block_fusion,
+            launch_budget_per_layer=launch_budget_per_layer)
         self.max_slots = int(max_slots)
         self.max_queue = max_queue
         self.slots: list[Request | None] = [None] * self.max_slots
