@@ -45,6 +45,10 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 9: bench.py stamps the overlap-scheduling pass's outcome
+# (overlap_scheduled_collectives / comm_buckets / modeled_overlap_us from
+# the compile's comm decisions — all zero on a single-chip bench, where the
+# pass has nothing to schedule);
 # 8: bench.py stamps the compiled-program census (census_* fields from
 # observe.census: HLO collective instructions, async fraction, fusion
 # instructions, flops, peak live HBM, sentinel findings) and bench_serve
@@ -60,7 +64,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 8
+METRICS_SCHEMA = 9
 
 
 def main():
@@ -388,6 +392,17 @@ def main():
           f"{int(cens.get('census_errors', 0))} guarded error(s)",
           file=sys.stderr)
 
+    # schema-9 overlap-scheduling outcome: what the comm_reorder pass did to
+    # THIS compile (zeros on a single-chip bench — no collectives to place)
+    comm_decs = [d for d in (tt.compile_stats(jstep).last_decisions or [])
+                 if d.get("kind") == "comm"]
+    overlap_windows = [d for d in comm_decs
+                       if d.get("decision") == "overlap_window"]
+    comm_buckets = sum(1 for d in comm_decs if d.get("decision") == "bucketed")
+    modeled_overlap_us = round(sum(
+        float((d.get("cost") or {}).get("overlap_us", 0.0))
+        for d in overlap_windows), 3)
+
     tokens_per_sec = batch * seq / t_ours
     fpt = llama.flops_per_token(cfg, seq, n_layers)
     # v5e ≈ 197 TFLOP/s bf16, v5p ≈ 459
@@ -429,6 +444,10 @@ def main():
         "census_errors": int(cens.get("census_errors", 0)),
         "census_pessimizations": sorted(
             {f["kind"] for f in (cens.get("findings") or [])}),
+        # schema-9 overlap-scheduling outcome (distributed/comm_reorder)
+        "overlap_scheduled_collectives": len(overlap_windows),
+        "comm_buckets": comm_buckets,
+        "modeled_overlap_us": modeled_overlap_us,
     }))
 
 

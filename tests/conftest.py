@@ -17,6 +17,25 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Suite wall-time is dominated by XLA compiles of near-identical tiny
+# programs; the persistent executable cache dedups them within one run and
+# removes them entirely on warm reruns. Only the jax config is set here —
+# NOT thunder_tpu.enable_compilation_cache(), which would also redirect the
+# kernel-quarantine set that tests configure per-tmpdir. An operator's
+# THUNDER_TPU_COMPILATION_CACHE (honored at thunder_tpu import) wins.
+if not os.environ.get("THUNDER_TPU_COMPILATION_CACHE"):
+    _cache_dir = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir, ".pytest_xla_cache"))
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    for _opt in ("jax_persistent_cache_min_compile_time_secs",
+                 "jax_compilation_cache_min_compile_time_secs"):
+        try:
+            jax.config.update(_opt, 1.0)
+            break
+        except AttributeError:
+            continue
+
 import pytest  # noqa: E402
 
 
@@ -81,5 +100,40 @@ def fsdp_smoke_step():
     tokens = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
     targets = np.roll(tokens, -1, 1).astype(np.int32)
     jstep = fsdp(train_step, MeshSpec.make(fsdp=8), zero=2)
+    entry = jstep.compile(params, opt.init(params), tokens, targets)
+    return jstep, entry
+
+
+@pytest.fixture(scope="session")
+def fsdp_overlap_step():
+    """The SAME tiny fsdp zero-2 smoke config compiled WITH the
+    overlap-scheduling pass (``comm_reorder=True``): decomposed forward
+    gathers, bucketed sub-threshold collectives, cost-aware schedule.
+    Shared by test_overlap's schedule/determinism tests and test_census's
+    overlap budget gate. Returns (jstep, entry)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import numpy as np
+
+    import thunder_tpu as tt
+    from thunder_tpu.core.devices import MeshSpec
+    from thunder_tpu.distributed import fsdp
+    from thunder_tpu.models import llama
+    from thunder_tpu.optim import AdamW
+
+    cfg = llama.CONFIGS["tiny"]
+    opt = AdamW(lr=1e-4)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = tt.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg))(params)
+        new_p, new_s = opt.update(params, grads, opt_state)
+        return loss, new_p, new_s
+
+    params = llama.init_params(cfg, seed=0, scale_layers=2)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    jstep = fsdp(train_step, MeshSpec.make(fsdp=8), zero=2, comm_reorder=True)
     entry = jstep.compile(params, opt.init(params), tokens, targets)
     return jstep, entry

@@ -278,6 +278,57 @@ class TestFsdpSmokeCensus:
 
 
 # ---------------------------------------------------------------------------
+# the overlap-scheduled smoke: bucketed counts, quiet sentinel, budget gate
+# ---------------------------------------------------------------------------
+
+class TestOverlapSmokeCensus:
+    def test_overlap_census_within_committed_budget(self, fsdp_overlap_step):
+        """The overlap-scheduling pass's regression gate: the comm_reorder
+        compile drifting outside its committed CENSUS_BUDGETS.json bounds
+        (counts, async fraction, recv bytes, recv-vs-trace ratio — BOTH
+        directions) fails tier-1."""
+        jstep, _ = fsdp_overlap_step
+        budget = _budgets()["tiny-fsdp-cpu8-zero2-overlap"]
+        violations = census.check_budget(tt.hlo_census(jstep), budget)
+        assert not violations, violations
+
+    def test_overlap_pass_quiets_the_sentinel(self, fsdp_overlap_step):
+        """Acceptance: with the pinned lowering + bucketing, the zero-2 CPU
+        smoke compiles with ZERO pessimization findings (in particular no
+        reduce-scatter-rewritten) and the HLO recv bytes/device EQUAL the
+        trace ring-model expectation — the r5 2.2x gap closed at the
+        per-compile level."""
+        jstep, _ = fsdp_overlap_step
+        c = tt.hlo_census(jstep)
+        assert c["hlo_unavailable"] is None
+        assert c["findings"] == []
+        got = c["collectives"]["recv_bytes_per_device_total"]
+        exp = c["expected_recv_bytes_per_device"]
+        assert exp > 0 and got <= 1.1 * exp
+        # bucketing collapsed the 21+21 small collectives to one fused pair
+        per_kind = c["collectives"]["per_kind"]
+        assert per_kind["all-gather"]["count"] < 21
+        assert per_kind["reduce-scatter"]["count"] < 21
+        assert c["expected_collectives"].get("bucketed_all_gather", 0) >= 1
+        assert c["expected_collectives"].get("bucketed_reduce_scatter", 0) >= 1
+
+    def test_new_budget_keys_are_live(self, fsdp_overlap_step):
+        """The schema additions bite (the gate above is not a tautology):
+        each new key reports a violation when set to a bound this compile
+        cannot meet."""
+        jstep, _ = fsdp_overlap_step
+        c = tt.hlo_census(jstep)
+        assert census.check_budget(c, {"recv_bytes_per_device_min": 10**12})
+        assert census.check_budget(c, {"recv_vs_trace_ratio_max": 0.5})
+        # async ceiling on a synthetic half-async census
+        half = {"async": {"async": 1, "count": 2, "fraction": 0.5},
+                "collectives": {"per_kind": {},
+                                "recv_bytes_per_device_total": 0}}
+        assert census.check_budget(half, {"async_fraction_max": 0.4})
+        assert not census.check_budget(half, {"async_fraction_max": 0.5})
+
+
+# ---------------------------------------------------------------------------
 # guarded errors: the census can never fail (or re-lower) a compile
 # ---------------------------------------------------------------------------
 

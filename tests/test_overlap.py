@@ -129,8 +129,25 @@ class TestCommReport:
         assert rep["comm"]["collectives"] == {}  # single-device: no comm
 
 
+@pytest.fixture(scope="module")
+def reorder_tiny_step():
+    """ONE 1-layer comm_reorder=True compile shared by every test in this
+    module that only reads its traces/decisions (compiles dominate suite
+    wall-time; don't repeat them per test)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    cfg = llama.CONFIGS["tiny"]
+    opt, args = _args(cfg, n_layers=1)
+    jstep = fsdp(_step_fn(cfg, opt), MeshSpec.make(fsdp=8),
+                 comm_reorder=True)
+    jstep.compile(*args)
+    return jstep
+
+
 class TestCommReorderReport:
-    def test_sort_waits_reports_what_it_did(self, eight_devices):
+    def test_sort_waits_reports_what_it_did(self, reorder_tiny_step):
         """The comm_reorder pass records its schedule as decisions: a
         summary (hoisted-issue / sunk-wait counts) plus one
         ``overlap_window`` record per collective with the issue→wait
@@ -138,11 +155,7 @@ class TestCommReorderReport:
         is judged against — and explain() renders the section."""
         from thunder_tpu import observe
 
-        cfg = llama.CONFIGS["tiny"]
-        opt, args = _args(cfg, n_layers=1)
-        jstep = fsdp(_step_fn(cfg, opt), MeshSpec.make(fsdp=8),
-                     comm_reorder=True)
-        jstep.compile(*args)
+        jstep = reorder_tiny_step
         decs = [d for d in tt.compile_stats(jstep).last_decisions
                 if d["kind"] == "comm"]
         assert decs, "comm_reorder recorded no decisions"
@@ -173,6 +186,165 @@ class TestCommReorderReport:
         jfn = tt.jit(lambda a, b: matmul(a, b))
         jfn(np.ones((4, 5), np.float32), np.ones((5, 3), np.float32))
         assert "== comm reorder ==" not in observe.explain(jfn)
+
+
+def _collective_issue_order(trc) -> list[str]:
+    """Collective issue sequence of a trace (recursing into fusions): the
+    thing every SPMD rank must agree on."""
+    from thunder_tpu.distributed.comm_reorder import _is_issue
+
+    names: list[str] = []
+
+    def walk(bsyms):
+        for b in bsyms:
+            if _is_issue(b):
+                names.append(b.sym.name)
+                continue
+            walk(b.subsymbols)
+
+    walk(trc.bound_symbols)
+    return names
+
+
+class TestOverlapScheduling:
+    def test_issue_order_is_rank_deterministic(self, reorder_tiny_step,
+                                               eight_devices):
+        """The no-deadlock property: two independent compiles of the same
+        program (what every rank of an SPMD job does) schedule the SAME
+        collective issue order under hoisting + bucketing — the scheduler
+        takes no clock, hash-order, or id() input. Rank 0 is the shared
+        module compile; rank 1 is a fresh wrapper over fresh proxies."""
+        cfg = llama.CONFIGS["tiny"]
+        orders = [_collective_issue_order(
+            tt.last_execution_trace(reorder_tiny_step))]
+        opt, args = _args(cfg, n_layers=1)
+        jstep = fsdp(_step_fn(cfg, opt), MeshSpec.make(fsdp=8),
+                     comm_reorder=True)
+        jstep.compile(*args)
+        orders.append(_collective_issue_order(
+            tt.last_execution_trace(jstep)))
+        assert orders[0], "no collective issues in the scheduled trace"
+        assert orders[0] == orders[1]
+
+    def test_sort_waits_is_deterministic_and_order_preserving(
+            self, reorder_tiny_step):
+        """Property test on the pass itself: scheduling the same trace twice
+        yields the identical bsym sequence; every collective issue survives
+        the reschedule; and SAME-KIND issues never pass each other (they
+        contend on one channel — cross-kind hoisting past each other is the
+        pass doing its job). The input is the shared compile's PRE-pass
+        trace (the stage comm_reorder actually runs at — it still carries
+        the fused ``synchronize`` ops)."""
+        from thunder_tpu.distributed.comm_reorder import (
+            _is_issue, bucket_collectives, decompose_collectives, sort_waits)
+
+        trc = next(t for t in tt.last_traces(reorder_tiny_step)
+                   if any(b.sym.name == "synchronize" for b in t.bound_symbols))
+        pre = bucket_collectives(decompose_collectives(trc), n_dev=8)
+        s1 = sort_waits(pre, n_dev=8)
+        s2 = sort_waits(pre, n_dev=8)
+        assert [b.sym.name for b in s1.bound_symbols] \
+            == [b.sym.name for b in s2.bound_symbols]
+
+        def issue_ids(t):
+            ids = []
+
+            def walk(bs):
+                for b in bs:
+                    if _is_issue(b):
+                        ids.append((b.sym.name, str(b.output)))
+                        continue
+                    walk(b.subsymbols)
+
+            walk(t.bound_symbols)
+            return ids
+
+        pi, si = issue_ids(pre), issue_ids(s1)
+        assert pi, "no collective issues in the pre-pass trace"
+        assert sorted(pi) == sorted(si)  # nothing dropped or duplicated
+        for kind in {k for k, _ in pi}:
+            assert [o for k, o in pi if k == kind] \
+                == [o for k, o in si if k == kind], kind
+
+    def test_no_use_after_del_in_scheduled_trace(self, fsdp_overlap_step):
+        """Del/comment pinning regression: after the reschedule, no variable
+        is consumed by a real op at a position later than its `del` —
+        the del-after-consumer edges must survive hoisting and sinking."""
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.core.utils import consumed_vars
+
+        jstep, _ = fsdp_overlap_step
+        trc = tt.last_execution_trace(jstep)
+        del_at: dict = {}
+        for i, b in enumerate(trc.bound_symbols):
+            if b.sym.id is PrimIDs.PYTHON_DEL:
+                for v in consumed_vars(b):
+                    del_at[v] = i
+        for i, b in enumerate(trc.bound_symbols):
+            if b.sym.id is PrimIDs.PYTHON_DEL:
+                continue
+            for v in consumed_vars(b):
+                assert del_at.get(v, len(trc.bound_symbols)) >= i, \
+                    f"{b.sym.name}@{i} consumes a var deleted at {del_at[v]}"
+
+    def test_cycle_bails_out_with_typed_decision(self):
+        """A malformed (cyclic) trace must not hang or half-schedule: the
+        pass returns the input trace unchanged and records a typed `comm`
+        bailout decision, which explain() renders as a BAILOUT line."""
+        from thunder_tpu import observe, ops
+        from thunder_tpu.core.proxies import Variable
+        from thunder_tpu.core.trace import from_trace
+        from thunder_tpu.distributed.comm_reorder import sort_waits
+        from thunder_tpu.observe import decisions as _decisions
+
+        jfn = tt.jit(lambda a, b: ops.add(ops.add(a, b), b))
+        jfn(np.ones((3,), np.float32), np.ones((3,), np.float32))
+        trc = tt.last_traces(jfn)[0]  # pre-fusion: the adds are visible
+        adds = [b for b in trc.bound_symbols if b.sym.name == "add"]
+        assert len(adds) == 2
+        b1, b2 = adds  # b2 consumes b1's output
+        ret = [b for b in trc.bound_symbols
+               if b.sym.name not in ("add",)][-1:]
+        # rewire b1 to consume b2's output: a dependency cycle
+        b1c = b1.from_bsym_swap_proxies(
+            {Variable(b1.args[1]): b2.output}, skip_output=True)
+        cyc = from_trace(trc)
+        cyc.bound_symbols = [b1c, b2] + ret
+        with _decisions.collect() as decs:
+            out = sort_waits(cyc)
+        assert out is cyc, "cyclic trace must be returned unscheduled"
+        bail = [d for d in decs
+                if d["kind"] == "comm" and d["decision"] == "bailout"]
+        assert len(bail) == 1
+        assert "cycle" in bail[0]["reason"]
+        assert bail[0]["cost"]["scheduled"] < bail[0]["cost"]["groups"]
+        # the renderer surfaces it (inject into a real compile's log)
+        tt.compile_stats(jfn).last_decisions.append(bail[0])
+        assert "BAILOUT: " in observe.explain(jfn)
+
+    def test_bucketing_reduces_collective_count(self, fsdp_overlap_step,
+                                                eight_devices):
+        """Acceptance: on the small-param smoke config the fused buckets
+        replace the per-param collectives — strictly fewer collective
+        issues than the unbucketed zero-2 trace (21 gathers + 21 scatters
+        + 2 all-reduces), with the bucketed pair present."""
+        from thunder_tpu.examine import comm_report
+
+        jstep, _ = fsdp_overlap_step
+        rep = comm_report(jstep)
+        names = set(rep["collectives"])
+        assert "bucketed_all_gather" in names
+        assert "bucketed_reduce_scatter" in names
+        n_issues = sum(e["count"] for e in rep["collectives"].values())
+        assert n_issues < 44, rep["collectives"]
+        # bucket verdicts are on the decision log
+        decs = [d for d in tt.compile_stats(jstep).last_decisions
+                if d["kind"] == "comm" and d["decision"] == "bucketed"]
+        assert len(decs) >= 2
+        for d in decs:
+            assert d["cost"]["members"] >= 2
+            assert d["cost"]["saved_issues"] == d["cost"]["members"] - 1
+            assert "dtype" in d["cost"] and "mesh_axis" in d["cost"]
 
 
 @pytest.fixture

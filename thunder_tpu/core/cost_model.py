@@ -224,6 +224,73 @@ def fused_adamw_cost(n_tensors: int, total_bytes: int,
     return cost
 
 
+# --- collective overlap model ----------------------------------------------
+# Ring-model transfer times and the in-flight buffer budget consumed by the
+# overlap-scheduling pass (distributed/comm_reorder.py). The byte formulas
+# are the SAME ring model observe.census applies to the optimized HLO, so a
+# modeled overlap window and the census's recv-byte gauges agree on what a
+# collective costs.
+ICI_BW_BYTES_PER_S = 9e10        # v5p per-axis ICI bandwidth (benchmarks/northstar.py)
+COLLECTIVE_LAUNCH_US = 5.0       # per-collective issue overhead (dispatch + ring setup)
+COLLECTIVE_INFLIGHT_CAP_BYTES = 64 * 1024 * 1024  # outstanding-future buffer budget
+COMM_BUCKET_MIN_BYTES = 1 << 20  # collectives below this coalesce (per member)
+COMM_BUCKET_MAX_BYTES = 16 << 20  # one fused bucket never exceeds this payload
+
+# peak FLOPs per µs and HBM bytes per µs, for per-op compute-time estimates
+_FLOPS_PER_US = TPU_PEAK_FLOPS / 1e6
+_HBM_BYTES_PER_US = ADAMW_HBM_GBPS * 1e3
+
+
+def bsym_us(bsym: BoundSymbol) -> float:
+    """Modeled execution time of one bound symbol in µs: the roofline max of
+    its FLOP time (peak matmul rate) and its HBM time (nominal bandwidth).
+    Coarse on purpose — the overlap scheduler only needs to rank how much
+    compute fits inside a collective's transfer window."""
+    flops, nbytes = bsym_cost(bsym)
+    return max(flops / _FLOPS_PER_US, nbytes / _HBM_BYTES_PER_US)
+
+
+# ring-model bytes received per device, keyed by the trace-level prim name
+# (census.hlo_collectives applies the same formulas to HLO instruction kinds)
+def ring_recv_bytes(kind: str, out_bytes: int, n_dev: int) -> int:
+    if n_dev <= 1:
+        return 0
+    if kind in ("all_gather", "bucketed_all_gather", "synchronize", "regather"):
+        return out_bytes * (n_dev - 1) // n_dev
+    if kind in ("reduce_scatter", "bucketed_reduce_scatter"):
+        return out_bytes * (n_dev - 1)
+    if kind == "all_reduce":
+        return 2 * out_bytes * (n_dev - 1) // n_dev
+    if kind == "ppermute":
+        return out_bytes
+    return out_bytes * (n_dev - 1) // n_dev  # all_to_all and friends
+
+
+def collective_transfer_us(kind: str, out_bytes: int, n_dev: int,
+                           ici_bw: float = ICI_BW_BYTES_PER_S) -> float:
+    """Modeled ICI transfer time of one collective in µs (ring recv bytes
+    over one axis's bandwidth) plus the fixed issue overhead."""
+    recv = ring_recv_bytes(kind, out_bytes, n_dev)
+    return COLLECTIVE_LAUNCH_US + recv / ici_bw * 1e6
+
+
+def comm_bucket_cost(kind: str, member_bytes: list[int], n_dev: int,
+                     ici_bw: float = ICI_BW_BYTES_PER_S) -> dict:
+    """Byte model for coalescing k sub-threshold collectives into one fused
+    issue/wait pair: the ring transfer is linear in bytes, so fusing saves
+    (k-1) issue overheads while moving the same payload. Returned dict feeds
+    the bucket-verdict decision records (same ``est_*_us`` convention as
+    ``fused_adamw_cost``)."""
+    k = len(member_bytes)
+    total = sum(member_bytes)
+    unfused = sum(collective_transfer_us(kind, b, n_dev, ici_bw) for b in member_bytes)
+    fused = collective_transfer_us(kind, total, n_dev, ici_bw)
+    return {"members": k, "bucket_bytes": total,
+            "saved_issues": max(k - 1, 0),
+            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+            "est_saved_us": round(unfused - fused, 3)}
+
+
 def fused_adamw_profitable(n_tensors: int, total_bytes: int) -> bool:
     """Fuse a bucket of n per-parameter AdamW chains into one multi-tensor
     launch? Singleton buckets never fuse (nothing to amortize); for the rest

@@ -39,6 +39,40 @@ class DistPrimIDs(Enum):
     SYNCHRONIZE_TP_OUTPUT = auto()
     SYNCHRONIZE_TP_INPUT = auto()
     AXIS_INDEX = auto()
+    BUCKETED_ALL_GATHER = auto()
+    BUCKETED_REDUCE_SCATTER = auto()
+    BUCKET_UNPACK_GATHER = auto()
+    BUCKET_UNPACK_SCATTER = auto()
+
+
+# ---------------------------------------------------------------------------
+# pinned lowering switch
+# ---------------------------------------------------------------------------
+
+# NORTHSTAR r5 measured XLA rewriting zero-2's reduce-scatters into
+# all-reduces on the v5p AOT path (per-chip comm 2.2x the trace-level bytes).
+# The pinned lowering feeds each sharded collective through
+# ``jax.lax.optimization_barrier`` — the same pin ``regather`` uses against
+# CSE — so the collective the trace scheduled is the collective XLA emits.
+# Default ON; ``pin_collectives(False)`` is the A/B escape hatch for the
+# on-chip measurement queued in ONCHIP_AB.md. The census's
+# ``reduce-scatter-rewritten`` finding verifies the pin per compile.
+_PIN_STATE = {"enabled": True}
+
+
+def pin_collectives(enabled: bool | None = None) -> bool:
+    """Get (no arg) or set the pinned-collective-lowering switch; returns the
+    previous value when setting."""
+    prev = _PIN_STATE["enabled"]
+    if enabled is not None:
+        _PIN_STATE["enabled"] = bool(enabled)
+    return prev
+
+
+def _pin(a):
+    if _PIN_STATE["enabled"]:
+        return jax.lax.optimization_barrier(a)
+    return a
 
 
 # ---------------------------------------------------------------------------
@@ -100,6 +134,60 @@ def _all_to_all_meta(a: TensorProxy, axis: str, split_dim: int, concat_dim: int,
 
 all_to_all = make_prim(DistPrimIDs.ALL_TO_ALL, "all_to_all", _all_to_all_meta,
                        tags=(OpTags.COLLECTIVE_OP,))
+
+
+# bucketed collectives: the overlap-scheduling pass coalesces sub-threshold
+# same-(dtype, mesh-axis) collectives into ONE fused issue/wait pair
+# (distributed/comm_reorder.bucket_collectives). Layout contracts:
+#   bucketed_all_gather(axis, size, *shards) -> future[(size, sum numel_i)]
+#     — each member arrives raveled and concatenated; row d holds device d's
+#       members back to back.
+#   bucketed_reduce_scatter(axis, size, *grads) -> future[(sum numel_i/size,)]
+#     — each member reshaped (size, -1) and concatenated on dim 1; the
+#       scatter leaves this device's shards back to back.
+# ``bucket_unpack_gather/scatter`` slice one member back out (static offset).
+
+def _bucketed_all_gather_meta(axis: str, size: int, *shards) -> FutureTensorProxy:
+    total = 0
+    for s in shards:
+        n = 1
+        for d in s.shape:
+            n *= int(d)
+        total += n
+    return FutureTensorProxy(shards[0], shape=(size, total))
+
+
+bucketed_all_gather = make_prim(DistPrimIDs.BUCKETED_ALL_GATHER, "bucketed_all_gather",
+                                _bucketed_all_gather_meta, tags=(OpTags.COLLECTIVE_OP,))
+
+
+def _bucketed_reduce_scatter_meta(axis: str, size: int, *grads) -> FutureTensorProxy:
+    total = 0
+    for g in grads:
+        check(g.shape[0] % size == 0,
+              lambda: f"bucketed_reduce_scatter: dim 0 ({g.shape[0]}) not divisible by {size}")
+        n = 1
+        for d in g.shape:
+            n *= int(d)
+        total += n // size
+    return FutureTensorProxy(grads[0], shape=(total,))
+
+
+bucketed_reduce_scatter = make_prim(DistPrimIDs.BUCKETED_REDUCE_SCATTER,
+                                    "bucketed_reduce_scatter",
+                                    _bucketed_reduce_scatter_meta,
+                                    tags=(OpTags.COLLECTIVE_OP,))
+
+
+def _bucket_unpack_gather_meta(buf: TensorProxy, offset: int, shape: tuple) -> TensorProxy:
+    return TensorProxy(shape=tuple(shape), dtype=buf.dtype, device=buf.device)
+
+
+bucket_unpack_gather = make_prim(DistPrimIDs.BUCKET_UNPACK_GATHER, "bucket_unpack_gather",
+                                 _bucket_unpack_gather_meta)
+
+bucket_unpack_scatter = make_prim(DistPrimIDs.BUCKET_UNPACK_SCATTER, "bucket_unpack_scatter",
+                                  _bucket_unpack_gather_meta)
 
 
 def _wait_meta(f: FutureTensorProxy) -> TensorProxy:
@@ -188,7 +276,10 @@ def _collective_faults(fn):
 @impl(DistPrimIDs.ALL_GATHER)
 @_collective_faults
 def _all_gather_impl(a, axis, dim, size):
-    return jax.lax.all_gather(a, axis, axis=dim, tiled=True)
+    # pinned: the barrier keeps the gather where the trace scheduled it
+    # (XLA CSE/motion would otherwise re-plan the issue point the overlap
+    # pass chose — the same failure mode regather pins against)
+    return jax.lax.all_gather(_pin(a), axis, axis=dim, tiled=True)
 
 
 @impl(DistPrimIDs.ALL_REDUCE)
@@ -208,7 +299,48 @@ def _all_reduce_impl(a, axis, op="sum"):
 @impl(DistPrimIDs.REDUCE_SCATTER)
 @_collective_faults
 def _reduce_scatter_impl(a, axis, dim, size):
-    return jax.lax.psum_scatter(a, axis, scatter_dimension=dim, tiled=True)
+    # pinned against the NORTHSTAR r5 pessimization: on the v5p AOT path XLA
+    # rewrote these grad reduce-scatters into all-reduces (~2x the bytes per
+    # grad reduction). The barrier blocks the pattern rewrite/motion across
+    # the operand, so the psum_scatter survives as an HLO reduce-scatter —
+    # verified per compile by the census's ``reduce-scatter-rewritten``
+    # finding staying quiet.
+    return jax.lax.psum_scatter(_pin(a), axis, scatter_dimension=dim, tiled=True)
+
+
+@impl(DistPrimIDs.BUCKETED_ALL_GATHER)
+@_collective_faults
+def _bucketed_all_gather_impl(axis, size, *shards):
+    cat = jax.numpy.concatenate([jax.numpy.ravel(s) for s in shards])
+    return jax.lax.all_gather(_pin(cat), axis, axis=0, tiled=False)
+
+
+@impl(DistPrimIDs.BUCKETED_REDUCE_SCATTER)
+@_collective_faults
+def _bucketed_reduce_scatter_impl(axis, size, *grads):
+    cat = jax.numpy.concatenate(
+        [jax.numpy.reshape(g, (size, -1)) for g in grads], axis=1)
+    return jax.lax.psum_scatter(_pin(cat), axis, scatter_dimension=0, tiled=False)
+
+
+@impl(DistPrimIDs.BUCKET_UNPACK_GATHER)
+def _bucket_unpack_gather_impl(buf, offset, shape):
+    # buf: (n_dev, total_local); the member occupies a contiguous run of each
+    # row; stacking the rows on dim 0 reproduces the tiled all_gather layout
+    n = buf.shape[0]
+    numel = 1
+    for d in shape:
+        numel *= int(d)
+    seg = buf[:, offset:offset + numel // n]
+    return jax.numpy.reshape(seg, tuple(shape))
+
+
+@impl(DistPrimIDs.BUCKET_UNPACK_SCATTER)
+def _bucket_unpack_scatter_impl(buf, offset, shape):
+    numel = 1
+    for d in shape:
+        numel *= int(d)
+    return jax.numpy.reshape(buf[offset:offset + numel], tuple(shape))
 
 
 @impl(DistPrimIDs.BROADCAST)

@@ -706,6 +706,25 @@ def _dist_rule(sid, bsym, tas, name, fuzzy):
             return SpecInfo(_drop_axis_all(sa.dims, axis), sa.partial,
                             sa.varying - {axis, "?"})
         return sa  # replicated-family synchronize: identity layout
+    if sid is DistPrimIDs.BUCKETED_ALL_GATHER:
+        # fused gather of many small shards: the (size, total) buffer is
+        # identical on every rank of the axis after the wait
+        axis = bsym.args[0]
+        return SpecInfo((None, None), sa.partial, sa.varying - {axis, "?"})
+    if sid is DistPrimIDs.BUCKETED_REDUCE_SCATTER:
+        # fused psum_scatter of many small grads: reduces over the axis and
+        # leaves each rank its flat chunk — dim 0 of the buffer is sharded
+        axis = bsym.args[0]
+        return SpecInfo((_add_axis(None, axis, name),), sa.partial - {axis},
+                        sa.varying - {axis, "?"})
+    if sid in (DistPrimIDs.BUCKET_UNPACK_GATHER, DistPrimIDs.BUCKET_UNPACK_SCATTER):
+        # slice+reshape out of a waited bucket buffer: a gather bucket is
+        # replicated (all dims free); a scatter bucket keeps its dim-0
+        # sharding, which the unpacked member shard inherits on ITS dim 0
+        rank = len(bsym.output.shape)
+        lead = sa.dims[0] if sid is DistPrimIDs.BUCKET_UNPACK_SCATTER else None
+        dims = ((lead,) + (None,) * (rank - 1)) if rank else ()
+        return SpecInfo(dims, sa.partial, sa.varying)
     if sid is DistPrimIDs.SYNCHRONIZE_TP_OUTPUT:
         axis = bsym.args[1]
         return SpecInfo(sa.dims, sa.partial - {axis}, sa.varying)

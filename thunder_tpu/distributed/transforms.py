@@ -119,13 +119,20 @@ class DistributedFunction(ThunderTPUFunction):
               "(leaf plans and shard specs are built per concrete call)")
         if mode in ("fsdp", "hsdp", "fsdp_tp") and zero == 3:
             jit_kwargs["transforms"] = tuple(jit_kwargs.get("transforms", ())) + (_Zero3Transform(),)
-        if jit_kwargs.pop("comm_reorder", False):
-            # manual comm scheduling (the sort_waits escape hatch) when XLA's
-            # async-collective overlap underdelivers
+        comm_reorder = jit_kwargs.pop("comm_reorder", False)
+        if comm_reorder:
+            # the overlap-scheduling pass (decompose sync gathers, bucket
+            # sub-threshold collectives, cost-aware issue hoist / wait sink)
+            # for when XLA's async-collective overlap underdelivers. Pass
+            # True for defaults or a dict of CommReorderTransform options
+            # (bucket_bytes, inflight_cap_bytes, ici_bw, ...); the mesh's
+            # collective-axis size feeds the ring model unless overridden.
             from thunder_tpu.distributed.comm_reorder import CommReorderTransform
 
+            opts = dict(comm_reorder) if isinstance(comm_reorder, dict) else {}
+            opts.setdefault("n_dev", self.size)
             jit_kwargs["transforms"] = tuple(jit_kwargs.get("transforms", ())) \
-                + (CommReorderTransform(),)
+                + (CommReorderTransform(**opts),)
         super().__init__(wrapped, **jit_kwargs)
         self._orig_fn = fn
 

@@ -65,11 +65,14 @@ def examine(fn, *args, executors=None, run: bool = False, **kwargs) -> dict:
 
 
 # collective symbols emitted by the distributed transforms (synchronize /
-# regather decompose to all_gather at execution; both layers are counted)
+# regather decompose to all_gather at execution; both layers are counted).
+# The bucketed_* fused pairs the overlap-scheduling pass emits are
+# collectives too — omitting them would zero the census's trace-level
+# expectation and silently disarm the pessimization sentinel.
 _COLLECTIVE_NAMES = frozenset((
     "all_gather", "all_reduce", "reduce_scatter", "broadcast", "ppermute",
     "all_to_all", "synchronize", "regather", "synchronize_tp_output",
-    "synchronize_tp_input",
+    "synchronize_tp_input", "bucketed_all_gather", "bucketed_reduce_scatter",
 ))
 
 

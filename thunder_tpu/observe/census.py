@@ -195,7 +195,21 @@ def thresholds() -> dict:
 # trace-level census (cheap — no XLA executable involved)
 # ---------------------------------------------------------------------------
 
-def trace_census(exec_trc) -> dict:
+def trace_ring_recv_bytes(rep: dict, n_dev: int) -> int:
+    """Trace-level recv-bytes-per-device expectation: the census ring model
+    applied to what the TRACE says each collective moves
+    (``examine.comm_report`` out_bytes per kind). This is the denominator of
+    the ``recv_vs_trace_ratio_max`` budget gate — HLO recv bytes drifting
+    above this expectation is exactly the NORTHSTAR r5 2.2x pessimization."""
+    from thunder_tpu.core.cost_model import ring_recv_bytes
+
+    total = 0
+    for kind, e in (rep.get("collectives") or {}).items():
+        total += ring_recv_bytes(kind, int(e.get("out_bytes", 0)), n_dev)
+    return total
+
+
+def trace_census(exec_trc, n_dev: int = 1) -> dict:
     """Launch/fusion shape of an execution trace plus the collective counts
     the TRACE expects. One owner for the claimed-launch walk: the serving
     runner's ``serving.decode_pallas_launches`` gauges are fed from here."""
@@ -222,6 +236,7 @@ def trace_census(exec_trc) -> dict:
                   if str(b.sym.id).startswith("xla.fusion"))
     expected: dict[str, int] = {}
     total_expected = 0
+    expected_recv = 0
     errors: list[str] = []
     try:
         from thunder_tpu import examine as _examine
@@ -229,6 +244,7 @@ def trace_census(exec_trc) -> dict:
         rep = _examine.comm_report(exec_trc)
         expected = {k: int(v["count"]) for k, v in rep["collectives"].items()}
         total_expected = sum(expected.values())
+        expected_recv = trace_ring_recv_bytes(rep, n_dev)
     except Exception as e:
         # a zeroed expectation silently disarms the reduce-scatter-rewrite
         # and inflation sentinels — the failure must be surfaced and
@@ -236,7 +252,8 @@ def trace_census(exec_trc) -> dict:
         errors.append(f"comm_report: {e!r}")
     return {"pallas_launches": launches, "decode_layer_fusions": decode_layers,
             "xla_regions": regions, "expected_collectives": expected,
-            "expected_collective_count": total_expected, "errors": errors}
+            "expected_collective_count": total_expected,
+            "expected_recv_bytes_per_device": expected_recv, "errors": errors}
 
 
 # ---------------------------------------------------------------------------
@@ -345,8 +362,12 @@ def findings(census: dict, th: dict | None = None) -> list[dict]:
     coll = census.get("collectives")
     expected = census.get("expected_collectives") or {}
     per_kind = (coll or {}).get("per_kind", {})
-    # trace reduce_scatter prims gone from the HLO while all-reduces remain
-    rs_expected = expected.get("reduce_scatter", 0)
+    # trace reduce_scatter prims gone from the HLO while all-reduces remain.
+    # Bucketed reduce-scatters (the overlap pass's fused pairs) lower to HLO
+    # reduce-scatter too — they count toward the expectation so bucketing
+    # cannot disarm this sentinel.
+    rs_expected = (expected.get("reduce_scatter", 0)
+                   + expected.get("bucketed_reduce_scatter", 0))
     if (coll is not None and rs_expected > 0
             and per_kind.get("reduce-scatter", {}).get("count", 0) == 0
             and per_kind.get("all-reduce", {}).get("count", 0) > 0):
@@ -434,7 +455,7 @@ def _collect(entry, *, fn_name: str) -> dict:
     exec_trc = entry.traces[-1] if entry.traces else None
     if exec_trc is not None:
         try:
-            tc = trace_census(exec_trc)
+            tc = trace_census(exec_trc, n_dev=census["n_dev"])
             census["errors"] += tc.pop("errors", [])
             census.update(tc)
         except Exception as e:
@@ -560,8 +581,16 @@ def check_budget(census: dict, budget: dict) -> list[str]:
     - ``forbid_kinds`` — kinds that must NOT appear
     - ``min_counts`` / ``max_counts`` — per-kind instruction-count bounds
     - ``max_total_collectives`` — bound on total collective instructions
-    - ``async_fraction_min`` — overall async-fraction floor
-    - ``recv_bytes_per_device_max`` — ring-model recv-byte ceiling
+    - ``async_fraction_min`` / ``async_fraction_max`` — overall
+      async-fraction bracket (both directions: a CPU-mesh smoke config
+      drifting to nonzero async is as much a schedule change as a TPU
+      config losing its overlap)
+    - ``recv_bytes_per_device_min`` / ``recv_bytes_per_device_max`` —
+      ring-model recv-byte bracket
+    - ``recv_vs_trace_ratio_max`` — ceiling on HLO recv bytes as a multiple
+      of the trace-level expectation
+      (``census['expected_recv_bytes_per_device']``) — the per-compile gate
+      on the NORTHSTAR r5 2.2x rewrite
     - ``max_launches_per_layer_per_token`` (+ ``layers``) — decode budget
     """
     v: list[str] = []
@@ -592,11 +621,29 @@ def check_budget(census: dict, budget: dict) -> list[str]:
             and asyn["fraction"] < amin:
         v.append(f"async fraction {asyn['async']}/{asyn['count']} "
                  f"({asyn['fraction']:.2f}) < budget floor {amin}")
+    amax = budget.get("async_fraction_max")
+    if amax is not None and asyn and asyn["count"] > 0 \
+            and asyn["fraction"] > amax:
+        v.append(f"async fraction {asyn['async']}/{asyn['count']} "
+                 f"({asyn['fraction']:.2f}) > budget ceiling {amax}")
     rmax = budget.get("recv_bytes_per_device_max")
     if rmax is not None and coll is not None \
             and coll["recv_bytes_per_device_total"] > rmax:
         v.append(f"recv bytes/device {coll['recv_bytes_per_device_total']} "
                  f"> budget {rmax}")
+    rmin = budget.get("recv_bytes_per_device_min")
+    if rmin is not None and coll is not None \
+            and coll["recv_bytes_per_device_total"] < rmin:
+        v.append(f"recv bytes/device {coll['recv_bytes_per_device_total']} "
+                 f"< budget floor {rmin}")
+    ratio = budget.get("recv_vs_trace_ratio_max")
+    exp_recv = census.get("expected_recv_bytes_per_device", 0)
+    if ratio is not None and coll is not None and exp_recv > 0:
+        got = coll["recv_bytes_per_device_total"]
+        if got > ratio * exp_recv:
+            v.append(f"HLO recv bytes/device {got} > {ratio:g}x the "
+                     f"trace-level expectation {exp_recv} "
+                     f"(the reduce-scatter-rewrite signature)")
     lmax = budget.get("max_launches_per_layer_per_token")
     if lmax is not None:
         layers = max(1, int(budget.get("layers", 1)))

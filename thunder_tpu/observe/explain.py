@@ -273,23 +273,47 @@ def explain(jfn) -> str:
         else:
             lines.append("  pessimizations: none")
 
-    # -- comm reorder (sort_waits report) ------------------------------------
+    # -- comm reorder (overlap-scheduling pass report) -----------------------
     comm_dec = [d for d in decisions if d["kind"] == "comm"]
     if comm_dec:
         lines.append("")
         lines.append("== comm reorder ==")
         for d in comm_dec:
             cost = d.get("cost") or {}
-            if d["op"] == "comm_reorder":
+            if d["decision"] == "bailout":
+                # a malformed trace must not skip scheduling invisibly
+                lines.append(f"  BAILOUT: {d.get('reason', '')}")
+            elif d["decision"] == "fallback":
+                lines.append(f"  bucketing fallback: {d.get('reason', '')}")
+            elif d["op"] == "comm_reorder":
                 lines.append(f"  {d.get('reason', '')} "
                              f"({cost.get('issues', 0)} issue(s), "
                              f"{cost.get('waits', 0)} wait(s) total)")
+                if "modeled_overlap_us" in cost:
+                    lines.append(
+                        f"  modeled overlap: {cost['modeled_overlap_us']:g} µs "
+                        f"hidden; in-flight cap "
+                        f"{cost.get('inflight_cap_bytes', 0) / 1e6:.0f} MB "
+                        f"({cost.get('cap_deferrals', 0)} deferral(s), "
+                        f"{cost.get('cap_forced', 0)} forced)")
+            elif d["decision"] in ("decomposed", "pinned"):
+                lines.append(f"  {d['op']}: {d.get('reason', '')}")
+            elif d["op"] == "comm_bucketing":
+                lines.append(f"  bucketing: {d.get('reason', '')}")
+            elif d["decision"] in ("bucketed", "kept"):
+                lines.append(f"  {d['op']} [{d['decision']}]: "
+                             f"{d.get('reason', '')}")
             else:
+                win = ""
+                if "window_us" in cost:
+                    win = (f", window {cost['window_us']:g} µs vs transfer "
+                           f"{cost['transfer_us']:g} µs — "
+                           f"{'covered' if cost.get('covered') else 'exposed'}")
                 lines.append(
                     f"  {d['op']}: issue@{cost.get('issue_at', '?')} -> "
                     f"wait@{cost.get('wait_at', '?')} "
                     f"(distance {cost.get('distance', '?')}, "
-                    f"was {cost.get('distance_before', '?')})")
+                    f"was {cost.get('distance_before', '?')}{win})")
 
     # -- numerics sentinel ---------------------------------------------------
     for tr in getattr(jfn, "transforms", ()):
