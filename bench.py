@@ -45,6 +45,10 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 10: bench.py stamps the measured-time observatory's residual summary
+# (model_residual_p50_pct / worst_region / calibration_platform from one
+# profiled window under --profile / BENCH_PROFILE=1 — null when the window
+# didn't run, so the fields are schema-stable);
 # 9: bench.py stamps the overlap-scheduling pass's outcome
 # (overlap_scheduled_collectives / comm_buckets / modeled_overlap_us from
 # the compile's comm decisions — all zero on a single-chip bench, where the
@@ -64,7 +68,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 9
+METRICS_SCHEMA = 10
 
 
 def main():
@@ -392,6 +396,43 @@ def main():
           f"{int(cens.get('census_errors', 0))} guarded error(s)",
           file=sys.stderr)
 
+    # schema-10 measured-time observatory (--profile / BENCH_PROFILE=1): one
+    # profiled window of the compiled step (per-region re-execution on CPU,
+    # jax.profiler trace ingestion on TPU), joined against the compile's
+    # est_*_us decisions into the residual ledger. Runs AFTER the timed
+    # trials on FRESH inputs (the timed loop donated the originals) — the
+    # reexec capture reads inputs, it never calls the donating run_fn.
+    model_residual_p50_pct = None
+    worst_region = None
+    calibration_platform = None
+    if "--profile" in sys.argv or os.environ.get("BENCH_PROFILE") == "1":
+        from thunder_tpu.observe import calibrate as _calibrate
+
+        calibration_platform = _calibrate.platform()
+        params_p = llama.init_params(cfg, seed=0, scale_layers=n_layers)
+        opt_p = opt.init(params_p)
+        prof_args = ((params_p, opt_p, fstate0, tokens, targets) if use_fp8
+                     else (params_p, opt_p, tokens, targets))
+        # CPU reexec runs every region eagerly with a sync per region — at
+        # the bench geometry that is minutes per pass, so smoke takes the
+        # 1-step/0-warmup window (attribution coverage is step-count
+        # invariant; only timing variance grows)
+        smoke = "--smoke" in sys.argv
+        prof_steps = int(os.environ.get("BENCH_PROFILE_STEPS",
+                                        "1" if smoke else "2"))
+        prof_warmup = int(os.environ.get("BENCH_PROFILE_WARMUP",
+                                         "0" if smoke else "1"))
+        prof = observe.profile_window(jstep, prof_args, steps=prof_steps,
+                                      warmup=prof_warmup)
+        psum = prof["summary"]
+        model_residual_p50_pct = psum["residual_p50_pct"]
+        worst_region = psum["worst_region"]
+        print(f"profile: {psum['measured']}/{psum['decisions_with_estimates']} "
+              f"est-decisions measured, |residual| p50="
+              f"{model_residual_p50_pct}% worst={worst_region} "
+              f"flips={psum['flips']} platform={calibration_platform}",
+              file=sys.stderr)
+
     # schema-9 overlap-scheduling outcome: what the comm_reorder pass did to
     # THIS compile (zeros on a single-chip bench — no collectives to place)
     comm_decs = [d for d in (tt.compile_stats(jstep).last_decisions or [])
@@ -448,6 +489,10 @@ def main():
         "overlap_scheduled_collectives": len(overlap_windows),
         "comm_buckets": comm_buckets,
         "modeled_overlap_us": modeled_overlap_us,
+        # schema-10 measured-time observatory (observe.profile, --profile)
+        "model_residual_p50_pct": model_residual_p50_pct,
+        "worst_region": worst_region,
+        "calibration_platform": calibration_platform,
     }))
 
 

@@ -175,6 +175,46 @@ def test_census_metric_names_documented():
         f"emits: {stale}")
 
 
+def test_profile_calib_metric_names_documented():
+    """Every ``profile.*`` / ``calib.*`` metric name the measured-time
+    observatory emits must appear in the docs' measured-time metrics table,
+    and every name the table documents must still be emitted — same
+    both-direction contract as the census metrics (calibration dashboards
+    key on these names to watch model-vs-measured drift)."""
+    import glob
+
+    import thunder_tpu
+
+    pkg_root = os.path.dirname(thunder_tpu.__file__)
+    sources = glob.glob(os.path.join(pkg_root, "**", "*.py"), recursive=True)
+    names: set = set()
+    for path in sources:
+        with open(path) as f:
+            names |= set(re.findall(
+                r"[\"']((?:profile|calib)\.[a-z0-9_]+)[\"']", f.read()))
+    # the observatory's core families must all be present (a refactor that
+    # stops emitting them should fail loudly here)
+    for required in ("profile.regions_measured", "profile.ledger_records",
+                     "profile.measured_coverage", "profile.residual_p50_pct",
+                     "profile.verdict_flips", "calib.constants_fitted",
+                     "calib.active_constants", "calib.budget_violations"):
+        assert required in names, f"code no longer emits {required}"
+    with open(DOC) as f:
+        doc = f.read()
+    missing = [n for n in sorted(names) if f"`{n}`" not in doc]
+    assert not missing, (
+        "profile/calib metrics emitted by the code but missing from the "
+        f"docs measured-time metrics table (docs/zero_to_thunder_tpu.md): "
+        f"{missing}")
+    table_names = set(re.findall(r"^\| `((?:profile|calib)\.[a-z0-9_]+)` \|",
+                                 doc, re.M))
+    assert table_names, "docs lost the measured-time metrics table"
+    stale = sorted(table_names - names)
+    assert not stale, (
+        f"docs measured-time metrics table documents names the code no "
+        f"longer emits: {stale}")
+
+
 def test_pessimization_kinds_documented():
     """The pessimization-sentinel vocabulary is an ops contract both ways:
     every kind in ``census.PESSIMIZATION_KINDS`` must be documented in

@@ -101,6 +101,12 @@ def enable_compilation_cache(directory: str, *, min_compile_secs: float = 1.0) -
     from thunder_tpu.runtime import quarantine as _rt_quarantine
 
     _rt_quarantine.configure(str(directory))
+    # fitted cost-model constants persist there too: a warm restart applies
+    # this platform's calibration overlay before the first verdict (every
+    # affected decision records a typed ``calibrated[...]`` reason)
+    from thunder_tpu.observe import calibrate as _obs_calibrate
+
+    _obs_calibrate.configure(str(directory))
 
 
 if _os.environ.get("THUNDER_TPU_COMPILATION_CACHE"):
@@ -140,6 +146,10 @@ class CompileStats:
         # per-pass walltimes (ms) — always collected, see thunder_tpu.observe
         self.last_decisions: list[dict] = []
         self.last_pass_times: dict[str, float] = {}
+        # measured-time observatory: the last observe.profile.profile_window
+        # result ({"profile": StepProfile, "ledger": [...], "summary": {...}})
+        # — model-vs-measured residuals joined to last_decisions by region id
+        self.last_profile = None
         self.fn_name = "fn"  # set by the owning ThunderTPUFunction
         # census knobs for this function's compiles (observe.census.ensure
         # reads them): the serving runner stashes its decode layer count +
@@ -709,6 +719,13 @@ class ThunderTPUFunction:
 
         with _observe.span("transform_for_execution"):
             exec_trc = transform_for_execution(trc, self.executors)
+        # the claim-level region-annotated trace (observe.profile replays it
+        # per region on backends without a profiler) rides in entry.traces so
+        # it survives the post-optimization transforms below, which rebuild
+        # the execution trace and would drop the attribute
+        region_trc = getattr(exec_trc, "_region_trace", None)
+        if region_trc is not None:
+            traces.append(region_trc)
         for tr in self.transforms:
             exec_trc = tr.transform_trace_post_optimization(exec_trc)
         if self.insert_dels:

@@ -48,6 +48,68 @@ TPU_PEAK_FLOPS = 197e12
 # roughly 1.2 us of HBM time on v5e, the same order as kernel launch).
 MIN_CLAIM_BYTES = 1 << 20
 
+# --- per-platform calibration overlay --------------------------------------
+# The hand-modeled constants below (efficiencies, launch overheads, ICI
+# bandwidth) are v5e figures. observe/calibrate.py fits platform-specific
+# values from the measured-time residual ledger (observe/profile.py) and
+# installs them HERE as an overlay: every cost function reads its constants
+# through ``constant(name)``, and every cost dict produced under an active
+# overlay is stamped ``"calibration": <platform>`` — which the decision log
+# turns into a typed ``calibrated[...]`` reason prefix. Verdicts never
+# change silently.
+
+CALIBRATABLE = (
+    "ADAMW_LAUNCH_OVERHEAD_US", "ADAMW_HBM_GBPS", "ADAMW_CHAIN_EFFICIENCY",
+    "ADAMW_FUSED_EFFICIENCY", "SUBBLOCK_XLA_EFFICIENCY",
+    "SUBBLOCK_FUSED_EFFICIENCY", "SUBBLOCK_LAUNCH_OVERHEAD_US",
+    "COLLECTIVE_LAUNCH_US", "ICI_BW_BYTES_PER_S",
+)
+
+_calibration_platform: str | None = None
+_calibration: dict = {}
+
+
+def constant(name: str) -> float:
+    """Read a cost-model constant through the calibration overlay: the
+    fitted per-platform value when one is installed, the hand-modeled
+    module default otherwise."""
+    return _calibration.get(name, globals()[name])
+
+
+def apply_calibration(platform: str, constants: dict) -> None:
+    """Install fitted constants for ``platform``. Unknown names are
+    rejected loudly — a schema drift between the persisted calibration and
+    ``CALIBRATABLE`` must fail, not silently half-apply."""
+    global _calibration_platform, _calibration
+    unknown = sorted(set(constants) - set(CALIBRATABLE))
+    if unknown:
+        raise ValueError(f"apply_calibration: unknown constant(s) {unknown}; "
+                         f"calibratable: {list(CALIBRATABLE)}")
+    _calibration = {k: float(v) for k, v in constants.items()}
+    _calibration_platform = str(platform)
+
+
+def clear_calibration() -> None:
+    """Drop the overlay — back to the hand-modeled defaults."""
+    global _calibration_platform, _calibration
+    _calibration = {}
+    _calibration_platform = None
+
+
+def calibration_platform() -> str | None:
+    """The platform whose fitted constants are installed, or ``None``."""
+    return _calibration_platform
+
+
+def stamp_calibration(cost: dict) -> dict:
+    """Mark a cost dict as computed under the active overlay (no-op when
+    uncalibrated). The decision log keys its typed ``calibrated[...]``
+    reason prefix off this stamp."""
+    if _calibration_platform is not None:
+        cost["calibration"] = _calibration_platform
+    return cost
+
+
 _ZERO_COST_IDS = {
     PrimIDs.PYTHON_RETURN, PrimIDs.COMMENT, PrimIDs.PYTHON_DEL,
     PrimIDs.PYTHON_PRINT, PrimIDs.SINK, PrimIDs.UNPACK_TRIVIAL,
@@ -202,9 +264,10 @@ def fused_adamw_cost(n_tensors: int, total_bytes: int,
     fusion, ~1/3 of the staging risk the r6 note recorded). The dict says
     which layout the verdict was computed under so the decision log and
     PERF_R6's risk note can never silently disagree."""
-    stream_us = total_bytes / (ADAMW_HBM_GBPS * 1e3)
-    unfused = stream_us / ADAMW_CHAIN_EFFICIENCY + n_tensors * ADAMW_LAUNCH_OVERHEAD_US
-    fused = stream_us / ADAMW_FUSED_EFFICIENCY + ADAMW_LAUNCH_OVERHEAD_US
+    launch = constant("ADAMW_LAUNCH_OVERHEAD_US")
+    stream_us = total_bytes / (constant("ADAMW_HBM_GBPS") * 1e3)
+    unfused = stream_us / constant("ADAMW_CHAIN_EFFICIENCY") + n_tensors * launch
+    fused = stream_us / constant("ADAMW_FUSED_EFFICIENCY") + launch
     # the exposed staging traffic if XLA does NOT absorb the packs: one
     # read+write per staged stream, ~2x the update bytes when all 7 streams
     # (g,p,m,v in + p,m,v out) stage. Slab-persistent m/v never stage — the
@@ -217,11 +280,12 @@ def fused_adamw_cost(n_tensors: int, total_bytes: int,
             "saved_launches": max(n_tensors - 1, 0),
             "slab_persistent": bool(slab_persistent),
             "pack_bytes_if_unabsorbed": 0 if slab_persistent else 2 * total_bytes,
+            "stream_us": round(stream_us, 3),
             "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
             "est_saved_us": round(unfused - fused, 3)}
     if slab_persistent:
         cost["pg_pack_bytes_if_unabsorbed"] = (2 * total_bytes) * 5 // 12
-    return cost
+    return stamp_calibration(cost)
 
 
 # --- collective overlap model ----------------------------------------------
@@ -267,15 +331,18 @@ def ring_recv_bytes(kind: str, out_bytes: int, n_dev: int) -> int:
 
 
 def collective_transfer_us(kind: str, out_bytes: int, n_dev: int,
-                           ici_bw: float = ICI_BW_BYTES_PER_S) -> float:
+                           ici_bw: float | None = None) -> float:
     """Modeled ICI transfer time of one collective in µs (ring recv bytes
-    over one axis's bandwidth) plus the fixed issue overhead."""
+    over one axis's bandwidth) plus the fixed issue overhead. ``ici_bw``
+    defaults to the (calibration-overlaid) ``ICI_BW_BYTES_PER_S``."""
+    if ici_bw is None:
+        ici_bw = constant("ICI_BW_BYTES_PER_S")
     recv = ring_recv_bytes(kind, out_bytes, n_dev)
-    return COLLECTIVE_LAUNCH_US + recv / ici_bw * 1e6
+    return constant("COLLECTIVE_LAUNCH_US") + recv / ici_bw * 1e6
 
 
 def comm_bucket_cost(kind: str, member_bytes: list[int], n_dev: int,
-                     ici_bw: float = ICI_BW_BYTES_PER_S) -> dict:
+                     ici_bw: float | None = None) -> dict:
     """Byte model for coalescing k sub-threshold collectives into one fused
     issue/wait pair: the ring transfer is linear in bytes, so fusing saves
     (k-1) issue overheads while moving the same payload. Returned dict feeds
@@ -285,10 +352,12 @@ def comm_bucket_cost(kind: str, member_bytes: list[int], n_dev: int,
     total = sum(member_bytes)
     unfused = sum(collective_transfer_us(kind, b, n_dev, ici_bw) for b in member_bytes)
     fused = collective_transfer_us(kind, total, n_dev, ici_bw)
-    return {"members": k, "bucket_bytes": total,
-            "saved_issues": max(k - 1, 0),
-            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
-            "est_saved_us": round(unfused - fused, 3)}
+    return stamp_calibration(
+        {"members": k, "bucket_bytes": total,
+         "recv_bytes": ring_recv_bytes(kind, total, n_dev), "n_dev": n_dev,
+         "saved_issues": max(k - 1, 0),
+         "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+         "est_saved_us": round(unfused - fused, 3)})
 
 
 def fused_adamw_profitable(n_tensors: int, total_bytes: int) -> bool:
@@ -372,21 +441,25 @@ def subblock_cost(n_tokens: int, d_model: int, d_ff: int,
     boundary_bytes = (3 * n_tokens * d_model * dtype_bytes
                       + 3 * d_model * d_ff * dtype_bytes)
     flop_us = flops / TPU_PEAK_FLOPS * 1e6
-    bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
+    bw_us_per_byte = 1.0 / (constant("ADAMW_HBM_GBPS") * 1e3)
+    launch = constant("SUBBLOCK_LAUNCH_OVERHEAD_US")
     unfused_launches = DECODE_UNFUSED_LAUNCHES_MLP if decode else 0
-    unfused = (flop_us / SUBBLOCK_XLA_EFFICIENCY
+    unfused = (flop_us / constant("SUBBLOCK_XLA_EFFICIENCY")
                + (boundary_bytes + interior_bytes) * bw_us_per_byte
-               + unfused_launches * SUBBLOCK_LAUNCH_OVERHEAD_US)
-    fused = (flop_us / SUBBLOCK_FUSED_EFFICIENCY
-             + boundary_bytes * bw_us_per_byte + SUBBLOCK_LAUNCH_OVERHEAD_US)
+               + unfused_launches * launch)
+    fused = (flop_us / constant("SUBBLOCK_FUSED_EFFICIENCY")
+             + boundary_bytes * bw_us_per_byte + launch)
     vmem = subblock_vmem_bytes(d_model, d_ff, dtype_bytes, n_tokens)
-    return {"n_tokens": n_tokens, "d_model": d_model, "d_ff": d_ff,
-            "flops": flops, "decode": bool(decode),
-            "saved_boundary_bytes": interior_bytes,
-            "vmem_bytes_per_step": vmem,
-            "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
-            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
-            "est_saved_us": round(unfused - fused, 3)}
+    return stamp_calibration(
+        {"n_tokens": n_tokens, "d_model": d_model, "d_ff": d_ff,
+         "flops": flops, "decode": bool(decode),
+         "saved_boundary_bytes": interior_bytes,
+         "flop_us": round(flop_us, 3),
+         "boundary_us": round(boundary_bytes * bw_us_per_byte, 3),
+         "vmem_bytes_per_step": vmem,
+         "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
+         "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+         "est_saved_us": round(unfused - fused, 3)})
 
 
 def subblock_profitable(cost: dict) -> bool:
@@ -483,22 +556,26 @@ def attn_subblock_cost(n_slots: int, d_model: int, n_heads: int,
                       + 2 * n_slots * d_model * dtype_bytes
                       + 2 * n_slots * kv_heads * L * head_dim * dtype_bytes)
     flop_us = flops / TPU_PEAK_FLOPS * 1e6
-    bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
-    unfused = (flop_us / SUBBLOCK_XLA_EFFICIENCY
+    bw_us_per_byte = 1.0 / (constant("ADAMW_HBM_GBPS") * 1e3)
+    launch = constant("SUBBLOCK_LAUNCH_OVERHEAD_US")
+    unfused = (flop_us / constant("SUBBLOCK_XLA_EFFICIENCY")
                + (boundary_bytes + interior_bytes) * bw_us_per_byte
-               + DECODE_UNFUSED_LAUNCHES_ATTN * SUBBLOCK_LAUNCH_OVERHEAD_US)
-    fused = (flop_us / SUBBLOCK_FUSED_EFFICIENCY
-             + boundary_bytes * bw_us_per_byte + SUBBLOCK_LAUNCH_OVERHEAD_US)
+               + DECODE_UNFUSED_LAUNCHES_ATTN * launch)
+    fused = (flop_us / constant("SUBBLOCK_FUSED_EFFICIENCY")
+             + boundary_bytes * bw_us_per_byte + launch)
     vmem = decode_subblock_vmem_bytes(n_slots, d_model, n_heads, kv_heads,
                                       head_dim, page_size, 0, dtype_bytes)
-    return {"n_slots": n_slots, "d_model": d_model, "n_heads": n_heads,
-            "kv_heads": kv_heads, "head_dim": head_dim,
-            "context_window": L, "flops": flops,
-            "saved_boundary_bytes": interior_bytes,
-            "vmem_bytes_per_step": vmem,
-            "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
-            "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
-            "est_saved_us": round(unfused - fused, 3)}
+    return stamp_calibration(
+        {"n_slots": n_slots, "d_model": d_model, "n_heads": n_heads,
+         "kv_heads": kv_heads, "head_dim": head_dim,
+         "context_window": L, "flops": flops,
+         "saved_boundary_bytes": interior_bytes,
+         "flop_us": round(flop_us, 3),
+         "boundary_us": round(boundary_bytes * bw_us_per_byte, 3),
+         "vmem_bytes_per_step": vmem,
+         "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
+         "est_unfused_us": round(unfused, 3), "est_fused_us": round(fused, 3),
+         "est_saved_us": round(unfused - fused, 3)})
 
 
 def decode_layer_cost(attn_cost: dict, mlp_cost: dict, n_slots: int,
@@ -511,21 +588,23 @@ def decode_layer_cost(attn_cost: dict, mlp_cost: dict, n_slots: int,
     staging — two individually-feasible halves can exceed the scoped
     budget together, in which case the planner keeps the two-launch form."""
     h2_roundtrip = 2 * n_slots * d_model * dtype_bytes
-    bw_us_per_byte = 1.0 / (ADAMW_HBM_GBPS * 1e3)
-    saved = (SUBBLOCK_LAUNCH_OVERHEAD_US + h2_roundtrip * bw_us_per_byte)
+    bw_us_per_byte = 1.0 / (constant("ADAMW_HBM_GBPS") * 1e3)
+    saved = (constant("SUBBLOCK_LAUNCH_OVERHEAD_US")
+             + h2_roundtrip * bw_us_per_byte)
     vmem = decode_subblock_vmem_bytes(
         n_slots, d_model, attn_cost["n_heads"], attn_cost["kv_heads"],
         attn_cost["head_dim"], page_size, mlp_cost["d_ff"], dtype_bytes)
-    return {"n_slots": n_slots, "d_model": d_model,
-            "d_ff": mlp_cost["d_ff"], "context_window":
-            attn_cost["context_window"],
-            "saved_boundary_bytes": h2_roundtrip,
-            "saved_launches": 1,
-            "vmem_bytes_per_step": vmem,
-            "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
-            "est_saved_us": round(
-                attn_cost["est_saved_us"] + mlp_cost["est_saved_us"] + saved,
-                3)}
+    return stamp_calibration(
+        {"n_slots": n_slots, "d_model": d_model,
+         "d_ff": mlp_cost["d_ff"], "context_window":
+         attn_cost["context_window"],
+         "saved_boundary_bytes": h2_roundtrip,
+         "saved_launches": 1,
+         "vmem_bytes_per_step": vmem,
+         "vmem_feasible": vmem <= VMEM_BUDGET_BYTES,
+         "est_saved_us": round(
+             attn_cost["est_saved_us"] + mlp_cost["est_saved_us"] + saved,
+             3)})
 
 
 def horizontal_merge_profitable(m_tokens: int, out_features) -> bool:

@@ -62,11 +62,20 @@ class ProfileTransform(Transform):
     per-region spans appear in profiler traces alongside XLA ops. When the
     ``thunder_tpu.observe`` registry is enabled, each wrapped call also
     records an observe span (cat ``op``) visible in
-    ``observe.export_chrome_trace``. NOTE: under the default whole-program
-    jit the wrapped impls execute once, at jax trace time — you get one
-    trace-time span per op, not a per-step runtime timeline; compile with
-    ``whole_program_jit=False`` (the per-region execution path) for real
-    per-op runtime spans."""
+    ``observe.export_chrome_trace``.
+
+    Region names come from ``observe.profile.region_names_for`` — the ONE
+    owner of the naming scheme (``executor:symbol#occurrence``) shared with
+    the dispatch-time ``jax.named_scope`` annotations, the measured-time
+    :class:`~thunder_tpu.observe.profile.StepProfile` and the residual
+    ledger — so this transform's profiler output joins against the decision
+    log by name, not by guesswork. ``prefix`` namespaces the annotation
+    (``<prefix>/<region>``) without changing the region id itself.
+
+    NOTE: under the default whole-program jit the wrapped impls execute
+    once, at jax trace time — you get one trace-time span per op, not a
+    per-step runtime timeline; compile with ``whole_program_jit=False``
+    (the per-region execution path) for real per-op runtime spans."""
 
     def __init__(self, prefix: str = "thunder_tpu"):
         self.prefix = prefix
@@ -75,14 +84,16 @@ class ProfileTransform(Transform):
         import jax
 
         from thunder_tpu.observe import registry as _observe
+        from thunder_tpu.observe.profile import region_names_for
 
+        names = region_names_for(trc)
         new = from_trace(trc)
         bsyms: list[BoundSymbol] = []
-        for bsym in trc.bound_symbols:
-            if bsym.sym.id in _SKIP or bsym.sym.python_impl is None:
+        for bsym, region in zip(trc.bound_symbols, names):
+            if region is None or bsym.sym.python_impl is None:
                 bsyms.append(bsym)
                 continue
-            name = f"{self.prefix}.{bsym.sym.codegen_name()}"
+            name = f"{self.prefix}/{region}" if self.prefix else region
             inner = bsym.sym.python_impl
 
             def make_impl(_name, _inner):

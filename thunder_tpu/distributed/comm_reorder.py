@@ -465,6 +465,7 @@ def sort_waits(trc: TraceCtx, *, n_dev: int = 1,
                 window = t_now - info["issue_t"]
                 pairs.append({
                     "issue_gi": info["issue_gi"], "wait_gi": pick,
+                    "bytes": info["bytes"],
                     "window_us": window, "transfer_us": info["transfer_us"],
                     "overlap_us": min(window, info["transfer_us"]),
                     "covered": window >= info["transfer_us"]})
@@ -537,20 +538,34 @@ def _report(groups, order, new_pos, pairs, sched_stats) -> None:
                     f"optimization_barrier (prims.pin_collectives()) — "
                     f"XLA cannot rewrite them into all-reduces"),
             cost={"count": pinned})
+    from thunder_tpu.core import cost_model as _cm
+
+    n_dev = sched_stats.get("n_dev", 1)
     for p in sorted(pairs, key=lambda q: (new_pos[q["issue_gi"]],
                                           new_pos[q["wait_gi"]])):
         src, wg = p["issue_gi"], p["wait_gi"]
+        kind = groups[src][0].sym.name
         _decisions.record(
-            "comm", groups[src][0].sym.name, None, "overlap_window",
+            "comm", kind, None, "overlap_window",
             reason=(f"issue@{new_pos[src]} wait@{new_pos[wg]} — "
                     f"{'covered' if p['covered'] else 'exposed'}"),
-            cost={"issue_at": new_pos[src], "wait_at": new_pos[wg],
-                  "distance": new_pos[wg] - new_pos[src],
-                  "distance_before": wg - src,
-                  "window_us": round(p["window_us"], 3),
-                  "transfer_us": round(p["transfer_us"], 3),
-                  "overlap_us": round(p["overlap_us"], 3),
-                  "covered": p["covered"]})
+            # transfer_us doubles as this pair's est prediction
+            # (est_transfer_us) so the residual ledger joins measured
+            # issue->wait windows against the ICI model; recv_bytes is the
+            # fit component observe.calibrate regresses ICI_BW_BYTES_PER_S /
+            # COLLECTIVE_LAUNCH_US against (the ONCHIP_AB.md B6 harness)
+            cost=_cm.stamp_calibration(
+                {"issue_at": new_pos[src], "wait_at": new_pos[wg],
+                 "distance": new_pos[wg] - new_pos[src],
+                 "distance_before": wg - src,
+                 "recv_bytes": _cm.ring_recv_bytes(
+                     kind, p.get("bytes", 0), n_dev),
+                 "n_dev": n_dev,
+                 "window_us": round(p["window_us"], 3),
+                 "transfer_us": round(p["transfer_us"], 3),
+                 "est_transfer_us": round(p["transfer_us"], 3),
+                 "overlap_us": round(p["overlap_us"], 3),
+                 "covered": p["covered"]}))
 
 
 class CommReorderTransform(Transform):

@@ -16,7 +16,7 @@ from thunder_tpu.core.baseutils import check
 from thunder_tpu.core.prims import OpTags, PrimIDs
 from thunder_tpu.core.proxies import Proxy, Variable
 from thunder_tpu.core.pytree import tree_flatten
-from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.symbol import BoundSymbol, Symbol
 from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
 from thunder_tpu.core.transform_common import dce
 from thunder_tpu.core.utils import consumed_vars, produced_vars
@@ -181,12 +181,71 @@ def transform_for_execution(trc: TraceCtx, executors) -> TraceCtx:
         new = from_trace(trc)
         new.bound_symbols = ex_bsyms
         new.set_provenance("Executor claim pass")
+    from thunder_tpu.core.compile_data import get_compile_option
+
+    # Region annotation happens at CLAIM granularity — before the fusion
+    # executors run — because that is the level the decision log speaks at
+    # (one planned block / bucketed optimizer chain per claimed bsym). The
+    # XLA fusion pass then absorbs the annotated impls into its jax.jit
+    # regions, so the named_scope still reaches the lowered HLO metadata and
+    # TPU profiler traces attribute time inside fused programs back to the
+    # exact verdict. The annotated claim-level trace is kept on the returned
+    # trace (``_region_trace``) so observe.profile can replay it region by
+    # region on backends without a profiler.
+    region_trc = None
+    if get_compile_option(
+            "region_annotations",
+            "wrap each claimed executor callable in a jax.named_scope carrying "
+            "its stable region name (executor:symbol#occurrence — the id the "
+            "decision log, observe.profile and ProfileTransform share), so "
+            "profiler traces attribute time back to compiler verdicts",
+            True):
+        with _observe.span("annotate_regions"):
+            new = region_trc = annotate_regions(new)
     for ex in executors:
         if isinstance(ex, FusionExecutor):
             with _observe.span(f"fusion_pass:{ex.name}"):
                 new = ex.fusion_pass(new)
     new = dce(new)
     new.set_provenance("Transform for execution")
+    new._region_trace = region_trc
+    return new
+
+
+def annotate_regions(trc: TraceCtx) -> TraceCtx:
+    """Thread the stable region names (``observe.profile.region_names_for``
+    — the SAME ids the decision log joins on) through dispatch: each bound
+    symbol carrying a ``python_impl`` (claimed executor ops, fusion-region
+    callables) is rebound to a copy whose impl runs under
+    ``jax.named_scope(region_name)``, so the region name lands in the
+    lowered HLO op metadata and ``jax.profiler`` traces attribute device
+    time back to the exact verdict that scheduled the region."""
+    import jax
+
+    from thunder_tpu.observe.profile import region_names_for
+
+    names = region_names_for(trc)
+    new = from_trace(trc)
+    bsyms: list[BoundSymbol] = []
+    for bsym, name in zip(trc.bound_symbols, names):
+        if name is None or bsym.sym.python_impl is None:
+            bsyms.append(bsym)
+            continue
+        inner = bsym.sym.python_impl
+
+        def make_impl(_name, _inner):
+            def annotated(*args, **kw):
+                with jax.named_scope(_name):
+                    return _inner(*args, **kw)
+
+            return annotated
+
+        sym = Symbol(bsym.sym.name, bsym.sym.meta, id=bsym.sym.id,
+                     is_prim=bsym.sym.is_prim, executor=bsym.sym.executor,
+                     python_impl=make_impl(name, inner), tags=bsym.sym.tags)
+        bsyms.append(bsym.from_bsym(sym=sym))
+    new.bound_symbols = bsyms
+    new.set_provenance("Region annotations")
     return new
 
 

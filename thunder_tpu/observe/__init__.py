@@ -22,9 +22,22 @@ makes the compiler's *decisions* inspectable too:
   (typed findings, ``compile.*``/``hlo.*`` gauges, budget gates),
 - exporters: JSONL, Chrome/Perfetto trace (with serving request/scheduler
   tracks and counter tracks), Prometheus text (``exporters.py``),
+- the MEASURED-TIME observatory (``profile.py``): stable per-region names
+  (``executor:symbol#occurrence``) threaded through dispatch as
+  ``jax.named_scope`` annotations, a profiled window of steps captured per
+  region (profiler-trace ingestion on TPU, timed re-execution on CPU), and
+  the model-vs-measured residual ledger joining measurements against the
+  decision log's ``est_*_us`` predictions (``profile.*`` metrics + flight
+  events),
+- cost-model CALIBRATION (``calibrate.py``): per-platform least-squares
+  fits of the efficiency/launch/bandwidth constants from accumulated
+  ledger records, persisted as schema-versioned ``cost_calibration.json``
+  next to the compile cache; applied through ``cost_model``'s overlay so
+  every recalibrated verdict is a typed ``calibrated[...]`` decision
+  (``calib.*`` metrics, ``CALIBRATION_BUDGETS.json`` drift gates),
 - ``explain(jfn)`` — the human report: who executes each op, why fusions
-  did or didn't fire, where compile time went, and the per-request serving
-  timeline (``explain.py``).
+  did or didn't fire, where compile time went, model-vs-measured
+  residuals, and the per-request serving timeline (``explain.py``).
 
 Quick start::
 
@@ -37,9 +50,11 @@ Quick start::
 
 from __future__ import annotations
 
+from thunder_tpu.observe import calibrate  # noqa: F401
 from thunder_tpu.observe import census  # noqa: F401
 from thunder_tpu.observe import decisions  # noqa: F401
 from thunder_tpu.observe import flight  # noqa: F401
+from thunder_tpu.observe import profile  # noqa: F401
 from thunder_tpu.observe.exporters import (  # noqa: F401
     chrome_trace_dict,
     export_chrome_trace,
@@ -61,6 +76,7 @@ from thunder_tpu.observe.registry import (  # noqa: F401
     snapshot,
     span,
 )
+from thunder_tpu.observe.profile import profile_window  # noqa: F401
 from thunder_tpu.observe.registry import enable as _enable_registry
 from thunder_tpu.observe.runtime import instrument_entry, set_sync_steps  # noqa: F401
 

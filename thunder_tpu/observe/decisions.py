@@ -57,6 +57,15 @@ def record(kind: str, op: str, executor: str | None, decision: str,
     sink = _sink.get()
     if sink is None and not _registry.is_enabled():
         return
+    # typed calibrated decisions: a cost dict computed under an active
+    # calibration overlay (observe.calibrate) carries a "calibration"
+    # platform stamp from cost_model — surface it in the reason so a
+    # verdict changed by fitted constants is never silent. One central
+    # prefix covers every record site.
+    if isinstance(cost, dict) and cost.get("calibration") \
+            and not reason.startswith("calibrated["):
+        reason = f"calibrated[{cost['calibration']}]: {reason}" if reason \
+            else f"calibrated[{cost['calibration']}]"
     rec = {"kind": kind, "op": op, "executor": executor,
            "decision": decision, "reason": reason, "cost": cost}
     if sink is not None:

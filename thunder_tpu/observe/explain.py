@@ -120,6 +120,74 @@ def _request_timeline_lines() -> list[str]:
     return out
 
 
+_RESIDUAL_MAX_LINES = 16
+
+
+def _model_vs_measured_lines() -> list[str]:
+    """The measured-time observatory's residual ledger, read back from the
+    ALWAYS-ON flight ring (``profile_ledger`` summary + ``profile_residual``
+    records published by ``observe.profile.profile_window``) — renders with
+    the registry disabled, the same black-box contract as the request
+    timeline. Shows the LATEST profiled window: coverage, residual p50,
+    then the worst-calibrated verdicts by |residual|, flagging any verdict
+    the measurement would have FLIPPED, and the decisions no measurement
+    attributed. Empty when no window was ever profiled."""
+    from thunder_tpu.observe import flight as _flight
+
+    recs = _flight.snapshot()
+    summary = None
+    for r in recs:
+        if r["type"] == "event" and r.get("kind") == "profile_ledger":
+            summary = r  # last one wins: the latest window
+    if summary is None:
+        return []
+    window = summary.get("window")
+    residuals = [r for r in recs
+                 if r["type"] == "event" and r.get("kind") == "profile_residual"
+                 and r.get("window") == window]
+    out: list[str] = []
+    out.append(f"  window {window}: {summary.get('steps', '?')} step(s), "
+               f"mode={summary.get('mode', '?')}, "
+               f"platform={summary.get('platform', '?')}")
+    n_est = summary.get("decisions_with_estimates", 0)
+    out.append(f"  coverage: {summary.get('measured', 0)}/{n_est} decision(s) "
+               f"with est_*_us measured, "
+               f"{summary.get('unattributed', 0)} unattributed")
+    p50 = summary.get("residual_p50_pct")
+    if p50 is not None:
+        out.append(f"  |residual| p50: {p50:g}% of predicted")
+    flips = summary.get("flips", 0)
+    if flips:
+        out.append(f"  VERDICT FLIPS: {flips} accepted fusion(s) measured "
+                   f"slower than their modeled unfused alternative")
+    measured = [r for r in residuals if r.get("status") == "measured"]
+    measured.sort(key=lambda r: abs(r.get("residual_pct") or 0.0),
+                  reverse=True)
+    for r in measured[:_RESIDUAL_MAX_LINES]:
+        flag = "  << FLIPPED" if r.get("flipped") else ""
+        rp = r.get("residual_pct")
+        out.append(
+            f"  {r.get('region', '?')} [{r.get('decision_kind', '?')}:"
+            f"{r.get('op', '?')} -> {r.get('decision', '?')}]: "
+            f"predicted {r.get('predicted_us', '?')} µs, measured "
+            f"{r.get('measured_us', '?')} µs"
+            + (f" ({rp:+g}%)" if rp is not None else "") + flag)
+    if len(measured) > _RESIDUAL_MAX_LINES:
+        out.append(f"  (... {len(measured) - _RESIDUAL_MAX_LINES} more "
+                   f"measured record(s))")
+    unatt = [r for r in residuals if r.get("status") == "unattributed"]
+    for r in unatt[:_RESIDUAL_MAX_LINES]:
+        out.append(f"  unattributed: "
+                   f"{r.get('decision_kind', '?')}:{r.get('op', '?')} "
+                   f"-> {r.get('decision', '?')} (no fused region to "
+                   f"measure — verdict kept the unfused form, or region "
+                   f"outside the window)")
+    if len(unatt) > _RESIDUAL_MAX_LINES:
+        out.append(f"  (... {len(unatt) - _RESIDUAL_MAX_LINES} more "
+                   f"unattributed record(s))")
+    return out
+
+
 def explain(jfn) -> str:
     """Return the textual report. The structured data behind it stays
     available on ``thunder_tpu.compile_stats(jfn)`` (``last_decisions``,
@@ -314,6 +382,16 @@ def explain(jfn) -> str:
                     f"wait@{cost.get('wait_at', '?')} "
                     f"(distance {cost.get('distance', '?')}, "
                     f"was {cost.get('distance_before', '?')}{win})")
+
+    # -- model vs measured (residual ledger) ---------------------------------
+    # sourced from the ALWAYS-ON flight ring (profile_window publishes the
+    # ledger there), so the section renders registry-off — the postmortem
+    # answer to "were the cost model's verdicts right on this machine"
+    residual = _model_vs_measured_lines()
+    if residual:
+        lines.append("")
+        lines.append("== model vs measured (residual ledger) ==")
+        lines.extend(residual)
 
     # -- numerics sentinel ---------------------------------------------------
     for tr in getattr(jfn, "transforms", ()):
