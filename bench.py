@@ -45,6 +45,10 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 11: bench_serve --mesh stamps the tensor-parallel serving scenario
+# (mesh_shape / tp_degree / per_shard_toks_s next to the aggregate
+# tokens/s and TTFT percentiles, plus the meshed decode program's census
+# collective counts — the ≤2-all-reduces-per-layer budget surface);
 # 10: bench.py stamps the measured-time observatory's residual summary
 # (model_residual_p50_pct / worst_region / calibration_platform from one
 # profiled window under --profile / BENCH_PROFILE=1 — null when the window
@@ -68,7 +72,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 10
+METRICS_SCHEMA = 11
 
 
 def main():

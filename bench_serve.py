@@ -60,10 +60,23 @@ value — nonzero IS the regression), ``census_decode_hlo_fusions``,
 guarded ``census_decode_errors``, and any sentinel
 ``census_decode_pessimizations`` kinds.
 
+``--mesh`` runs the TENSOR-PARALLEL scenario: the engine builds over a
+``SERVE_TP``-way (default 8) 1-D mesh — column/row-sharded weights,
+kv-head-sharded paged pool, replicated activations — and the schema-11
+JSON line stamps ``mesh_shape`` / ``tp_degree`` / ``per_shard_toks_s``
+(aggregate tokens/s over the shard count) next to the TTFT percentiles,
+plus the MESHED decode program's census collective counts
+(``census_decode_collectives`` per kind and
+``census_decode_all_reduces_per_layer`` — the committed
+CENSUS_BUDGETS.json budget is ≤2 per layer with zero gathers) and the
+``serving_mesh`` flight-ring record count. On CPU the mesh is forced via
+``--xla_force_host_platform_device_count``; the smoke uses the tiny-tp
+geometry (everything divides tp=8).
+
 Env: SERVE_MODEL, SERVE_LAYERS, SERVE_REQUESTS, SERVE_DECODE, SERVE_SLOTS,
 SERVE_CONTEXT, SERVE_PAGE, SERVE_CHUNK, SERVE_RATE, SERVE_DEADLINE_S,
-SERVE_QUEUE, SERVE_SYS, SERVE_BESTOF, SERVE_TRACE. ``--smoke``: tiny GQA
-geometry on CPU.
+SERVE_QUEUE, SERVE_SYS, SERVE_BESTOF, SERVE_TP, SERVE_TRACE. ``--smoke``:
+tiny GQA geometry on CPU (tiny-tp under ``--mesh``).
 """
 
 from __future__ import annotations
@@ -89,6 +102,26 @@ def main():
     smoke = "--smoke" in sys.argv
     overload = "--overload" in sys.argv
     prefix = "--prefix" in sys.argv
+    mesh = "--mesh" in sys.argv
+    if mesh and "tpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        # the CPU mesh needs its devices BEFORE the backend initializes:
+        # tp host devices (tp from SERVE_TP, default 8), same trick as
+        # tests/conftest.py
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                + os.environ.get("SERVE_TP", "8")).strip()
+    if mesh and smoke:
+        # mesh smoke: the tiny-tp geometry (8 heads / 8 kv-heads / 192
+        # intermediate — everything divides tp=8), short decodes; the
+        # scenario's story is the census + per-shard split, not raw speed
+        os.environ.setdefault("SERVE_MODEL", "tiny-tp")
+        os.environ.setdefault("SERVE_LAYERS", "2")
+        os.environ.setdefault("SERVE_DECODE", "32")
+        os.environ.setdefault("SERVE_SLOTS", "4")
+        os.environ.setdefault("SERVE_PAGE", "8")
+        os.environ.setdefault("SERVE_CHUNK", "32")
     if overload and smoke:
         # overload smoke: enough offered load to overflow the bounded queue
         # while each accepted request keeps a wide SLO margin
@@ -146,6 +179,95 @@ def main():
     # need the registry; the baseline runs under the same instrumentation
     # so the comparison carries identical per-dispatch overhead)
     observe.enable(clear=True)
+
+    # ---- tensor-parallel mesh scenario: pjit-sharded prefill/decode -------
+    if mesh:
+        tp = int(os.environ.get("SERVE_TP", "8"))
+        need = -(-int(max(len(p) for p in prompts) + n_decode) // page)
+        eng = ServingEngine(params, cfg, max_slots=slots, page_size=page,
+                            max_context=max_context, n_layers=n_layers,
+                            prefill_chunk=chunk, num_pages=slots * need + 1,
+                            mesh=tp)
+        # warm the real length mix + the sharded decode program
+        for L in sorted({int(l) for l in lens}):
+            eng.submit(rng.randint(1, cfg.vocab_size,
+                                   size=L).astype(np.int32),
+                       max_new_tokens=2)
+        eng.drain()
+
+        def run_round():
+            eng.completed.clear()
+            eng.cache.reset_peak()
+            pending = sorted(zip(arrivals.tolist(), prompts),
+                             key=lambda x: x[0])
+            reqs = []
+            t0 = time.perf_counter()
+            while pending or eng.queue or eng.active_requests:
+                now = time.perf_counter() - t0
+                while pending and pending[0][0] <= now:
+                    reqs.append(eng.submit(pending.pop(0)[1], n_decode))
+                if not eng.step() and pending:
+                    time.sleep(max(0.0, min(pending[0][0] - now, 1e-3)))
+            wall = time.perf_counter() - t0
+            return wall, {
+                "ttfts": sorted(r.ttft_s * 1e3 for r in reqs),
+                "util_peak": (eng.cache.peak_pages_used
+                              / eng.cache.pages_total),
+            }
+
+        rounds = 3 if smoke else 2
+        best = None
+        for _ in range(rounds):
+            w, stats = run_round()
+            if best is None or w < best[0]:
+                best = (w, stats)
+        eng.assert_quiescent()
+        wall, stats = best
+        tok_s = total_tokens / wall
+        ttfts = stats["ttfts"]
+        # the MESHED decode program's census: the collective ledger IS the
+        # scenario's acceptance surface (CENSUS_BUDGETS.json pins ≤2
+        # all-reduces per layer and zero gathers for the tiny-tp config;
+        # here the live numbers ride the JSON line). mesh_shape/tp_degree
+        # come off the census itself — stamped from the runner's
+        # census_context, so the line reports what actually compiled.
+        dec_cens = tt.compile_stats(eng.runner.decode_jit).last_census or {}
+        per_kind = {k: int(v["count"]) for k, v in
+                    ((dec_cens.get("collectives") or {}).get("per_kind")
+                     or {}).items()}
+        mesh_shape = list(dec_cens.get("mesh_shape") or [tp])
+        tp_deg = int(dec_cens.get("tp_degree") or tp)
+        # the flight ring holds the serving_mesh build event (mesh_shape in
+        # the record) — the postmortem story the acceptance gate wants
+        mesh_recs = [r for r in observe.flight.snapshot()
+                     if r.get("kind") == "serving_mesh"]
+        ar_per_layer = per_kind.get("all-reduce", 0) / max(n_layers, 1)
+        print(f"mesh: tp={tp_deg} over mesh {mesh_shape}, {n_requests} "
+              f"requests — {tok_s:.1f} tok/s aggregate "
+              f"({tok_s / tp_deg:.1f}/shard), TTFT p99 "
+              f"{_percentile(ttfts, 0.99):.1f} ms, decode collectives "
+              f"{per_kind or '{}'} ({ar_per_layer:g} all-reduce/layer), "
+              f"{len(mesh_recs)} serving_mesh flight records",
+              file=sys.stderr)
+        print(json.dumps({
+            "metrics_schema": METRICS_SCHEMA,
+            "metric": f"{geom} tensor-parallel (tp={tp_deg}) aggregate "
+                      f"decode tokens/s",
+            "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": 1.0,
+            "requests": n_requests, "decode_tokens": n_decode,
+            # schema-11 tensor-parallel fields
+            "mesh_shape": mesh_shape,
+            "tp_degree": tp_deg,
+            "per_shard_toks_s": round(tok_s / tp_deg, 2),
+            "ttft_ms_p50": round(_percentile(ttfts, 0.50), 2),
+            "ttft_ms_p99": round(_percentile(ttfts, 0.99), 2),
+            "kv_page_util_peak": round(stats["util_peak"], 4),
+            "census_decode_collectives": per_kind,
+            "census_decode_all_reduces_per_layer": round(ar_per_layer, 3),
+            "census_decode_pessimizations": sorted(
+                {f["kind"] for f in (dec_cens.get("findings") or [])}),
+            "flight_mesh_records": len(mesh_recs)}))
+        return
 
     # ---- shared-prefix scenario: COW prefix cache + in-graph sampling -----
     if prefix:

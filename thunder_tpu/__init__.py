@@ -761,10 +761,21 @@ class ThunderTPUFunction:
                 entry.arg_of_flat[i] = getattr(path[1], "idx", None)
         import jax as _jax
 
+        def _leaf_aval(leaf):
+            # GSPMD inputs: a leaf committed to a NamedSharding over >1 device
+            # must carry that sharding into the aval, or census lowering
+            # (`jit_obj.lower(*input_avals)`) would compile an unsharded
+            # program and miss every collective the real step executes
+            aval = _jax.ShapeDtypeStruct(
+                tuple(leaf.shape), dtypes.to_dtype(leaf.dtype).jax)
+            sh = getattr(leaf, "sharding", None)
+            if (isinstance(sh, _jax.sharding.NamedSharding)
+                    and sh.mesh.size > 1 and getattr(leaf, "_committed", True)):
+                aval = _jax.ShapeDtypeStruct(aval.shape, aval.dtype, sharding=sh)
+            return aval
+
         if all(hasattr(flat[i], "shape") for i in tensor_indices):
-            entry.input_avals = [
-                _jax.ShapeDtypeStruct(tuple(flat[i].shape), dtypes.to_dtype(flat[i].dtype).jax)
-                for i in tensor_indices]
+            entry.input_avals = [_leaf_aval(flat[i]) for i in tensor_indices]
             if uses_rng:
                 entry.input_avals.append(_jax.ShapeDtypeStruct((2,), _np.uint32))
             # transforms may thread extra runtime inputs into the trace
@@ -844,6 +855,14 @@ class ThunderTPUFunction:
                 if entry.arg_of_flat.get(fi) in donate_args)
         entry.run_fn = jax.jit(entry.computation_fn, donate_argnums=donate)
         entry.jit_obj = entry.run_fn
+        # GSPMD: when any input is committed to a multi-device mesh the jit
+        # compiles one SPMD program over it — record the device count so the
+        # census ring model and budget gates divide by the right n
+        for leaf in flat:
+            sh = getattr(leaf, "sharding", None)
+            if (isinstance(sh, jax.sharding.NamedSharding)
+                    and sh.mesh.size > getattr(entry, "n_dev", 1)):
+                entry.n_dev = sh.mesh.size
 
     @property
     def _extra_cache_key(self):

@@ -83,6 +83,11 @@ BLOCK_DECISION_KINDS = {
                      "(no adjoining MLP sub-block over the same residual "
                      "stream, mismatched eps, or an output consumed "
                      "in between); the layer keeps the two-launch form",
+    "mesh-rung-capped": "the decode program compiles over a tensor-parallel "
+                        "mesh (decode_tp_shards > 1); Pallas megakernels "
+                        "cannot auto-partition under GSPMD, so fusion is "
+                        "capped at the attention/MLP sub-block rung — one "
+                        "quarantine rung down, never per-op XLA",
 }
 
 
@@ -677,8 +682,28 @@ def block_fusion_pass(trc: TraceCtx, executors) -> TraceCtx:
         None)
     if enabled is False or not executors:
         return trc
+    tp_shards = get_compile_option(
+        "decode_tp_shards",
+        "tensor-parallel shard count of the serving mesh this program is "
+        "compiled over (>1 caps block fusion at the attention/MLP sub-block "
+        "rung: a whole-decode-layer Pallas launch cannot auto-partition "
+        "under GSPMD, so the planner falls back exactly ONE quarantine "
+        "rung, never to per-op XLA)",
+        None)
     trc = _attn_block_pass(trc, executors, enabled)
     trc = _mlp_block_pass(trc, executors, enabled)
+    if tp_shards is not None and int(tp_shards) > 1:
+        # record the cap only on traces that reached the chainable rung —
+        # an attention sub-block anchor means _decode_chain_pass would
+        # otherwise have considered the megakernel
+        if any(b.sym.id == "nn.attn_subblock" for b in trc.bound_symbols):
+            _record_block(
+                "mesh-rung-capped",
+                f"decode program compiled over a tp={int(tp_shards)} mesh: "
+                "Pallas megakernels cannot auto-partition under GSPMD; "
+                "fusion capped at the attention/MLP sub-block rung",
+                None, op="nn.decode_layer")
+        return trc
     return _decode_chain_pass(trc, executors, enabled)
 
 

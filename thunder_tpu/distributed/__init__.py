@@ -114,6 +114,13 @@ from thunder_tpu.distributed.transforms import (  # noqa: E402,F401
     tensor_parallel,
 )
 from thunder_tpu.distributed.pipeline import make_pipeline_loss  # noqa: E402,F401
+from thunder_tpu.distributed.gspmd import (  # noqa: E402,F401
+    TensorParallelMesh,
+    build_tp_mesh,
+    shard_params,
+    shard_kv_pools,
+    mesh_descriptor,
+)
 from thunder_tpu.distributed.comm_reorder import (  # noqa: E402,F401
     CommReorderTransform, sort_waits,
 )

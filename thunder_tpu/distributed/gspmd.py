@@ -1,0 +1,182 @@
+"""GSPMD tensor-parallel sharding helpers for the serving stack.
+
+The training side shards through ``DistributedFunction`` (shard_map over
+trace-level collective prims — its own cache/donation discipline). Serving
+wants the other classic surface: **commit** the persistent state (weights,
+paged KV pool) to a ``NamedSharding`` over a ``jax.sharding.Mesh`` and let
+the runner's existing ``jax.jit(..., donate_argnums)`` compile ONE SPMD
+program around those shardings (the pjit ``in_axis_resources`` /
+``donate_argnums`` surface named by ROADMAP item 1(a)). XLA's sharding
+propagation then emits exactly the Megatron collective schedule: one
+all-reduce after the attention out-projection and one after the MLP
+down-projection — 2 per layer — with the paged pool sharded by kv-head and
+never gathered.
+
+Plan (for ``(out_features, in_features)``-layout llama weights):
+
+=============  ==========================  =========================
+param          spec                        role
+=============  ==========================  =========================
+wq wk wv       ``P(axis, None)``           column-parallel (dim 0)
+w_gate w_up    ``P(axis, None)``           column-parallel (dim 0)
+wo w_down      ``P(None, axis)``           row-parallel (dim 1)
+norms, embeds  ``P()``                     replicated
+lm_head        ``P()``                     replicated (logits feed
+                                           in-graph sampling; keeping
+                                           them replicated costs zero
+                                           extra collectives)
+KV pool        ``P(axis, None, None, None)``  kv-head sharded (dim 0)
+=============  ==========================  =========================
+
+Step inputs (tokens, block tables, lengths, sampling rows) stay uncommitted
+host arrays — JAX replicates them, and the scalar-prefetch block-table
+gather indexes the *page* axis, which is unsharded on every shard.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+__all__ = [
+    "TensorParallelMesh",
+    "build_tp_mesh",
+    "tp_param_sharding",
+    "shard_params",
+    "shard_kv_pools",
+    "replicate",
+    "leaf_tp_degree",
+    "mesh_descriptor",
+]
+
+
+@dataclass(frozen=True)
+class TensorParallelMesh:
+    """A 1-D tensor-parallel mesh plus the param-classification patterns.
+
+    Hashable + picklable on purpose: this is the object that rides inside
+    the typed restart state (``serving.errors.RestartState``) so a
+    post-crash rebuild recreates shardings, not just shapes.
+    """
+
+    tp: int
+    axis: str = "tp"
+    column_patterns: tuple = ()
+    row_patterns: tuple = ()
+
+    def __post_init__(self):
+        if self.tp < 1:
+            raise ValueError(f"tp degree must be >= 1, got {self.tp}")
+
+    # -- lazy jax objects ---------------------------------------------------
+    def mesh(self):
+        return build_tp_mesh(self.tp, axis=self.axis)
+
+    def named_sharding(self, spec):
+        import jax
+
+        return jax.sharding.NamedSharding(self.mesh(), spec)
+
+    def pool_spec(self):
+        """Paged pool ``(kv_heads, num_pages, page_size, head_dim)``:
+        shard the kv-head dim, leave page geometry whole per shard."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.axis, None, None, None)
+
+    def describe(self) -> dict:
+        return {"axis": self.axis, "tp": self.tp,
+                "mesh_shape": [self.tp]}
+
+
+def build_tp_mesh(tp: int, *, axis: str = "tp"):
+    """A 1-D mesh over the first ``tp`` local devices."""
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    if tp > len(devs):
+        raise ValueError(
+            f"tp={tp} exceeds available devices ({len(devs)}); on CPU run "
+            "with --xla_force_host_platform_device_count")
+    return jax.sharding.Mesh(np.array(devs[:tp]), (axis,))
+
+
+def tp_param_sharding(tpm: TensorParallelMesh, pathstr: str, ndim: int):
+    """NamedSharding for one param leaf, classified by key-path pattern
+    (same ``jtu.keystr`` convention as ``DistributedFunction``'s planner)."""
+    from jax.sharding import PartitionSpec as P
+
+    col = any(re.search(p, pathstr) for p in tpm.column_patterns)
+    row = any(re.search(p, pathstr) for p in tpm.row_patterns)
+    if col and ndim >= 1:
+        spec = P(*((tpm.axis,) + (None,) * (ndim - 1)))
+    elif row and ndim >= 2:
+        spec = P(*((None,) * (ndim - 1) + (tpm.axis,)))
+    else:
+        spec = P()
+    return tpm.named_sharding(spec)
+
+
+def shard_params(params, tpm: TensorParallelMesh):
+    """Commit a param pytree to the TP plan (device_put with NamedSharding).
+
+    Column/row-classified leaves must divide by ``tp`` on the sharded dim —
+    violations raise ``ValueError`` here (typed, pre-XLA) rather than as an
+    opaque partitioner error at compile time.
+    """
+    import jax
+    import jax.tree_util as jtu
+
+    flat_with_paths, treedef = jtu.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat_with_paths:
+        pathstr = jtu.keystr(path)
+        ndim = len(getattr(leaf, "shape", ()))
+        ns = tp_param_sharding(tpm, pathstr, ndim)
+        spec = ns.spec
+        for d, ax in enumerate(spec):
+            if ax == tpm.axis and leaf.shape[d] % tpm.tp != 0:
+                raise ValueError(
+                    f"param {pathstr} dim {d} ({leaf.shape[d]}) not divisible "
+                    f"by tp={tpm.tp}")
+        out.append(jax.device_put(leaf, ns))
+    return jtu.tree_unflatten(treedef, out)
+
+
+def shard_kv_pools(pools, tpm: TensorParallelMesh):
+    """Commit per-layer ``{"k": ..., "v": ...}`` paged pools to the kv-head
+    sharding. Divisibility is validated by ``PagedKVCache`` (typed
+    ``ShardingGeometryError``) before the arrays exist."""
+    import jax
+
+    ns = tpm.named_sharding(tpm.pool_spec())
+    return [{k: jax.device_put(v, ns) for k, v in layer.items()}
+            for layer in pools]
+
+
+def replicate(tree, tpm: TensorParallelMesh):
+    import jax
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec as P
+
+    ns = tpm.named_sharding(P())
+    return jtu.tree_map(lambda x: jax.device_put(x, ns), tree)
+
+
+def leaf_tp_degree(leaf) -> int:
+    """Mesh size a leaf is committed over (1 for host / single-device)."""
+    import jax
+
+    sh = getattr(leaf, "sharding", None)
+    if isinstance(sh, jax.sharding.NamedSharding):
+        return int(sh.mesh.size)
+    return 1
+
+
+def mesh_descriptor(tpm) -> dict:
+    """JSON-safe mesh stamp for flight-recorder events and bench metrics."""
+    if tpm is None:
+        return {"mesh_shape": [1], "tp_degree": 1}
+    d = tpm.describe()
+    return {"mesh_shape": list(d["mesh_shape"]), "tp_degree": int(d["tp"])}

@@ -242,6 +242,24 @@ def _scatter_add(a, indices, value, dim):
 
 @impl(PrimIDs.SCATTER)
 def _scatter(a, indices, value, dim):
+    if all(indices.shape[d] == a.shape[d]
+           for d in range(a.ndim) if d != dim):
+        # full non-dim coverage (the serving K/V row-write shape): lower as
+        # a vmapped 1-D scatter so XLA sees the non-dim axes as scatter
+        # BATCHING dims. Semantically identical to the generic form below,
+        # but under GSPMD the partitioner keeps a batching dim sharded —
+        # the generic all-dims index form forces it to all-gather the
+        # updates + iota indices across a sharded kv-head axis (2 gathers
+        # per pool write on the tensor-parallel decode path)
+        import jax
+
+        a2 = jnp.moveaxis(a, dim, -1)
+        i2 = jnp.moveaxis(indices, dim, -1)
+        v2 = jnp.moveaxis(value, dim, -1)
+        f = lambda ar, ir, vr: ar.at[ir].set(vr)  # noqa: E731
+        for _ in range(a2.ndim - 1):
+            f = jax.vmap(f)
+        return jnp.moveaxis(f(a2, i2, v2), -1, dim)
     idx = list(jnp.indices(indices.shape, sparse=True))
     idx[dim] = indices
     return a.at[tuple(idx)].set(value)

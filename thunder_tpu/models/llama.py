@@ -49,6 +49,10 @@ CONFIGS = {
                         intermediate_size=176, max_seq_len=256),
     "tiny-gqa": LlamaConfig(name="tiny-gqa", vocab_size=512, dim=64, n_layers=4, n_heads=4,
                             n_kv_heads=2, intermediate_size=176, max_seq_len=256),
+    # 8-way tensor-parallel smoke scale: every sharded dim (heads, kv heads,
+    # intermediate) divides by 8, so the CPU 8-device mesh splits it cleanly
+    "tiny-tp": LlamaConfig(name="tiny-tp", vocab_size=512, dim=64, n_layers=4, n_heads=8,
+                           n_kv_heads=8, intermediate_size=192, max_seq_len=256),
     "llama2-7b": LlamaConfig(name="llama2-7b", vocab_size=32000, dim=4096, n_layers=32,
                              n_heads=32, intermediate_size=11008, max_seq_len=4096,
                              dtype=dtypes.bfloat16),

@@ -537,6 +537,12 @@ def ensure(stats, *, fn_name: str = "", th: dict | None = None) -> dict | None:
         # so the decode-launch-growth finding regenerates on every ensure,
         # not only at bind time
         ctx = getattr(stats, "census_context", None) or {}
+        # tensor-parallel serving runners stamp their mesh descriptor into
+        # the context: surface it on the census itself so postmortems and
+        # bench metrics read mesh shape from the same record as collectives
+        for key in ("mesh_shape", "tp_degree"):
+            if ctx.get(key) is not None:
+                census.setdefault(key, ctx[key])
         layers = ctx.get("decode_layers")
         if layers and census.get("launches_per_layer") is None:
             census["launches_per_layer"] = \

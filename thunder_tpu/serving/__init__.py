@@ -53,7 +53,9 @@ from thunder_tpu.serving.errors import (  # noqa: F401
     EngineStallError,
     InfeasibleRequest,
     RestartBudgetExceeded,
+    RestartState,
     ServingError,
+    ShardingGeometryError,
 )
 from thunder_tpu.serving.kv_cache import (  # noqa: F401
     OutOfPages,

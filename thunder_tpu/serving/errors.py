@@ -29,9 +29,44 @@ somebody". The hierarchy:
 - :class:`RestartBudgetExceeded` — the supervisor's sliding-window restart
   budget ran out; the engine is failing faster than restarts can honestly
   mask, so the failure escalates to the caller.
+- :class:`ShardingGeometryError` — the paged-pool geometry cannot be
+  sharded over the requested mesh (kv-head count not divisible by the
+  mesh axis size); raised at pool-construction time so a bad split fails
+  typed instead of as an opaque XLA partitioner error. Subclasses
+  ``ValueError`` too: it is a configuration bug.
+
+:class:`RestartState` is not an error: it is the typed record of what a
+post-crash rebuild must reproduce — pool geometry, dtype, AND the mesh /
+sharding plan — carried on :class:`EngineFault` so the supervisor's
+restart is sharding-identical, not just shape-identical.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass(frozen=True)
+class RestartState:
+    """Everything a rebuild-after-crash needs to recreate the KV pool
+    exactly: geometry + dtype (shape identity) and the tensor-parallel
+    mesh (sharding identity — ``None`` for single-device engines)."""
+
+    geometry: Any
+    dtype: Any
+    mesh: Any = None
+
+    def describe(self) -> dict:
+        d = {"n_layers": self.geometry.n_layers,
+             "kv_heads": self.geometry.kv_heads,
+             "num_pages": self.geometry.num_pages,
+             "tp_degree": 1, "mesh_shape": [1]}
+        if self.mesh is not None:
+            md = self.mesh.describe()
+            d["tp_degree"] = int(md["tp"])
+            d["mesh_shape"] = list(md["mesh_shape"])
+        return d
 
 
 class ServingError(RuntimeError):
@@ -68,9 +103,11 @@ class EngineFault(ServingError):
     restart — pool rebuild plus re-prefill of in-flight requests — can
     recover. Carries the dispatch ``domain`` that escalated."""
 
-    def __init__(self, message: str, *, domain: str = ""):
+    def __init__(self, message: str, *, domain: str = "",
+                 restart_state: RestartState | None = None):
         super().__init__(message)
         self.domain = domain
+        self.restart_state = restart_state
 
 
 class EngineStallError(ServingError):
@@ -90,3 +127,13 @@ class RestartBudgetExceeded(ServingError):
         super().__init__(message)
         self.in_window = in_window
         self.max_restarts = max_restarts
+
+
+class ShardingGeometryError(ServingError, ValueError):
+    """The paged-pool geometry cannot be split over the mesh: the kv-head
+    count must be divisible by the tensor-parallel axis size."""
+
+    def __init__(self, message: str, *, kv_heads: int = 0, tp: int = 0):
+        super().__init__(message)
+        self.kv_heads = kv_heads
+        self.tp = tp

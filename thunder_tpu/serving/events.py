@@ -41,6 +41,8 @@ EVENT_KINDS = frozenset({
     "serving_cache_evict",          # allocator reclaimed parked prefix-cache
     #                                 pages (trie subtree dropped)
     # engine lifecycle / supervision
+    "serving_mesh",                 # tensor-parallel mesh committed (build /
+    #                                 rebuild): mesh_shape + tp_degree
     "serving_decode_bind",          # decode program (re)bound; launch shape
     "serving_decode_rebind",        # re-bind forced by a quarantine-epoch move
     "serving_admission_fault",      # contained admission-domain fault

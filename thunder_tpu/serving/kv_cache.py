@@ -63,17 +63,37 @@ class PagedKVCache:
     stores the returned updated pools back via :meth:`update_pools`.
     """
 
-    def __init__(self, geometry: PageGeometry, dtype):
+    def __init__(self, geometry: PageGeometry, dtype, *, sharding=None):
         import jax.numpy as jnp
 
         g = geometry
         if g.num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if sharding is not None and getattr(sharding, "tp", 1) > 1:
+            if g.kv_heads % sharding.tp != 0:
+                from thunder_tpu.serving.errors import ShardingGeometryError
+
+                raise ShardingGeometryError(
+                    f"kv_heads={g.kv_heads} not divisible by mesh axis "
+                    f"'{sharding.axis}' size {sharding.tp}: the paged pool "
+                    "is sharded by kv-head, so each shard must own a whole "
+                    "number of heads", kv_heads=g.kv_heads, tp=sharding.tp)
         self.geometry = g
         self.dtype = dtype
+        # sharding: a distributed.gspmd.TensorParallelMesh (or None). The
+        # pool keeps its GLOBAL logical shape — GSPMD splits the kv-head dim
+        # across the mesh, so per-shard geometry is (kv_heads/tp, ...) while
+        # block tables and the free list stay global (the page axis is whole
+        # on every shard).
+        self.sharding = sharding if (sharding is not None
+                                     and getattr(sharding, "tp", 1) > 1) else None
         shape = (g.kv_heads, g.num_pages, g.page_size, g.head_dim)
         self.pools = [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
                       for _ in range(g.n_layers)]
+        if self.sharding is not None:
+            from thunder_tpu.distributed.gspmd import shard_kv_pools
+
+            self.pools = shard_kv_pools(self.pools, self.sharding)
         # LIFO free list: recently-freed pages are re-served first (their
         # pool region is likeliest still warm in any cache hierarchy); the
         # mirror set keeps free()'s double-free check O(1) per page (a list

@@ -100,11 +100,12 @@ class PagedLlamaRunner:
 
     def __init__(self, cfg, geometry, *, n_layers: int | None = None,
                  executors=None, block_fusion=None,
-                 launch_budget_per_layer: float | None = None):
+                 launch_budget_per_layer: float | None = None, mesh=None):
         import thunder_tpu as tt
 
         self.cfg = cfg
         self.geom = geometry
+        self.mesh = mesh  # distributed.gspmd.TensorParallelMesh or None
         self.n_layers = n_layers if n_layers is not None else cfg.n_layers
         # decode-launch budget: when set (via census_context below), a
         # decode program dispatching more Pallas launches per layer per
@@ -118,6 +119,14 @@ class PagedLlamaRunner:
         # whole-decode-layer megakernel whenever an executor claims it);
         # True/False force/disable — tests and A/Bs use both
         opts = {} if block_fusion is None else {"block_fusion": block_fusion}
+        # tensor-parallel mesh: the step inputs (params, pools) arrive
+        # COMMITTED to NamedShardings, so the whole-program jit compiles one
+        # SPMD program around them. Pallas launches cannot auto-partition
+        # under GSPMD, so the planner caps block fusion ONE rung below the
+        # whole-decode-layer megakernel (attention/MLP sub-blocks still
+        # plan) — never silently down to per-op XLA
+        if mesh is not None and getattr(mesh, "tp", 1) > 1:
+            opts["decode_tp_shards"] = int(mesh.tp)
         # one jitted fn each; distinct chunk shapes become distinct cache
         # entries inside the ThunderTPUFunction (bounded by the ladder)
         self.decode_jit = tt.jit(self._decode_fn, executors=executors,
@@ -134,6 +143,12 @@ class PagedLlamaRunner:
             "decode_layers": self.n_layers,
             "decode_launches_per_layer_max": launch_budget_per_layer,
         }
+        if mesh is not None and getattr(mesh, "tp", 1) > 1:
+            from thunder_tpu.distributed.gspmd import mesh_descriptor
+
+            md = mesh_descriptor(mesh)
+            self.decode_jit._stats.census_context.update(md)
+            self.prefill_jit._stats.census_context = dict(md)
 
     # -- traced bodies ------------------------------------------------------
     def _attn_block(self, h, layer, q, block_tables, lengths, pools_kv):

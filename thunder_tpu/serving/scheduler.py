@@ -99,6 +99,8 @@ from thunder_tpu.serving.errors import (
     EngineFault,
     EngineStallError,
     InfeasibleRequest,
+    RestartState,
+    ShardingGeometryError,
 )
 from thunder_tpu.serving.kv_cache import OutOfPages, PagedKVCache, PageGeometry
 from thunder_tpu.serving.prefix_cache import PrefixCache
@@ -113,6 +115,31 @@ QUEUED, PREFILL, DECODE, DONE, SHED = \
 # warm engine and a timed engine must not interleave two "request 0"s on
 # one timeline
 _REQUEST_IDS = itertools.count()
+
+
+def _as_tp_mesh(mesh, cfg):
+    """Normalize the engine's ``mesh=`` argument (None, an int tp degree,
+    or a ``TensorParallelMesh``) and validate the model config against it
+    with typed errors — a bad split must fail HERE, not as an opaque XLA
+    partitioner error three layers down."""
+    if mesh is None:
+        return None
+    from thunder_tpu.distributed.gspmd import TensorParallelMesh
+    from thunder_tpu.models.llama import TP_COLUMN_PATTERNS, TP_ROW_PATTERNS
+
+    if isinstance(mesh, int):
+        mesh = TensorParallelMesh(tp=mesh,
+                                  column_patterns=TP_COLUMN_PATTERNS,
+                                  row_patterns=TP_ROW_PATTERNS)
+    if mesh.tp <= 1:
+        return None
+    for name, n in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
+                    ("intermediate_size", cfg.intermediate_size)):
+        if n % mesh.tp != 0:
+            raise ShardingGeometryError(
+                f"config {cfg.name}: {name}={n} not divisible by "
+                f"tp={mesh.tp}", kv_heads=cfg.kv_heads, tp=mesh.tp)
+    return mesh
 
 
 @dataclass(eq=False)  # identity semantics: requests live in slot lists
@@ -204,7 +231,19 @@ class ServingEngine:
                  max_queue: int | None = None, executors=None,
                  retry_policy=None, block_fusion=None,
                  prefix_cache: bool = False,
-                 launch_budget_per_layer: float | None = None):
+                 launch_budget_per_layer: float | None = None,
+                 mesh=None):
+        # tensor-parallel serving (GSPMD): `mesh` is an int tp degree or a
+        # distributed.gspmd.TensorParallelMesh. Params are committed to the
+        # Megatron column/row plan, the paged pool is sharded by kv-head,
+        # and the runner's jitted step compiles ONE SPMD program around the
+        # committed shardings (donation preserved — in/out pool shardings
+        # match). Step inputs stay host arrays (replicated).
+        self.mesh = _as_tp_mesh(mesh, cfg)
+        if self.mesh is not None:
+            from thunder_tpu.distributed.gspmd import shard_params
+
+            params = shard_params(params, self.mesh)
         self.params = params
         self.cfg = cfg
         n_layers_eff = n_layers if n_layers is not None else cfg.n_layers
@@ -237,14 +276,25 @@ class ServingEngine:
             page_size=page_size, num_pages=int(num_pages),
             pages_per_request=pages_per_req)
         self.geom = geometry
-        self.cache = PagedKVCache(geometry, cfg.dtype.jax)
+        # the typed restart state: everything a supervisor rebuild needs to
+        # recreate the pool EXACTLY — geometry + dtype + mesh — carried on
+        # every EngineFault so recovery is sharding-identical
+        self._restart_state = RestartState(
+            geometry=geometry, dtype=cfg.dtype.jax, mesh=self.mesh)
+        self.cache = PagedKVCache(geometry, cfg.dtype.jax, sharding=self.mesh)
         # cross-request prefix cache (opt-in): completed prompts donate
         # their full pages into a token trie; admission probes it
         self.prefix = PrefixCache(self.cache) if prefix_cache else None
         self.runner = PagedLlamaRunner(
             cfg, geometry, n_layers=n_layers, executors=executors,
             block_fusion=block_fusion,
-            launch_budget_per_layer=launch_budget_per_layer)
+            launch_budget_per_layer=launch_budget_per_layer, mesh=self.mesh)
+        if self.mesh is not None:
+            from thunder_tpu.distributed.gspmd import mesh_descriptor
+
+            md = mesh_descriptor(self.mesh)
+            _observe.set_gauge("serving.tp_degree", md["tp_degree"])
+            _observe.event("serving_mesh", phase="build", **md)
         self.max_slots = int(max_slots)
         self.max_queue = max_queue
         self.slots: list[Request | None] = [None] * self.max_slots
@@ -461,14 +511,27 @@ class ServingEngine:
                 request_id=req.request_id))
         return victims
 
-    def rebuild_after_fault(self) -> list[Request]:
+    def rebuild_after_fault(self, restart_state: RestartState | None = None) \
+            -> list[Request]:
         """Crash recovery (the supervisor's restart rung): discard the
         consumed device pools, build fresh ones, drop the stale decode
         binding, and re-queue every in-flight request for recompute-on-
         resume re-prefill — the same discipline as ``_preempt``, so
         surviving outputs stay token-identical to a fault-free run. The
         compiled prefill/decode programs survive (same shapes, same cache
-        entries); only the pools and the binding are rebuilt."""
+        entries); only the pools and the binding are rebuilt.
+
+        ``restart_state`` (the typed record the fault carried) must match
+        this engine's own — the supervisor passes it back so a rebuild is
+        provably SHARDING-identical, not just shape-identical; a mismatch
+        is a lifecycle bug and raises ``ShardingGeometryError``."""
+        if restart_state is not None \
+                and restart_state != self._restart_state:
+            raise ShardingGeometryError(
+                "restart state mismatch: the fault's recorded pool spec "
+                f"{restart_state.describe()} != this engine's "
+                f"{self._restart_state.describe()}; rebuilding from it "
+                "would not be sharding-identical")
         residents = sorted((r for r in self.slots if r is not None),
                            key=lambda r: r.admit_seq, reverse=True)
         for req in residents:
@@ -484,7 +547,18 @@ class ServingEngine:
             req.restarts += 1
             self.queue.appendleft(req)  # reverse admit order -> FIFO resume
             self._phase_begin(req, QUEUED)
-        self.cache = PagedKVCache(self.geom, self.cfg.dtype.jax)
+        # rebuild from the typed restart state — geometry, dtype, AND mesh —
+        # so a tensor-parallel engine's fresh pools come back committed to
+        # the same NamedShardings the compiled SPMD step was built around
+        # (geometry alone would rebuild an unsharded pool and the next
+        # dispatch would recompile or crash)
+        rs = self._restart_state
+        self.cache = PagedKVCache(rs.geometry, rs.dtype, sharding=rs.mesh)
+        if self.mesh is not None:
+            from thunder_tpu.distributed.gspmd import mesh_descriptor
+
+            _observe.event("serving_mesh", phase="rebuild",
+                           **mesh_descriptor(self.mesh))
         if self.prefix is not None:
             # the trie's pages died with the consumed pools: start a fresh
             # cache attached to the rebuilt allocator (re-donation refills
@@ -555,6 +629,7 @@ class ServingEngine:
             "block_table_rows_live": int((self._np_bt != 0).any(1).sum()),
             "quiescence": quiescence,
             "slo": {"attained": self._slo_attained, "total": self._slo_total},
+            "mesh": self._restart_state.describe(),
         }
 
     # -- scheduling internals -----------------------------------------------
@@ -776,8 +851,8 @@ class ServingEngine:
                 raise EngineFault(
                     f"{domain} dispatch consumed the donated page pools; "
                     f"in-place retry is impossible — supervisor restart "
-                    f"(pool rebuild + re-prefill) required", domain=domain) \
-                    from e
+                    f"(pool rebuild + re-prefill) required", domain=domain,
+                    restart_state=self._restart_state) from e
             raise
 
     def _prefill_one(self) -> bool:

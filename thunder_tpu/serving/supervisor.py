@@ -320,7 +320,12 @@ class EngineSupervisor:
             self.dump_postmortem(err)
             raise err from cause
         t0 = time.perf_counter()
-        recovered = self.engine.rebuild_after_fault()
+        # hand the fault's typed restart state back to the engine: the
+        # rebuild must reproduce the EXACT pool spec the crashed dispatch
+        # ran against — geometry, dtype, and the tensor-parallel mesh —
+        # not just shapes re-derived from geometry
+        recovered = self.engine.rebuild_after_fault(
+            getattr(cause, "restart_state", None))
         self.restarts += 1
         _observe.inc("serving.engine_restarts")
         _observe.event("serving_engine_restart", cause=repr(cause),
