@@ -45,6 +45,11 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 12: bench_serve stamps engine-labeled/fleet fields (engine_id on every
+# serving line, with gauge-sourced numbers read from the TIMED engine's
+# labeled series instead of the process-global gauge any co-resident
+# engine may have clobbered; fleet_engines / fleet_health /
+# fleet_slo_attainment from the FleetObservatory over the timed engine);
 # 11: bench_serve --mesh stamps the tensor-parallel serving scenario
 # (mesh_shape / tp_degree / per_shard_toks_s next to the aggregate
 # tokens/s and TTFT percentiles, plus the meshed decode program's census
@@ -72,7 +77,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 11
+METRICS_SCHEMA = 12
 
 
 def main():

@@ -60,6 +60,15 @@ value — nonzero IS the regression), ``census_decode_hlo_fusions``,
 guarded ``census_decode_errors``, and any sentinel
 ``census_decode_pessimizations`` kinds.
 
+Schema 12: every engine-backed JSON line stamps the engine's
+process-unique ``engine_id``, gauge-sourced numbers come off the TIMED
+engine's **labeled** series (``eng.obs.snapshot()`` — immune to
+last-writer-wins clobbering when warm pools, baselines, or sibling
+engines share the process registry), and the continuous line adds the
+fleet view (``fleet_engines`` / ``fleet_health`` /
+``fleet_slo_attainment``) from a post-timing ``FleetObservatory`` check
+over the timed engine.
+
 ``--mesh`` runs the TENSOR-PARALLEL scenario: the engine builds over a
 ``SERVE_TP``-way (default 8) 1-D mesh — column/row-sharded weights,
 kv-head-sharded paged pool, replicated activations — and the schema-11
@@ -251,6 +260,7 @@ def main():
               file=sys.stderr)
         print(json.dumps({
             "metrics_schema": METRICS_SCHEMA,
+            "engine_id": eng.engine_id,
             "metric": f"{geom} tensor-parallel (tp={tp_deg}) aggregate "
                       f"decode tokens/s",
             "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": 1.0,
@@ -370,6 +380,7 @@ def main():
               file=sys.stderr)
         print(json.dumps({
             "metrics_schema": METRICS_SCHEMA,
+            "engine_id": eng.engine_id,
             "metric": f"{geom} shared-prefix warm/cold TTFT p50 speedup "
                       f"({sys_tokens}-token system prompt)",
             "value": round(cold_p50 / warm_p50, 2), "unit": "x",
@@ -430,12 +441,14 @@ def main():
         sup.drain()                     # stamps serving.drain_ms; engine idle
         wall = time.perf_counter() - t0
         eng.assert_quiescent()          # leak audit: overload must not leak
-        snap = observe.snapshot()
+        # the TIMED engine's labeled series (schema 12): a sibling engine
+        # or warm pool sharing the registry cannot clobber these reads
+        esnap = eng.obs.snapshot()
         done = [r for r in accepted if r.done]
         late = sum(1 for r in done if r.deadline_at is not None
                    and r.finished_s > r.deadline_at)
         shed_total = len(eng.shed)      # queue/priority shed + rejected
-        slo = snap["gauges"].get("serving.slo_attainment", float("nan"))
+        slo = esnap["gauges"].get("serving.slo_attainment", float("nan"))
         tok_s = sum(len(r.generated) for r in done) / wall
         print(f"overload: {n_requests} offered at {rate:g}/s, queue bound "
               f"{qbound}: {len(done)} completed, {shed_total} shed "
@@ -443,6 +456,7 @@ def main():
               f"{tok_s:.1f} tok/s aggregate", file=sys.stderr)
         print(json.dumps({
             "metrics_schema": METRICS_SCHEMA,
+            "engine_id": eng.engine_id,
             "metric": f"{geom} overload slo_attainment "
                       f"(rate>capacity, deadline {deadline:g}s)",
             "value": round(slo, 4), "unit": "ratio", "vs_baseline": 1.0,
@@ -452,7 +466,7 @@ def main():
             "shed_rate": round(shed_total / n_requests, 4),
             "deadline_miss_rate": round(late / max(1, len(done)), 4),
             "slo_attainment": round(slo, 4),
-            "engine_restarts": int(snap["counters"].get(
+            "engine_restarts": int(esnap["counters"].get(
                 "serving.engine_restarts", 0)),
             "tokens_per_s": round(tok_s, 1)}))
         trace_path = os.environ.get("SERVE_TRACE")
@@ -524,13 +538,16 @@ def main():
     eng.drain()
     # decode fusion shape, published by the runner at bind time from the
     # compiled program's executor assignments (registry gauges, NOT trace
-    # grepping) — captured here because the timed rounds reset the registry.
+    # grepping) — captured here because the timed rounds reset the registry,
+    # and read off the TIMED engine's LABELED series (schema 12): the
+    # process-wide gauge is last-writer-wins, so any sibling engine binding
+    # later in this process would clobber it silently.
     # decode_layer_fusions counts whole-decode-layer megakernel claims;
     # launches is the Pallas dispatch count of ONE decode step (one token
     # across the whole batch). 0/0 on stacks where Pallas is unavailable
     # (e.g. this CPU smoke) — the decode trace then runs the XLA
     # decomposition and the stamped shape says so.
-    snap0 = observe.snapshot()
+    snap0 = eng.obs.snapshot()
     decode_layer_fusions = int(snap0["gauges"].get(
         "serving.decode_layer_fusions", 0))
     decode_launches = int(snap0["gauges"].get(
@@ -600,6 +617,16 @@ def main():
     # pays the census's one memoized AOT compile (observe.census).
     dec_cens = tt.compile_stats(eng.runner.decode_jit).last_census or {}
     dec_async = dec_cens.get("async") or {}
+    # fleet view (schema 12): wrap the timed engine in a supervisor +
+    # FleetObservatory AFTER timing (the health check is pure attribute
+    # reads — no traffic, no steps) so the line carries the same verdict a
+    # production observatory would compute from this engine's state
+    from thunder_tpu.serving import EngineSupervisor, FleetObservatory
+
+    fleet = FleetObservatory()
+    fleet.add(EngineSupervisor(eng))
+    fleet_health = fleet.check()
+    fleet_slo = fleet.slo_attainment()
 
     seq_tps = total_tokens / seq_wall
     wall = cont["wall"]
@@ -624,6 +651,7 @@ def main():
         "requests": n_requests, "decode_tokens": n_decode}))
     print(json.dumps({
         "metrics_schema": METRICS_SCHEMA,
+        "engine_id": eng.engine_id,
         "metric": f"{geom} continuous batching aggregate decode tokens/s",
         "value": round(cont_tps, 1), "unit": "tokens/s",
         "vs_baseline": round(cont_tps / seq_tps, 4),
@@ -654,7 +682,12 @@ def main():
         "census_decode_hlo_fusions": int(dec_cens.get("hlo_fusions", 0)),
         "census_decode_errors": int(dec_cens.get("census_errors", 0)),
         "census_decode_pessimizations": sorted(
-            {f["kind"] for f in (dec_cens.get("findings") or [])})}))
+            {f["kind"] for f in (dec_cens.get("findings") or [])}),
+        # schema-12 fleet view (post-timing FleetObservatory check)
+        "fleet_engines": len(fleet_health),
+        "fleet_health": fleet_health,
+        "fleet_slo_attainment": (None if fleet_slo is None
+                                 else round(fleet_slo, 4))}))
 
     if trace_path:
         with open(trace_path, "w") as f:

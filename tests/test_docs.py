@@ -134,6 +134,33 @@ def test_serving_event_kinds_documented():
         f"registers: {stale}")
 
 
+def test_health_states_documented():
+    """The health-state vocabulary is the routing contract: a router keys
+    its traffic decisions on these names (and the ``serving.health_state``
+    gauge on their codes), so the docs table and
+    ``serving.HEALTH_STATES`` must agree in BOTH directions — same
+    discipline as the block-planner decision kinds."""
+    from thunder_tpu.serving import HEALTH_STATES
+    from thunder_tpu.serving.health import HEALTH_STATE_CODE
+
+    assert HEALTH_STATES, "serving lost its health-state vocabulary"
+    # the gauge codes are table positions — reordering silently rewires
+    # every dashboard threshold, so the mapping is pinned here too
+    assert HEALTH_STATE_CODE == {s: i for i, s in enumerate(HEALTH_STATES)}
+    with open(DOC) as f:
+        doc = f.read()
+    table_states = set(re.findall(r"^\| `([A-Z]+)` \|", doc, re.M))
+    assert table_states, "docs lost the serving health-states table"
+    undocumented = sorted(set(HEALTH_STATES) - table_states)
+    assert not undocumented, (
+        "health states in serving.HEALTH_STATES but missing from the docs "
+        f"health-states table (docs/zero_to_thunder_tpu.md): {undocumented}")
+    stale = sorted(table_states - set(HEALTH_STATES))
+    assert not stale, (
+        "docs health-states table documents states the code no longer "
+        f"defines: {stale}")
+
+
 def test_census_metric_names_documented():
     """Every ``compile.*`` / ``hlo.*`` metric name the code emits must
     appear in the docs' census metrics table, and every name the table

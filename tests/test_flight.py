@@ -297,6 +297,38 @@ def test_flight_trace_dict_works_registry_off(model):
     assert {"X", "i", "C", "M"} <= phs
 
 
+def test_flight_trace_groups_engines_into_processes_registry_off(model):
+    """Two engines sharing the one always-on ring render as SEPARATE
+    Perfetto process groups (registry off — labels ride the ring records):
+    each engine gets its own process_name meta, and every labeled event
+    lands under its engine's synthetic pid, not the shared base pid."""
+    cfg, params = model
+    e0, e1 = _engine(params, cfg), _engine(params, cfg)
+    rng = np.random.RandomState(0)
+    for eng in (e0, e1):
+        eng.submit(rng.randint(1, cfg.vocab_size, size=9).astype(np.int32), 2)
+        eng.drain()
+    assert not observe.is_enabled()
+    trace = observe.flight_trace_dict()
+    json.dumps(trace)
+    metas = [e for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    names = {m["args"]["name"]: m["pid"] for m in metas}
+    assert f"thunder_tpu engine {e0.engine_id}" in names
+    assert f"thunder_tpu engine {e1.engine_id}" in names
+    pid0 = names[f"thunder_tpu engine {e0.engine_id}"]
+    pid1 = names[f"thunder_tpu engine {e1.engine_id}"]
+    assert pid0 != pid1
+    # each engine's lifecycle events live under ITS process group
+    for pid, eng in ((pid0, e0), (pid1, e1)):
+        evs = [e for e in trace["traceEvents"]
+               if e.get("ph") == "i" and e.get("pid") == pid]
+        assert any(e["name"] == "serving_submitted" for e in evs)
+    # counter tracks split per engine too (queue depth per process)
+    cnt_pids = {e["pid"] for e in trace["traceEvents"] if e.get("ph") == "C"}
+    assert {pid0, pid1} <= cnt_pids
+
+
 # ---------------------------------------------------------------------------
 # postmortem bundles
 # ---------------------------------------------------------------------------

@@ -403,6 +403,176 @@ def test_exports_roundtrip_non_jsonable_field_values(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# labeled series (engine-scoped telemetry)
+# ---------------------------------------------------------------------------
+
+def test_labeled_dual_writes_and_keeps_series_disjoint():
+    """A labeled write updates BOTH stores: the unlabeled rollup (counters
+    summed, gauges last-writer-wins) and the per-label-set series — and
+    two label sets never collide."""
+    observe.enable(clear=True)
+    a = observe.labeled(engine="e0")
+    b = observe.labeled(engine="e1")
+    a.inc("serving.shed_requests", 2)
+    b.inc("serving.shed_requests", 3)
+    a.set_gauge("serving.queue_depth", 5)
+    b.set_gauge("serving.queue_depth", 1)
+    a.observe_value("serving.ttft_ms", 4.0)
+    sa, sb = a.snapshot(), b.snapshot()
+    assert sa["counters"]["serving.shed_requests"] == 2
+    assert sb["counters"]["serving.shed_requests"] == 3
+    assert sa["gauges"]["serving.queue_depth"] == 5
+    assert sb["gauges"]["serving.queue_depth"] == 1
+    assert sa["histograms"]["serving.ttft_ms"]["count"] == 1
+    assert "serving.ttft_ms" not in sb["histograms"]
+    snap = observe.snapshot()
+    assert snap["counters"]["serving.shed_requests"] == 5   # summed
+    assert snap["gauges"]["serving.queue_depth"] == 1       # last writer
+    assert observe.engines_seen() == ["e0", "e1"]
+    # label order never forks a series: kwargs freeze to one sorted key
+    observe.labeled(b="2", a="1").inc("x")
+    observe.labeled(a="1", b="2").inc("x")
+    labeled_x = [r for r in observe.snapshot()["labeled"]["counters"]
+                 if r["name"] == "x"]
+    assert len(labeled_x) == 1 and labeled_x[0]["value"] == 2.0
+
+
+def test_labeled_requires_at_least_one_label():
+    with pytest.raises(ValueError):
+        observe.labeled()
+
+
+def test_labeled_disabled_noop_registry_but_ring_records_labels():
+    """Disabled gating matches the module entry points exactly — labeled
+    counters/histograms are dropped, while labeled gauge moves, events,
+    and span edges still reach the always-on ring WITH their label dict."""
+    from thunder_tpu.observe import flight
+
+    flight.clear()
+    try:
+        rec = observe.labeled(engine="e7")
+        rec.inc("c")
+        rec.observe_value("h", 1.0)
+        rec.set_gauge("serving.queue_depth", 2)
+        rec.event("serving_shed", request=1, reason="x")
+        with rec.span("work", cat="serving:sched"):
+            pass
+        snap = observe.snapshot()
+        assert snap["counters"] == {} and snap["gauges"] == {}
+        assert snap["labeled"] == {"counters": [], "gauges": [],
+                                   "histograms": []}
+        ring = flight.snapshot()
+        assert {r["type"] for r in ring} == {"gauge", "event", "span"}
+        assert all(r["labels"] == {"engine": "e7"} for r in ring)
+    finally:
+        flight.clear()
+
+
+def test_reset_and_enable_clear_drop_labeled_series_ring_survives():
+    """Multi-engine reset semantics, both directions: ``reset()`` and
+    ``enable(clear=True)`` clear the labeled series for ALL engines (a
+    per-round bench reset must not leak engine A's series into engine B's
+    round), while the flight ring keeps its labeled records."""
+    from thunder_tpu.observe import flight
+
+    flight.clear()
+    try:
+        observe.enable(clear=True)
+        for eid in ("e0", "e1"):
+            h = observe.labeled(engine=eid)
+            h.inc("c")
+            h.set_gauge("g", 1.0)
+            h.observe_value("h", 1.0)
+        assert observe.engines_seen() == ["e0", "e1"]
+        observe.reset()
+        assert observe.engines_seen() == []
+        snap = observe.snapshot()
+        assert snap["labeled"] == {"counters": [], "gauges": [],
+                                   "histograms": []}
+        ring = [r for r in flight.snapshot() if r["type"] == "gauge"]
+        assert {r["labels"]["engine"] for r in ring} == {"e0", "e1"}
+
+        observe.labeled(engine="e2").inc("c")
+        observe.enable(clear=True)              # the other direction
+        assert observe.engines_seen() == []
+        assert flight.snapshot()                # ring still survives
+    finally:
+        flight.clear()
+
+
+def test_labeled_span_records_histogram_and_ring_edge():
+    from thunder_tpu.observe import flight
+
+    flight.clear()
+    try:
+        observe.enable(clear=True)
+        rec = observe.labeled(engine="e0")
+        with rec.span("schedule", cat="serving:sched", args={"n": 2}):
+            pass
+        s = rec.snapshot()
+        assert s["histograms"]["serving:sched.schedule.ms"]["count"] == 1
+        spans = observe.snapshot()["spans"]
+        assert spans[0]["name"] == "schedule"
+        assert spans[0]["labels"] == {"engine": "e0"}
+        edge = next(r for r in flight.snapshot() if r["type"] == "span")
+        assert edge["labels"] == {"engine": "e0"} and edge["args"] == {"n": 2}
+    finally:
+        flight.clear()
+
+
+def test_prometheus_renders_labeled_next_to_rollup_with_escaping(tmp_path):
+    """Exposition-format round-trip: labeled series render under ONE
+    ``# TYPE`` per metric next to the unlabeled rollup, label values
+    escape backslash/quote/newline, histogram buckets merge the ``le``
+    label into the series labels."""
+    observe.enable(clear=True)
+    h = observe.labeled(engine="e0")
+    h.inc("serving.shed_requests", 2)
+    h.set_gauge("serving.queue_depth", 3)
+    h.observe_value("serving.ttft_ms", 0.2)
+    nasty = observe.labeled(engine='w\\x"y\nz')
+    nasty.set_gauge("serving.queue_depth", 9)
+    text = observe.export_prometheus(str(tmp_path / "m.prom"))
+    assert text.count("# TYPE thunder_tpu_serving_queue_depth gauge") == 1
+    assert "\nthunder_tpu_serving_queue_depth 9" in "\n" + text  # rollup
+    assert 'thunder_tpu_serving_queue_depth{engine="e0"} 3' in text
+    assert ('thunder_tpu_serving_queue_depth{engine="w\\\\x\\"y\\nz"} 9'
+            in text)
+    assert 'thunder_tpu_serving_shed_requests{engine="e0"} 2' in text
+    assert ('thunder_tpu_serving_ttft_ms_bucket{engine="e0",le="+Inf"} 1'
+            in text)
+    assert 'thunder_tpu_serving_ttft_ms_count{engine="e0"} 1' in text
+    # still line-structured: "<metric possibly with labels> <value>" — use
+    # the file side of the round-trip for the parse audit
+    for line in (tmp_path / "m.prom").read_text().splitlines():
+        if line.startswith("#") or '"y' in line:   # the newline-bearing label
+            continue
+        metric, value = line.rsplit(" ", 1)
+        assert metric.startswith("thunder_tpu_")
+        float(value)
+
+
+def test_jsonl_export_emits_labeled_records(tmp_path):
+    observe.enable(clear=True)
+    h = observe.labeled(engine="e0")
+    h.inc("serving.shed_requests", 2)
+    h.set_gauge("serving.queue_depth", 3)
+    h.observe_value("serving.ttft_ms", 1.5)
+    path = str(tmp_path / "labeled.jsonl")
+    observe.export_jsonl(path)
+    recs = [json.loads(line) for line in open(path)]
+    by_type = {}
+    for r in recs:
+        by_type.setdefault(r["type"], []).append(r)
+    for fam in ("labeled_counter", "labeled_gauge", "labeled_histogram"):
+        rs = [r for r in by_type.get(fam, ())]
+        assert len(rs) == 1
+        assert rs[0]["labels"] == {"engine": "e0"}
+    assert by_type["labeled_gauge"][0]["value"] == 3.0
+    assert by_type["labeled_histogram"][0]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
 # bench integration + tier-1 hygiene
 # ---------------------------------------------------------------------------
 

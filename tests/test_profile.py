@@ -435,6 +435,7 @@ def test_explain_section_coverage_audit():
         "model vs measured",
         "numerics sentinel",
         "serving",
+        "serving fleet",
         "serving prefix cache",
         "serving slo/supervision",
         "request timeline",

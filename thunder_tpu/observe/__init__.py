@@ -55,6 +55,7 @@ from thunder_tpu.observe import census  # noqa: F401
 from thunder_tpu.observe import decisions  # noqa: F401
 from thunder_tpu.observe import flight  # noqa: F401
 from thunder_tpu.observe import profile  # noqa: F401
+from thunder_tpu.observe import statusz  # noqa: F401
 from thunder_tpu.observe.exporters import (  # noqa: F401
     chrome_trace_dict,
     export_chrome_trace,
@@ -64,12 +65,15 @@ from thunder_tpu.observe.exporters import (  # noqa: F401
 )
 from thunder_tpu.observe.explain import explain  # noqa: F401
 from thunder_tpu.observe.registry import (  # noqa: F401
+    Labeled,
     collect_pass_times,
     disable,
+    engines_seen,
     event,
     get_registry,
     inc,
     is_enabled,
+    labeled,
     observe_value,
     reset,
     set_gauge,

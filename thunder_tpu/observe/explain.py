@@ -456,6 +456,41 @@ def explain(jfn) -> str:
             lines.append("== serving slo/supervision ==")
             lines.extend(slo_lines)
 
+        # fleet section: when engines recorded LABELED series, break the
+        # serving picture out per engine (the unlabeled sections above are
+        # the process rollup — last-writer-wins for gauges — which is
+        # exactly what a multi-engine process needs disambiguated)
+        per_engine: dict[str, dict] = {}
+        for fam in ("gauges", "counters"):
+            for r in snap.get("labeled", {}).get(fam, []):
+                eid = r["labels"].get("engine")
+                if eid is not None and r["name"].startswith("serving."):
+                    per_engine.setdefault(eid, {})[r["name"]] = r["value"]
+        if len(per_engine) > 1 or (per_engine and any(
+                "serving.health_state" in m for m in per_engine.values())):
+            from thunder_tpu.serving.health import HEALTH_STATES
+
+            lines.append("")
+            lines.append("== serving fleet ==")
+            fleet_slo = snap["gauges"].get("serving.fleet_slo_attainment")
+            lines.append(f"  engines: {len(per_engine)}"
+                         + (f"   fleet SLO attainment: {fleet_slo:g}"
+                            if fleet_slo is not None else ""))
+            for eid, m in sorted(per_engine.items()):
+                code = m.get("serving.health_state")
+                state = (HEALTH_STATES[int(code)]
+                         if code is not None
+                         and 0 <= int(code) < len(HEALTH_STATES) else "?")
+                parts = [f"  {eid}: {state}"]
+                for k, short in (("serving.queue_depth", "queue"),
+                                 ("serving.active_requests", "active"),
+                                 ("serving.kv_pages_free", "pages_free"),
+                                 ("serving.slo_attainment", "slo"),
+                                 ("serving.engine_restarts", "restarts")):
+                    if k in m:
+                        parts.append(f"{short}={m[k]:g}")
+                lines.append(" ".join(parts))
+
     # -- request timeline (flight recorder) ---------------------------------
     # sourced from the ALWAYS-ON flight ring, so it renders even when the
     # registry was never enabled — the postmortem reading of explain()

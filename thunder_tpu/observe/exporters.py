@@ -21,6 +21,14 @@ Every export path routes field values through ``_jsonable`` — events and
 spans carry arbitrary user values (exceptions, numpy scalars, request
 objects), and one non-serializable value must never lose a trace or a
 postmortem.
+
+Labeled series render natively in every format: Prometheus as
+``serving_queue_depth{engine="e0"} 3`` (values escaped per the exposition
+format), JSONL records with a ``labels`` dict, and Chrome/Perfetto as
+per-engine *process* groups — every span/event/gauge record carrying an
+``engine`` label lands under a synthetic pid named ``thunder_tpu engine
+e0``, so two engines sharing one OS process read as two swim-lane groups
+with their own scheduler/request/counter tracks.
 """
 
 from __future__ import annotations
@@ -41,6 +49,10 @@ _COUNTER_TRACKS = ("serving.queue_depth", "serving.active_requests",
 # synthetic tids for the serving tracks (real thread ids land nowhere near)
 _SCHED_TID = 2
 _REQ_TID_BASE = 10_000_000
+
+# synthetic pids for per-engine Perfetto process groups (real pids on linux
+# stay below 4194304 by default; collisions would only mislabel a lane)
+_ENGINE_PID_BASE = 10_000_000
 
 
 def _jsonable(v, _seen=frozenset()):
@@ -84,6 +96,14 @@ def export_jsonl(path: str) -> int:
         for name, h in sorted(snap["histograms"].items()):
             f.write(json.dumps({"type": "histogram", "name": name, **h}) + "\n")
             n += 1
+        for family, recs in sorted(snap.get("labeled", {}).items()):
+            # one line per labeled series, labels as a dict field — the
+            # grep-able per-engine view ("labeled_counter" etc. so a reader
+            # never conflates a per-engine series with the process rollup)
+            for r in sorted(recs, key=lambda r: (r["name"], sorted(r["labels"].items()))):
+                f.write(json.dumps(_jsonable(
+                    {"type": f"labeled_{family[:-1]}", **r}), default=str) + "\n")
+                n += 1
         for e in snap["events"]:
             f.write(json.dumps(_jsonable({"type": "event", **e}),
                                default=str) + "\n")
@@ -95,27 +115,45 @@ def export_jsonl(path: str) -> int:
     return n
 
 
+def _rec_engine(r) -> str | None:
+    lbls = r.get("labels")
+    if isinstance(lbls, dict):
+        return lbls.get("engine")
+    return None
+
+
 def _trace_from(spans, events, samples) -> dict:
     """Build the Chrome Trace Event Format object from span/event/sample
-    record lists (registry- or flight-sourced)."""
-    pid = os.getpid()
+    record lists (registry- or flight-sourced). Records labeled with an
+    ``engine`` land in that engine's own process group (synthetic pid) so
+    N engines in one OS process render as N swim-lane groups."""
+    base_pid = os.getpid()
+    engines = sorted({e for e in map(_rec_engine, (*spans, *events, *samples))
+                      if e is not None})
+    engine_pid = {e: _ENGINE_PID_BASE + i for i, e in enumerate(engines)}
+
+    def rec_pid(r) -> int:
+        e = _rec_engine(r)
+        return engine_pid[e] if e is not None else base_pid
+
     out: list[dict] = []
-    tids: set = set()
-    req_tracks: set = set()
-    sched_track = False
+    tids: set = set()               # (pid, tid)
+    req_tracks: set = set()         # (pid, rid)
+    sched_pids: set = set()
     for s in spans:
         cat = s["cat"]
         args = s.get("args") or {}
+        pid = rec_pid(s)
         if cat == "serving:request":
             rid = int(args.get("request", -1))
             tid = _REQ_TID_BASE + max(rid, 0)
-            req_tracks.add(max(rid, 0))
+            req_tracks.add((pid, max(rid, 0)))
         elif cat == "serving:sched":
             tid = _SCHED_TID
-            sched_track = True
+            sched_pids.add(pid)
         else:
             tid = s["tid"]
-            tids.add(tid)
+            tids.add((pid, tid))
         out.append({
             "name": s["name"], "cat": cat, "ph": "X",
             "ts": s["ts_us"], "dur": s["dur_us"],
@@ -128,7 +166,7 @@ def _trace_from(spans, events, samples) -> dict:
         args = {k: v for k, v in e.items() if k not in ("kind", "ts_us", "type")}
         out.append({
             "name": e["kind"], "cat": "event", "ph": "i", "s": "p",
-            "ts": e["ts_us"], "pid": pid, "tid": 0,
+            "ts": e["ts_us"], "pid": rec_pid(e), "tid": 0,
             "args": {k: _jsonable(v) for k, v in args.items()},
         })
     for smp in samples:
@@ -136,20 +174,23 @@ def _trace_from(spans, events, samples) -> dict:
             continue
         out.append({
             "name": smp["name"], "ph": "C", "ts": smp["ts_us"],
-            "pid": pid, "args": {"value": _jsonable(smp["value"])},
+            "pid": rec_pid(smp), "args": {"value": _jsonable(smp["value"])},
         })
-    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+    meta = [{"name": "process_name", "ph": "M", "pid": base_pid, "tid": 0,
              "args": {"name": "thunder_tpu"}}]
-    if sched_track:
+    for e in engines:
+        meta.append({"name": "process_name", "ph": "M", "pid": engine_pid[e],
+                     "tid": 0, "args": {"name": f"thunder_tpu engine {e}"}})
+    for pid in sorted(sched_pids):
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": _SCHED_TID, "args": {"name": "serving scheduler"}})
         meta.append({"name": "thread_sort_index", "ph": "M", "pid": pid,
                      "tid": _SCHED_TID, "args": {"sort_index": -2}})
-    for rid in sorted(req_tracks):
+    for pid, rid in sorted(req_tracks):
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": _REQ_TID_BASE + rid,
                      "args": {"name": f"request {rid}"}})
-    for tid in sorted(tids):
+    for pid, tid in sorted(tids):
         meta.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                      "args": {"name": f"thread-{tid}"}})
     return {"traceEvents": meta + sorted(out, key=lambda e: e["ts"]),
@@ -188,29 +229,75 @@ def _prom_name(name: str) -> str:
         c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+def _prom_label_value(v: str) -> str:
+    # exposition-format escaping: backslash, double-quote, newline
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: dict) -> str:
+    """Render a label dict as ``{k="v",...}`` (sorted keys, escaped values;
+    empty dict renders as the empty string)."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{"".join(c if c.isalnum() or c == "_" else "_" for c in str(k))}'
+        f'="{_prom_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _group_labeled(recs) -> dict:
+    by: dict[str, list] = {}
+    for r in recs:
+        by.setdefault(r["name"], []).append(r)
+    for rs in by.values():
+        rs.sort(key=lambda r: sorted(r["labels"].items()))
+    return by
+
+
 def export_prometheus(path: str | None = None) -> str:
-    """Prometheus text format of counters/gauges/histograms. Returns the
-    text; also writes it to ``path`` when given."""
+    """Prometheus text format of counters/gauges/histograms — labeled
+    series render next to their unlabeled rollup under one ``# TYPE``
+    (``serving_queue_depth{engine="e0"} 3``). Returns the text; also
+    writes it to ``path`` when given."""
     snap = snapshot()
+    labeled = snap.get("labeled", {})
+    lc = _group_labeled(labeled.get("counters", []))
+    lg = _group_labeled(labeled.get("gauges", []))
+    lh = _group_labeled(labeled.get("histograms", []))
     lines: list[str] = []
-    for name, v in sorted(snap["counters"].items()):
-        m = _prom_name(name)
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {v}")
-    for name, v in sorted(snap["gauges"].items()):
-        m = _prom_name(name)
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {v}")
-    for name, h in sorted(snap["histograms"].items()):
-        m = _prom_name(name)
-        lines.append(f"# TYPE {m} histogram")
+
+    def _hist_series(m: str, h: dict, labels: dict) -> None:
         cum = 0
         for bound, count in zip([*HIST_BOUNDS, float("inf")], h["buckets"].values()):
             cum += count
             le = "+Inf" if bound == float("inf") else repr(bound)
-            lines.append(f'{m}_bucket{{le="{le}"}} {cum}')
-        lines.append(f"{m}_count {h['count']}")
-        lines.append(f"{m}_sum {h['sum']}")
+            lines.append(f'{m}_bucket{_prom_labels({**labels, "le": le})} {cum}')
+        suffix = _prom_labels(labels)
+        lines.append(f"{m}_count{suffix} {h['count']}")
+        lines.append(f"{m}_sum{suffix} {h['sum']}")
+
+    for name in sorted(set(snap["counters"]) | set(lc)):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} counter")
+        if name in snap["counters"]:
+            lines.append(f"{m} {snap['counters'][name]}")
+        for r in lc.get(name, ()):
+            lines.append(f"{m}{_prom_labels(r['labels'])} {r['value']}")
+    for name in sorted(set(snap["gauges"]) | set(lg)):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        if name in snap["gauges"]:
+            lines.append(f"{m} {snap['gauges'][name]}")
+        for r in lg.get(name, ()):
+            lines.append(f"{m}{_prom_labels(r['labels'])} {r['value']}")
+    for name in sorted(set(snap["histograms"]) | set(lh)):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        if name in snap["histograms"]:
+            _hist_series(m, snap["histograms"][name], {})
+        for r in lh.get(name, ()):
+            _hist_series(m, r, r["labels"])
     text = "\n".join(lines) + ("\n" if lines else "")
     if path is not None:
         with open(path, "w") as f:

@@ -34,10 +34,16 @@ Record shapes (all carry ``type`` and ``ts_us``):
 - ``{"type": "span", "name", "cat", "dur_us", "tid", "args"}`` — span
   edges (request lifecycle phases, scheduler iterations, dispatches).
 
+Records emitted through a scoped ``observe.labeled(engine="e0")`` handle
+additionally carry ``"labels": {"engine": "e0"}`` — the exporters group
+them into per-engine Perfetto process tracks, and a fleet postmortem can
+attribute every ring record to the engine that wrote it even though N
+engines share the one ring.
+
 ``observe.reset()`` / ``observe.enable(clear=True)`` do NOT clear the
-ring — the black box must survive registry resets (benchmarks reset the
-registry between rounds; an incident bundle still wants the history).
-Clear it explicitly with :func:`clear`.
+ring (labeled records included) — the black box must survive registry
+resets (benchmarks reset the registry between rounds; an incident bundle
+still wants the history). Clear it explicitly with :func:`clear`.
 """
 
 from __future__ import annotations

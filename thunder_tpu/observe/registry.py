@@ -24,6 +24,16 @@ The instrumentation contract for the whole compiler/runtime stack:
 Metric names are dotted (``cache.hits``, ``fusion.horizontal_merges``,
 ``step.walltime_ms``); exporters map them to their own conventions
 (Prometheus flattens dots to underscores).
+
+**Labels.** ``labeled(engine="e0")`` returns a scoped handle whose
+``inc``/``set_gauge``/``observe_value``/``event``/``record_span``/``span``
+mirror the module entry points but additionally key a parallel series store
+on ``(name, frozen labels)`` and stamp the label dict onto every flight-ring
+record. Unlabeled paths are untouched — same records, same single
+enabled-boolean check — and labeled writes *also* update the unlabeled
+series (the process-wide view stays whole; the labeled view disambiguates).
+``reset()``/``enable(clear=True)`` clear labeled series for ALL label sets;
+the flight ring survives either, labels and all.
 """
 
 from __future__ import annotations
@@ -82,6 +92,11 @@ class Registry:
         self.histograms: dict[str, Histogram] = {}
         self.events: deque = deque(maxlen=MAX_EVENTS)
         self.spans: deque = deque(maxlen=MAX_SPANS)
+        # labeled series: keyed (name, tuple(sorted (k, v) pairs)) — one
+        # flat dict per metric family, every label set an independent series
+        self.labeled_counters: dict[tuple, float] = {}
+        self.labeled_gauges: dict[tuple, float] = {}
+        self.labeled_histograms: dict[tuple, Histogram] = {}
 
     def clear(self) -> None:
         with self._lock:
@@ -90,6 +105,9 @@ class Registry:
             self.histograms.clear()
             self.events.clear()
             self.spans.clear()
+            self.labeled_counters.clear()
+            self.labeled_gauges.clear()
+            self.labeled_histograms.clear()
 
 
 _registry = Registry()
@@ -186,6 +204,132 @@ def record_span(name: str, cat: str, ts_us: float, dur_us: float,
 
 
 # ---------------------------------------------------------------------------
+# labeled series
+# ---------------------------------------------------------------------------
+
+def labels_key(labels: dict) -> tuple:
+    """Canonical frozen form of a label dict: sorted ``(key, str(value))``
+    pairs. This is the second element of every labeled-series key."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Labeled:
+    """Scoped recording handle that stamps a fixed label set.
+
+    Mirrors the module entry points (``inc``/``set_gauge``/``observe_value``/
+    ``event``/``record_span``/``span``) with identical gating — one enabled
+    boolean, flight-ring appends before the gate — but every write ALSO
+    lands in the labeled series keyed ``(name, frozen labels)``, and every
+    ring record carries ``labels`` so exporters can group per engine.
+    Unlabeled series still receive the write (last-writer-wins for gauges,
+    summed for counters): the process-wide view stays whole, the labeled
+    view is the disambiguated one."""
+
+    __slots__ = ("_key", "_dict")
+
+    def __init__(self, **labels: Any):
+        if not labels:
+            raise ValueError("labeled() needs at least one label, e.g. engine='e0'")
+        self._key = labels_key(labels)
+        self._dict = dict(self._key)
+
+    @property
+    def labels(self) -> dict:
+        return dict(self._dict)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        if not _enabled:
+            return
+        with _registry._lock:
+            _registry.counters[name] = _registry.counters.get(name, 0.0) + value
+            key = (name, self._key)
+            _registry.labeled_counters[key] = \
+                _registry.labeled_counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        value = float(value)
+        _flight.append({"type": "gauge", "name": name, "value": value,
+                        "labels": dict(self._dict), "ts_us": _now_us()})
+        if not _enabled:
+            return
+        with _registry._lock:
+            _registry.gauges[name] = value
+            _registry.labeled_gauges[(name, self._key)] = value
+
+    def observe_value(self, name: str, value: float) -> None:
+        if not _enabled:
+            return
+        with _registry._lock:
+            h = _registry.histograms.get(name)
+            if h is None:
+                h = _registry.histograms[name] = Histogram()
+            h.observe(value)
+            key = (name, self._key)
+            lh = _registry.labeled_histograms.get(key)
+            if lh is None:
+                lh = _registry.labeled_histograms[key] = Histogram()
+            lh.observe(value)
+
+    def event(self, kind: str, **fields: Any) -> None:
+        rec = {"kind": kind, "ts_us": _now_us(),
+               "labels": dict(self._dict), **fields}
+        _flight.append({"type": "event", **rec})
+        if not _enabled:
+            return
+        with _registry._lock:
+            _registry.events.append(rec)
+
+    def record_span(self, name: str, cat: str, ts_us: float, dur_us: float,
+                    args: dict | None = None) -> None:
+        rec = {"name": name, "cat": cat, "ts_us": ts_us, "dur_us": dur_us,
+               "tid": threading.get_ident(), "labels": dict(self._dict),
+               "args": args or {}}
+        _flight.append({"type": "span", **rec})
+        if not _enabled:
+            return
+        with _registry._lock:
+            _registry.spans.append(rec)
+
+    def span(self, name: str, cat: str = "serving", args: dict | None = None):
+        return _SpanCM(name, cat, args, None, rec=self)
+
+    def snapshot(self) -> dict:
+        """This label set's series only, keyed by bare metric name — the
+        per-engine view a consumer (bench, statusz) reads without caring
+        which other engines share the process."""
+        k = self._key
+        with _registry._lock:
+            return {
+                "labels": dict(self._dict),
+                "counters": {n: v for (n, l), v in
+                             _registry.labeled_counters.items() if l == k},
+                "gauges": {n: v for (n, l), v in
+                           _registry.labeled_gauges.items() if l == k},
+                "histograms": {n: h.to_dict() for (n, l), h in
+                               _registry.labeled_histograms.items() if l == k},
+            }
+
+
+def labeled(**labels: Any) -> Labeled:
+    """Scoped handle recording under a frozen label set: see :class:`Labeled`."""
+    return Labeled(**labels)
+
+
+def engines_seen() -> list[str]:
+    """Sorted ``engine`` label values present in any labeled series — how a
+    fleet consumer discovers which engines shared this process's registry."""
+    out = set()
+    with _registry._lock:
+        for store in (_registry.labeled_counters, _registry.labeled_gauges,
+                      _registry.labeled_histograms):
+            for (_, lbls) in store:
+                for k, v in lbls:
+                    if k == "engine":
+                        out.add(v)
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
 # spans
 # ---------------------------------------------------------------------------
 
@@ -212,13 +356,15 @@ def collect_pass_times(sink: dict):
 
 
 class _SpanCM:
-    __slots__ = ("name", "cat", "args", "sink", "_t0", "_ts", "_key", "_tok")
+    __slots__ = ("name", "cat", "args", "sink", "rec",
+                 "_t0", "_ts", "_key", "_tok")
 
-    def __init__(self, name, cat, args, sink):
+    def __init__(self, name, cat, args, sink, rec=None):
         self.name = name
         self.cat = cat
         self.args = args
         self.sink = sink
+        self.rec = rec  # a Labeled handle, or None for the module path
 
     def __enter__(self):
         if self.sink is not None:
@@ -238,8 +384,13 @@ class _SpanCM:
         # registry write; the derived histogram sample is registry-only
         # (observe_value doesn't ring-append — the ring already holds the
         # span edge with its duration)
-        record_span(self.name, self.cat, self._ts, dur_ns / 1e3, self.args)
-        observe_value(f"{self.cat}.{self.name}.ms", dur_ns / 1e6)
+        r = self.rec
+        if r is None:
+            record_span(self.name, self.cat, self._ts, dur_ns / 1e3, self.args)
+            observe_value(f"{self.cat}.{self.name}.ms", dur_ns / 1e6)
+        else:
+            r.record_span(self.name, self.cat, self._ts, dur_ns / 1e3, self.args)
+            r.observe_value(f"{self.cat}.{self.name}.ms", dur_ns / 1e6)
         return False
 
 
@@ -269,7 +420,11 @@ def _copy_rec(rec: dict) -> dict:
 
 
 def snapshot() -> dict:
-    """Plain-dict copy of all metrics/events/spans (safe to mutate/serialize)."""
+    """Plain-dict copy of all metrics/events/spans (safe to mutate/serialize).
+
+    Labeled series come back as lists of records (``{"name", "labels",
+    "value"}``, histograms with the bucket dict inlined) — JSON-safe, and
+    the shape exporters render without re-deriving label keys."""
     with _registry._lock:
         return {
             "counters": dict(_registry.counters),
@@ -277,4 +432,12 @@ def snapshot() -> dict:
             "histograms": {k: h.to_dict() for k, h in _registry.histograms.items()},
             "events": [_copy_rec(e) for e in _registry.events],
             "spans": [_copy_rec(s) for s in _registry.spans],
+            "labeled": {
+                "counters": [{"name": n, "labels": dict(l), "value": v}
+                             for (n, l), v in _registry.labeled_counters.items()],
+                "gauges": [{"name": n, "labels": dict(l), "value": v}
+                           for (n, l), v in _registry.labeled_gauges.items()],
+                "histograms": [{"name": n, "labels": dict(l), **h.to_dict()}
+                               for (n, l), h in _registry.labeled_histograms.items()],
+            },
         }

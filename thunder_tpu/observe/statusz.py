@@ -1,0 +1,122 @@
+"""Per-engine /statusz: atomic JSON status snapshots on a cadence.
+
+Each supervised engine writes ``<dir>/<engine_id>.json`` — a small,
+self-describing status payload — with the same tmp-write + ``os.replace``
+discipline as :class:`thunder_tpu.elastic.Heartbeat`, so a reader never
+sees a torn file. Because the transport is just files in a directory, the
+aggregation side (:func:`read_dir`) works across processes (and, with a
+shared filesystem, across hosts) with no RPC plane: exactly the shape a
+cross-host router needs before one exists.
+
+:class:`StatusWriter` throttles to ``interval_s`` so a tight serving loop
+pays one clock read per step in the common case; ``interval_s=0`` writes
+every call (tests, drain-time final flush). Staleness is judged by the
+``time`` stamp inside the payload, mirroring ``elastic.check_stalled``:
+a status file whose writer died reads as stale, not as healthy-forever.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+STATUS_SCHEMA = 1
+
+
+def status_path(dir_path: str, engine_id: str) -> str:
+    return os.path.join(os.path.abspath(dir_path), f"{engine_id}.json")
+
+
+def write_status(path: str, payload: dict) -> None:
+    """Atomically write one status snapshot (tmp + rename; torn-read-proof)."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rec = {"status_schema": STATUS_SCHEMA, "time": time.time(), **payload}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, default=repr)
+    os.replace(tmp, path)
+
+
+def read_status(path: str) -> dict | None:
+    """One engine's snapshot, or None if missing/unparseable (a writer mid-
+    crash must not take the aggregator down with it)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+class StatusWriter:
+    """Throttled atomic status writer for one engine.
+
+    ``maybe_write(payload)`` writes at most once per ``interval_s`` and
+    returns whether it wrote; ``write(payload)`` is unconditional (use it
+    for the final flush on drain/shutdown so the last state on disk is the
+    terminal one)."""
+
+    def __init__(self, dir_path: str, engine_id: str, *,
+                 interval_s: float = 1.0):
+        self.path = status_path(dir_path, engine_id)
+        self.engine_id = engine_id
+        self.interval_s = interval_s
+        self._last_write: float | None = None
+
+    def maybe_write(self, payload: dict) -> bool:
+        now = time.monotonic()
+        if (self._last_write is not None
+                and now - self._last_write < self.interval_s):
+            return False
+        self.write(payload)
+        return True
+
+    def write(self, payload: dict) -> None:
+        write_status(self.path, {"engine_id": self.engine_id, **payload})
+        self._last_write = time.monotonic()
+
+
+def read_dir(dir_path: str, *, stale_after_s: float | None = None,
+             _now: float | None = None) -> dict:
+    """Aggregate every ``*.json`` status snapshot in ``dir_path``.
+
+    Returns ``{"engines": {engine_id: payload}, "stale": [...], "fleet":
+    {...}}`` — the fleet section rolls up engine count, health states (when
+    the payloads carry them), and SLO attainment summed over per-engine
+    ``slo_attained``/``slo_total`` counters. ``stale_after_s`` moves engines
+    whose payload ``time`` is older than the threshold into ``stale`` (they
+    still appear in ``engines``; routing layers decide what stale means)."""
+    dir_path = os.path.abspath(dir_path)
+    now = time.time() if _now is None else _now
+    engines: dict[str, dict] = {}
+    stale: list[str] = []
+    try:
+        names = sorted(os.listdir(dir_path))
+    except OSError:
+        names = []
+    for fname in names:
+        if not fname.endswith(".json"):
+            continue
+        rec = read_status(os.path.join(dir_path, fname))
+        if rec is None:
+            continue
+        eid = rec.get("engine_id") or fname[:-len(".json")]
+        engines[eid] = rec
+        if stale_after_s is not None and now - rec.get("time", 0.0) > stale_after_s:
+            stale.append(eid)
+    attained = sum(e.get("slo_attained", 0) for e in engines.values())
+    total = sum(e.get("slo_total", 0) for e in engines.values())
+    states: dict[str, Any] = {eid: e.get("health") for eid, e in engines.items()}
+    return {
+        "engines": engines,
+        "stale": stale,
+        "fleet": {
+            "engines": len(engines),
+            "health": states,
+            "slo_attained": attained,
+            "slo_total": total,
+            "slo_attainment": (attained / total) if total else None,
+        },
+    }

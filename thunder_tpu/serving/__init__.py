@@ -32,6 +32,13 @@ The layers (ROADMAP item 1 + the serving containment story):
   crash recovery (pool rebuild + re-prefill of in-flight requests, charged
   to a sliding-window :class:`~thunder_tpu.runtime.retry.RestartBudget`),
   graceful ``drain()``/``shutdown()``, and a heartbeat watchdog.
+- :mod:`thunder_tpu.serving.health` — the fleet plane: every engine's
+  telemetry is labeled with its process-unique ``engine_id``;
+  :class:`~health.EngineHealth` scores it into a typed
+  HEALTHY/DEGRADED/DRAINING/DEAD machine with hysteresis, and a
+  :class:`~health.FleetObservatory` aggregates N supervised engines
+  (fleet SLO, merged explain section, cross-engine postmortems, statusz
+  directory aggregation).
 
 >>> from thunder_tpu.serving import EngineSupervisor, ServingEngine
 >>> eng = ServingEngine(params, cfg, max_slots=8, page_size=16,
@@ -56,6 +63,16 @@ from thunder_tpu.serving.errors import (  # noqa: F401
     RestartState,
     ServingError,
     ShardingGeometryError,
+)
+from thunder_tpu.serving.health import (  # noqa: F401
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    HEALTH_STATES,
+    HEALTHY,
+    EngineHealth,
+    FleetObservatory,
+    HealthPolicy,
 )
 from thunder_tpu.serving.kv_cache import (  # noqa: F401
     OutOfPages,

@@ -28,7 +28,10 @@ somebody". The hierarchy:
   stuck requests instead of burning ``max_steps`` silently.
 - :class:`RestartBudgetExceeded` — the supervisor's sliding-window restart
   budget ran out; the engine is failing faster than restarts can honestly
-  mask, so the failure escalates to the caller.
+  mask, so the failure escalates to the caller. The health plane
+  (:mod:`thunder_tpu.serving.health`) reads the same budget: a refused
+  restart is what flips an engine's health to its terminal ``DEAD`` state,
+  and each masked ``EngineFault`` restart reads as a ``DEGRADED`` episode.
 - :class:`ShardingGeometryError` — the paged-pool geometry cannot be
   sharded over the requested mesh (kv-head count not divisible by the
   mesh axis size); raised at pool-construction time so a bad split fails

@@ -52,4 +52,9 @@ EVENT_KINDS = frozenset({
     "serving_restart_budget_exhausted",  # restart rung refused; escalating
     "serving_slo_collapse",         # rolling SLO attainment fell below floor
     "serving_postmortem",           # black-box bundle written to disk
+    # fleet observatory (health.py)
+    "serving_health_transition",    # EngineHealth state moved (from/to +
+    #                                 the breach reasons that drove it)
+    "serving_fleet_postmortem",     # cross-engine bundle written: names the
+    #                                 faulting engine, captures siblings
 })

@@ -1,0 +1,391 @@
+"""Engine health scoring and the fleet observatory.
+
+ROADMAP item 1(c) — a router spreading traffic over N supervised engines —
+needs two things before any routing policy can exist: a per-engine health
+verdict it can trust, and fleet-level aggregation that doesn't require the
+engines to share anything but a process (or, via statusz files, not even
+that). This module is both.
+
+:class:`EngineHealth` rolls the signals the serving layer already
+maintains — SLO attainment since the last transition, restart-budget
+headroom (:meth:`~thunder_tpu.runtime.retry.RestartBudget.describe`),
+queue depth vs ``max_queue``, KV page pressure, and the decode-rebind
+rate — into a typed four-state machine::
+
+    HEALTHY --any breach--> DEGRADED --recover_checks clean--> HEALTHY
+       |                        |
+       +--admissions stopped----+--> DRAINING   (terminal-ish: un-drains
+       |                        |                never happen today)
+       +--restart budget spent--+--> DEAD       (terminal)
+
+with hysteresis: degradation is immediate (a router should stop sending
+traffic NOW), recovery needs ``recover_checks`` consecutive clean checks
+(flapping between verdicts is worse for a router than a pessimistic one).
+Every transition emits a ``serving_health_transition`` event under the
+engine's label and moves the per-engine ``serving.health_state`` gauge.
+
+:class:`FleetObservatory` aggregates N supervisors: ``check()`` runs every
+health machine (auto-dumping a fleet postmortem on a degrading
+transition), ``slo_attainment()`` is the fleet-wide ratio, ``explain()``
+renders the merged fleet section, and :meth:`dump_fleet_postmortem`
+writes a bundle that names the faulting engine while capturing every
+sibling's state — cross-engine correlation is the whole point: "e1 died
+while e0's queue spiked" is a fleet fact no single engine's ring shows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+from thunder_tpu.observe import registry as _observe
+
+HEALTHY = "HEALTHY"
+DEGRADED = "DEGRADED"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+
+# the typed health vocabulary — pinned here and enforced against the docs
+# table in BOTH directions by tests/test_docs.py (the BLOCK_DECISION_KINDS
+# discipline): a state added in code but undocumented fails tier-1, and a
+# documented state nothing can reach fails too
+HEALTH_STATES = (HEALTHY, DEGRADED, DRAINING, DEAD)
+
+# numeric codes for the serving.health_state gauge (Prometheus/Perfetto
+# render numbers; the event carries the names)
+HEALTH_STATE_CODE = {s: i for i, s in enumerate(HEALTH_STATES)}
+
+
+@dataclass(frozen=True)
+class HealthPolicy:
+    """Thresholds for the degradation signals (all judged per check).
+
+    ``slo_floor`` with fewer than ``min_slo_samples`` terminals since the
+    last transition is not judged (cold engines are healthy, not lucky).
+    ``queue_fill_degraded`` only applies to bounded queues (``max_queue``
+    set). ``recover_checks`` is the hysteresis width: consecutive clean
+    checks needed before DEGRADED flips back to HEALTHY."""
+
+    slo_floor: float = 0.8
+    min_slo_samples: int = 4
+    queue_fill_degraded: float = 0.9
+    page_free_degraded: float = 0.05
+    restart_headroom_min: int = 1
+    recover_checks: int = 2
+
+
+class EngineHealth:
+    """The per-engine health state machine over one :class:`EngineSupervisor`.
+
+    ``check()`` evaluates the signals and returns the (possibly new) state;
+    ``describe()`` returns the signals WITH the verdict, for statusz
+    payloads and postmortems. Restart detection is edge-triggered (a new
+    restart since the previous check is a breach even though the engine is
+    up again) — that is what makes a crash + token-identical rebuild read
+    HEALTHY → DEGRADED → (clean checks) → HEALTHY instead of staying
+    green throughout."""
+
+    def __init__(self, supervisor, policy: HealthPolicy | None = None):
+        self.sup = supervisor
+        self.engine = supervisor.engine
+        self.policy = policy or HealthPolicy()
+        self.state = HEALTHY
+        self.transitions: list[dict] = []
+        self._clean = 0
+        self._last_restarts = supervisor.restarts
+        self._last_rebinds = self.engine.decode_rebinds
+        # SLO window base: judged since the last transition (or attach)
+        self._slo_base = (self.engine._slo_attained, self.engine._slo_total,
+                          self.engine._slo_resets)
+        self._publish()
+
+    # -- signals ------------------------------------------------------------
+    def signals(self) -> dict:
+        """Evaluate every degradation signal; ``breaches`` lists the ones
+        that fired (reason strings — they go into the transition event)."""
+        eng, sup, pol = self.engine, self.sup, self.policy
+        breaches: list[str] = []
+
+        new_restarts = sup.restarts - self._last_restarts
+        if new_restarts > 0:
+            breaches.append(f"engine_restart(+{new_restarts})")
+
+        new_rebinds = eng.decode_rebinds - self._last_rebinds
+        if new_rebinds > 0:
+            breaches.append(f"decode_rebind(+{new_rebinds})")
+
+        base_a, base_t, base_gen = self._slo_base
+        if eng._slo_resets != base_gen:
+            self._slo_base = (0, 0, eng._slo_resets)
+            base_a, base_t = 0, 0
+        total = eng._slo_total - base_t
+        slo = (eng._slo_attained - base_a) / total if total else None
+        if (total >= max(pol.min_slo_samples, 1) and slo is not None
+                and slo < pol.slo_floor):
+            breaches.append(f"slo_attainment({slo:.3f}<{pol.slo_floor:g})")
+
+        queue_fill = (len(eng.queue) / eng.max_queue
+                      if eng.max_queue else None)
+        if queue_fill is not None and queue_fill >= pol.queue_fill_degraded:
+            breaches.append(f"queue_fill({queue_fill:.2f})")
+
+        page_free = (eng.cache.pages_free / eng.cache.pages_total
+                     if eng.cache.pages_total else 1.0)
+        if page_free < pol.page_free_degraded:
+            breaches.append(f"kv_page_pressure(free={page_free:.3f})")
+
+        headroom = sup.budget.max_restarts - sup.budget.in_window
+        if headroom < pol.restart_headroom_min:
+            breaches.append(f"restart_headroom({headroom})")
+
+        return {
+            "restarts": sup.restarts,
+            "new_restarts": new_restarts,
+            "decode_rebinds": eng.decode_rebinds,
+            "new_rebinds": new_rebinds,
+            "slo_attainment": None if slo is None else round(slo, 4),
+            "slo_samples": total,
+            "queue_depth": len(eng.queue),
+            "queue_fill": queue_fill,
+            "page_free_frac": round(page_free, 4),
+            "restart_headroom": headroom,
+            "budget": sup.budget.describe(),
+            "admitting": eng.admitting,
+            "breaches": breaches,
+        }
+
+    # -- the state machine --------------------------------------------------
+    def check(self) -> str:
+        """One health evaluation. Degradation is immediate; recovery needs
+        ``recover_checks`` consecutive clean checks. DRAINING tracks the
+        admission gate; DEAD (restart budget spent) is terminal."""
+        sig = self.signals()
+        self._last_restarts = self.sup.restarts
+        self._last_rebinds = self.engine.decode_rebinds
+        if self.state == DEAD:
+            return self.state
+
+        # DEAD only once the budget actually REFUSED a restart (in_window
+        # can only exceed max after a refused record()) — zero headroom
+        # with the engine still up is a DEGRADED breach, not death
+        if self.sup.budget.in_window > self.sup.budget.max_restarts:
+            self._transition(DEAD, sig)
+            return self.state
+        if not self.engine.admitting:
+            if self.state != DRAINING:
+                self._transition(DRAINING, sig)
+            return self.state
+        if self.state == DRAINING:
+            # admissions resumed (engine rebuilt/repointed under us)
+            self._transition(HEALTHY, sig)
+            return self.state
+
+        if sig["breaches"]:
+            self._clean = 0
+            if self.state != DEGRADED:
+                self._transition(DEGRADED, sig)
+        elif self.state == DEGRADED:
+            self._clean += 1
+            if self._clean >= self.policy.recover_checks:
+                self._transition(HEALTHY, sig)
+        return self.state
+
+    def _transition(self, to: str, sig: dict) -> None:
+        frm, self.state = self.state, to
+        self._clean = 0
+        # recovery judges a FRESH SLO window, not the misses that degraded us
+        self._slo_base = (self.engine._slo_attained, self.engine._slo_total,
+                          self.engine._slo_resets)
+        rec = {"from": frm, "to": to, "step": self.engine._step_count,
+               "breaches": list(sig.get("breaches", ()))}
+        self.transitions.append(rec)
+        obs = self.engine.obs
+        obs.inc("serving.health_transitions")
+        obs.event("serving_health_transition", engine=self.engine.engine_id,
+                  **rec)
+        self._publish()
+
+    def _publish(self) -> None:
+        self.engine.obs.set_gauge("serving.health_state",
+                                  HEALTH_STATE_CODE[self.state])
+
+    def describe(self) -> dict:
+        return {"engine_id": self.engine.engine_id, "state": self.state,
+                "signals": self.signals(),
+                "transitions": list(self.transitions)}
+
+
+class FleetObservatory:
+    """Aggregates N supervised engines into one health/telemetry plane.
+
+    ``add(sup)`` attaches an :class:`EngineHealth` (also exposed as
+    ``sup.health`` so statusz payloads carry the verdict); ``check()``
+    runs every machine and publishes the fleet gauges; ``explain()`` is
+    the merged fleet section. With ``postmortem_dir=`` set, a transition
+    INTO ``DEGRADED``/``DEAD`` auto-dumps a fleet postmortem bundle
+    naming the faulting engine next to every sibling's state."""
+
+    def __init__(self, *, policy: HealthPolicy | None = None,
+                 postmortem_dir: str | None = None):
+        self.policy = policy or HealthPolicy()
+        self.postmortem_dir = postmortem_dir
+        self.supervisors: dict[str, object] = {}
+        self.health: dict[str, EngineHealth] = {}
+
+    def add(self, supervisor, policy: HealthPolicy | None = None) -> EngineHealth:
+        eid = supervisor.engine.engine_id
+        if eid in self.supervisors:
+            raise ValueError(f"engine {eid!r} already under observation")
+        h = EngineHealth(supervisor, policy or self.policy)
+        supervisor.health = h
+        self.supervisors[eid] = supervisor
+        self.health[eid] = h
+        _observe.set_gauge("serving.fleet_engines", len(self.health))
+        return h
+
+    def check(self) -> dict[str, str]:
+        """Run every engine's health check; returns ``{engine_id: state}``.
+        Publishes fleet-wide gauges and auto-dumps a fleet postmortem for
+        every transition into DEGRADED/DEAD (one bundle per transition,
+        not per check — re-checking a degraded fleet is free)."""
+        states: dict[str, str] = {}
+        for eid, h in self.health.items():
+            prev = h.state
+            st = h.check()
+            states[eid] = st
+            if st != prev and st in (DEGRADED, DEAD):
+                breaches = (h.transitions[-1].get("breaches", [])
+                            if h.transitions else [])
+                self.dump_fleet_postmortem(
+                    eid, f"{prev}->{st}: {', '.join(breaches) or 'unknown'}")
+        _observe.set_gauge("serving.fleet_engines", len(self.health))
+        slo = self.slo_attainment()
+        if slo is not None:
+            _observe.set_gauge("serving.fleet_slo_attainment", slo)
+        return states
+
+    def slo_attainment(self) -> float | None:
+        """Fleet-wide SLO attainment: terminals summed over every engine
+        (an idle fleet returns None, not 1.0 — no claim without samples)."""
+        attained = sum(s.engine._slo_attained
+                       for s in self.supervisors.values())
+        total = sum(s.engine._slo_total for s in self.supervisors.values())
+        return (attained / total) if total else None
+
+    def describe(self) -> dict:
+        slo = self.slo_attainment()
+        return {
+            "engines": {eid: h.describe() for eid, h in self.health.items()},
+            "fleet": {
+                "engines": len(self.health),
+                "states": {eid: h.state for eid, h in self.health.items()},
+                "slo_attainment": None if slo is None else round(slo, 4),
+            },
+        }
+
+    def explain(self) -> str:
+        """The merged fleet section — same shape as ``observe.explain``'s
+        serving section, one line per engine plus the fleet rollup."""
+        lines = ["== serving fleet =="]
+        slo = self.slo_attainment()
+        lines.append(f"  engines: {len(self.health)}"
+                     + (f"   fleet SLO attainment: {slo:.3f}"
+                        if slo is not None else ""))
+        for eid, h in sorted(self.health.items()):
+            sig = h.signals()
+            slo_s = ("-" if sig["slo_attainment"] is None
+                     else f"{sig['slo_attainment']:.3f}")
+            lines.append(
+                f"  {eid}: {h.state:9s} queue={sig['queue_depth']} "
+                f"pages_free={sig['page_free_frac']:.2f} slo={slo_s} "
+                f"restarts={sig['restarts']} [{sig['budget']}]")
+            for t in h.transitions[-3:]:
+                lines.append(f"    step {t['step']}: {t['from']} -> {t['to']}"
+                             + (f" ({', '.join(t['breaches'])})"
+                                if t["breaches"] else ""))
+        return "\n".join(lines)
+
+    def write_statusz(self, dir_path: str) -> None:
+        """One atomic status file per engine, now (cadence-free: the
+        per-supervisor ``statusz_dir=`` writers ride step(); this is the
+        observatory-driven flush for engines without one)."""
+        from thunder_tpu.observe import statusz as _statusz
+
+        for eid, sup in self.supervisors.items():
+            _statusz.write_status(_statusz.status_path(dir_path, eid),
+                                  {"engine_id": eid, **sup.status_payload()})
+
+    @staticmethod
+    def aggregate_statusz(dir_path: str, *,
+                          stale_after_s: float | None = None) -> dict:
+        """Aggregate a directory of statusz snapshots (cross-process: the
+        writers need not share this process, only the filesystem)."""
+        from thunder_tpu.observe import statusz as _statusz
+
+        return _statusz.read_dir(dir_path, stale_after_s=stale_after_s)
+
+    def dump_fleet_postmortem(self, engine_id: str, cause) -> str | None:
+        """The cross-engine black box: the faulting engine's FULL bundle
+        (via its supervisor's ``dump_postmortem`` when it has a
+        ``postmortem_dir``, else inline state) plus every sibling's
+        ``describe_state``/health — written under this observatory's
+        ``postmortem_dir``. Returns the bundle path (None when unset).
+        Never raises."""
+        if self.postmortem_dir is None:
+            return None
+        sup = self.supervisors.get(engine_id)
+        try:
+            base = os.path.join(self.postmortem_dir,
+                                f"fleet-postmortem-{engine_id}")
+            path, i = base, 1
+            while os.path.exists(path):
+                path = f"{base}.{i}"
+                i += 1
+            os.makedirs(path)
+        except Exception:
+            return None
+        from thunder_tpu.observe import exporters as _exporters
+        from thunder_tpu.observe import flight as _flight
+
+        errors: list[str] = []
+
+        def part(fname: str, build) -> None:
+            try:
+                obj = build()
+                with open(os.path.join(path, fname), "w") as f:
+                    json.dump(_exporters._jsonable(obj), f, default=str)
+            except Exception as e:    # partial bundle beats no bundle
+                errors.append(f"{fname}: {e!r}")
+
+        try:
+            n_flight = _flight.dump_jsonl(os.path.join(path, "flight.jsonl"))
+        except Exception as e:
+            n_flight = 0
+            errors.append(f"flight.jsonl: {e!r}")
+        part("fleet.json", self.describe)
+        part("siblings.json", lambda: {
+            eid: s.engine.describe_state()
+            for eid, s in self.supervisors.items()})
+        # the shared ring renders once, per-engine process groups and all —
+        # THE cross-engine correlation artifact
+        part("timeline.json", _exporters.flight_trace_dict)
+        part("MANIFEST.json", lambda: {
+            "faulting_engine": engine_id,
+            "cause": repr(cause),
+            "created_s": time.time(),
+            "engines": sorted(self.supervisors),
+            "states": {eid: h.state for eid, h in self.health.items()},
+            "flight_records": n_flight,
+            "registry_enabled": _observe.is_enabled(),
+            "errors": errors,
+            "files": ["flight.jsonl", "fleet.json", "siblings.json",
+                      "timeline.json"],
+        })
+        _observe.inc("serving.fleet_postmortems")
+        obs = (sup.engine.obs if sup is not None
+               else _observe.labeled(engine=engine_id))
+        obs.event("serving_fleet_postmortem", engine=engine_id,
+                  path=path, cause=repr(cause))
+        return path

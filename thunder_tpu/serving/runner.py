@@ -100,12 +100,19 @@ class PagedLlamaRunner:
 
     def __init__(self, cfg, geometry, *, n_layers: int | None = None,
                  executors=None, block_fusion=None,
-                 launch_budget_per_layer: float | None = None, mesh=None):
+                 launch_budget_per_layer: float | None = None, mesh=None,
+                 engine_id: str | None = None):
         import thunder_tpu as tt
+        from thunder_tpu.observe import registry as _observe
 
         self.cfg = cfg
         self.geom = geometry
         self.mesh = mesh  # distributed.gspmd.TensorParallelMesh or None
+        # owning engine's label: the runner's gauge/event emissions (decode
+        # bind shape) must land in that engine's series, not a shared one
+        self.engine_id = engine_id
+        self.obs = (_observe.labeled(engine=engine_id)
+                    if engine_id is not None else None)
         self.n_layers = n_layers if n_layers is not None else cfg.n_layers
         # decode-launch budget: when set (via census_context below), a
         # decode program dispatching more Pallas launches per layer per
@@ -285,8 +292,9 @@ class PagedLlamaRunner:
         tc = _census.trace_census(trc)
         launches = tc["pallas_launches"]
         layers = tc["decode_layer_fusions"]
-        _observe.set_gauge("serving.decode_pallas_launches", launches)
-        _observe.set_gauge("serving.decode_layer_fusions", layers)
+        rec = self.obs if self.obs is not None else _observe
+        rec.set_gauge("serving.decode_pallas_launches", launches)
+        rec.set_gauge("serving.decode_layer_fusions", layers)
         # launch-budget enforcement lives in the census (the census_context
         # stashed at construction): the decode-launch-growth finding is
         # derived — ONCE — whenever the decode program's census is
@@ -297,5 +305,5 @@ class PagedLlamaRunner:
         # lifecycle edge for the flight ring: WHICH program shape is now
         # serving (a postmortem wants to know if the megakernel or a
         # fallback rung was bound when the fault hit)
-        _observe.event("serving_decode_bind", launches=launches,
-                       decode_layer_fusions=layers)
+        rec.event("serving_decode_bind", launches=launches,
+                  decode_layer_fusions=layers)

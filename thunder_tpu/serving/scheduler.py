@@ -116,6 +116,11 @@ QUEUED, PREFILL, DECODE, DONE, SHED = \
 # one timeline
 _REQUEST_IDS = itertools.count()
 
+# process-unique engine ids ("e0", "e1", ...): the label value that keys
+# every engine's metrics/events/spans so N engines in one process never
+# clobber each other's series (the fleet-observatory contract)
+_ENGINE_IDS = itertools.count()
+
 
 def _as_tp_mesh(mesh, cfg):
     """Normalize the engine's ``mesh=`` argument (None, an int tp degree,
@@ -232,13 +237,17 @@ class ServingEngine:
                  retry_policy=None, block_fusion=None,
                  prefix_cache: bool = False,
                  launch_budget_per_layer: float | None = None,
-                 mesh=None):
+                 mesh=None, engine_id: str | None = None):
         # tensor-parallel serving (GSPMD): `mesh` is an int tp degree or a
         # distributed.gspmd.TensorParallelMesh. Params are committed to the
         # Megatron column/row plan, the paged pool is sharded by kv-head,
         # and the runner's jitted step compiles ONE SPMD program around the
         # committed shardings (donation preserved — in/out pool shardings
         # match). Step inputs stay host arrays (replicated).
+        # engine identity first: every emission below this line is labeled
+        self.engine_id = engine_id if engine_id is not None \
+            else f"e{next(_ENGINE_IDS)}"
+        self.obs = _observe.labeled(engine=self.engine_id)
         self.mesh = _as_tp_mesh(mesh, cfg)
         if self.mesh is not None:
             from thunder_tpu.distributed.gspmd import shard_params
@@ -288,13 +297,14 @@ class ServingEngine:
         self.runner = PagedLlamaRunner(
             cfg, geometry, n_layers=n_layers, executors=executors,
             block_fusion=block_fusion,
-            launch_budget_per_layer=launch_budget_per_layer, mesh=self.mesh)
+            launch_budget_per_layer=launch_budget_per_layer, mesh=self.mesh,
+            engine_id=self.engine_id)
         if self.mesh is not None:
             from thunder_tpu.distributed.gspmd import mesh_descriptor
 
             md = mesh_descriptor(self.mesh)
-            _observe.set_gauge("serving.tp_degree", md["tp_degree"])
-            _observe.event("serving_mesh", phase="build", **md)
+            self.obs.set_gauge("serving.tp_degree", md["tp_degree"])
+            self.obs.event("serving_mesh", phase="build", **md)
         self.max_slots = int(max_slots)
         self.max_queue = max_queue
         self.slots: list[Request | None] = [None] * self.max_slots
@@ -307,6 +317,8 @@ class ServingEngine:
         self._slo_attained = 0          # on-time completions
         self._slo_total = 0             # terminal requests (done + shed)
         self._slo_resets = 0            # reset_slo_window() generation
+        self.decode_rebinds = 0         # quarantine-forced re-binds (health
+        #                                 reads this registry-independently)
         # serving is latency-sensitive: quick retries, no long backoff
         self._retry_policy = retry_policy or _retry.RetryPolicy(
             max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
@@ -388,7 +400,7 @@ class ServingEngine:
                         sampling=sp, fork_parent=parent)
             r.stream_seed = sp.stream_seed(r.request_id)
             # lifecycle edge 1: always in the flight ring, registry on/off
-            _observe.event("serving_submitted", request=r.request_id,
+            self.obs.event("serving_submitted", request=r.request_id,
                            prompt_tokens=int(prompt.size),
                            max_new_tokens=int(max_new_tokens),
                            priority=r.priority, deadline_s=deadline_s,
@@ -457,7 +469,7 @@ class ServingEngine:
             # the dispatch halves record their own spans. Idle polling steps
             # stay out of the flight ring — a long idle stretch must not
             # flush the last incident's history out of the bounded ring.
-            _observe.record_span("schedule", "serving:sched", t0_us,
+            self.obs.record_span("schedule", "serving:sched", t0_us,
                                  _observe._now_us() - t0_us,
                                  {"step": self._step_count})
         worked = self._decode_step() or worked
@@ -557,7 +569,7 @@ class ServingEngine:
         if self.mesh is not None:
             from thunder_tpu.distributed.gspmd import mesh_descriptor
 
-            _observe.event("serving_mesh", phase="rebuild",
+            self.obs.event("serving_mesh", phase="rebuild",
                            **mesh_descriptor(self.mesh))
         if self.prefix is not None:
             # the trie's pages died with the consumed pools: start a fresh
@@ -607,6 +619,7 @@ class ServingEngine:
         except AssertionError as e:
             quiescence = str(e)
         return {
+            "engine_id": self.engine_id,
             "step": self._step_count,
             "admitting": self.admitting,
             "slots": [{"slot": i, "request": r.request_id, "state": r.state,
@@ -647,14 +660,14 @@ class ServingEngine:
         dur_us = _observe._now_us() - req._phase_t0_us
         if req._phase == QUEUED:
             req.queued_ms += dur_us / 1e3
-        _observe.record_span(req._phase, "serving:request", req._phase_t0_us,
+        self.obs.record_span(req._phase, "serving:request", req._phase_t0_us,
                              dur_us, {"request": req.request_id, **args})
         req._phase = ""
 
     def _close_request_span(self, req: Request) -> None:
         """The terminal umbrella span: one bar covering submit -> terminal
         on the request's track, phases nested inside it."""
-        _observe.record_span(
+        self.obs.record_span(
             f"request {req.request_id}", "serving:request", req.submitted_us,
             _observe._now_us() - req.submitted_us,
             {"request": req.request_id, "state": req.state,
@@ -672,13 +685,13 @@ class ServingEngine:
             f"{self.cache.pages_free}/{self.cache.pages_total}", stuck=stuck)
 
     def _gauges(self) -> None:
-        _observe.set_gauge("serving.queue_depth", len(self.queue))
-        _observe.set_gauge("serving.active_requests", self.active_requests)
-        _observe.set_gauge("serving.kv_pages_free", self.cache.pages_free)
+        self.obs.set_gauge("serving.queue_depth", len(self.queue))
+        self.obs.set_gauge("serving.active_requests", self.active_requests)
+        self.obs.set_gauge("serving.kv_pages_free", self.cache.pages_free)
         if self.prefix is not None:
-            _observe.set_gauge("serving.cached_pages", self.cache.cached_pages)
+            self.obs.set_gauge("serving.cached_pages", self.cache.cached_pages)
         if self._slo_total:
-            _observe.set_gauge("serving.slo_attainment",
+            self.obs.set_gauge("serving.slo_attainment",
                                self._slo_attained / self._slo_total)
 
     def _expire_deadlines(self) -> bool:
@@ -733,10 +746,10 @@ class ServingEngine:
         self._close_request_span(req)
         self.shed.append(req)
         self._slo_total += 1
-        _observe.inc("serving.shed_requests")
+        self.obs.inc("serving.shed_requests")
         if isinstance(error, DeadlineExceeded):
-            _observe.inc("serving.deadline_misses")
-        _observe.event("serving_shed", request=req.request_id,
+            self.obs.inc("serving.deadline_misses")
+        self.obs.event("serving_shed", request=req.request_id,
                        priority=req.priority, state=shed_from,
                        reason=type(error).__name__,
                        generated=len(req.generated))
@@ -785,7 +798,7 @@ class ServingEngine:
                 # deferral COUNTS as progress — drain() must read it as
                 # "the engine deliberately waited", not as a stall (a
                 # permanent admission fault still bounds out via max_steps)
-                _observe.event("serving_admission_fault", error=repr(e),
+                self.obs.event("serving_admission_fault", error=repr(e),
                                request=req.request_id)
                 admitted = True
                 break
@@ -804,7 +817,7 @@ class ServingEngine:
             req.admit_seq = next(self._admits)
             self.slots[slot] = req
             self._phase_end(req)            # close "queued"
-            _observe.event("serving_admitted", request=req.request_id,
+            self.obs.event("serving_admitted", request=req.request_id,
                            slot=slot, preemptions=req.preemptions,
                            restarts=req.restarts,
                            prefix_hit_tokens=req.prefilled)
@@ -893,14 +906,14 @@ class ServingEngine:
         pools = self._dispatch_guarded(dispatch, "serving:prefill")
         self.cache.update_pools(pools)
         dur_us = _observe._now_us() - t0_us
-        _observe.observe_value("serving.prefill_ms",
+        self.obs.observe_value("serving.prefill_ms",
                                (time.perf_counter() - t0) * 1e3)
         # the chunk dispatch on the request's own lifecycle track
-        _observe.record_span("prefill_chunk", "serving:request", t0_us, dur_us,
+        self.obs.record_span("prefill_chunk", "serving:request", t0_us, dur_us,
                              {"request": req.request_id, "chunk": C,
                               "pos0": pos0})
         req.prefill_chunks += 1
-        _observe.event("serving_prefill_chunk", request=req.request_id,
+        self.obs.event("serving_prefill_chunk", request=req.request_id,
                        chunk=C, pos0=pos0, real=real)
         req.prefilled += real
         if req.prefilled == len(wp):                # prompt fully resident
@@ -957,8 +970,8 @@ class ServingEngine:
         req.preemptions += 1
         self.queue.appendleft(req)
         self._phase_begin(req, QUEUED)
-        _observe.inc("serving.preempted_requests")
-        _observe.event("serving_preempt", request=req.request_id,
+        self.obs.inc("serving.preempted_requests")
+        self.obs.event("serving_preempt", request=req.request_id,
                        generated=len(req.generated))
 
     def _decode_step(self) -> bool:
@@ -1055,12 +1068,13 @@ class ServingEngine:
                     # as a throughput regression; the counter renders in
                     # explain()'s serving section, the event carries the
                     # epochs, and the rebind republishes the launch gauges.
-                    _observe.inc("serving.decode_rebinds")
-                    _observe.event("serving_decode_rebind",
+                    self.decode_rebinds += 1
+                    self.obs.inc("serving.decode_rebinds")
+                    self.obs.event("serving_decode_rebind",
                                    old_epoch=self._bound_epoch, epoch=ep,
                                    quarantined=sorted(
                                        _quarantine.get_quarantine().ids()))
-                _observe.set_gauge("serving.quarantine_epoch", ep)
+                self.obs.set_gauge("serving.quarantine_epoch", ep)
                 self._decode_bound = self.runner.bind_decode(
                     self.params, tokens, bt, lengths, write_pos,
                     self.cache.pools, temps, topk, topp, rng)
@@ -1078,7 +1092,7 @@ class ServingEngine:
         # (the (S, V) logits output stays on device, unread)
         toks = np.asarray(tok_ids)
         # the dispatch half of the iteration, on the scheduler track
-        _observe.record_span("decode_dispatch", "serving:sched", t0_us,
+        self.obs.record_span("decode_dispatch", "serving:sched", t0_us,
                              _observe._now_us() - t0_us,
                              {"step": self._step_count, "batch": len(active)})
         for i, r in active:
@@ -1094,8 +1108,8 @@ class ServingEngine:
         req.next_token = tok
         if req.ttft_s is None:
             req.ttft_s = time.perf_counter() - req.submitted_s
-            _observe.observe_value("serving.ttft_ms", req.ttft_s * 1e3)
-            _observe.event("serving_first_token", request=req.request_id,
+            self.obs.observe_value("serving.ttft_ms", req.ttft_s * 1e3)
+            self.obs.event("serving_first_token", request=req.request_id,
                            ttft_ms=round(req.ttft_s * 1e3, 3))
         if (len(req.generated) >= req.max_new_tokens
                 or (req.eos_id is not None and tok == req.eos_id)):
@@ -1141,7 +1155,7 @@ class ServingEngine:
             # rather than re-deriving it (the two can't drift)
             copied = self.cache.cow_copies - cow_before
             if copied:
-                _observe.inc("serving.cow_copies", copied)
+                self.obs.inc("serving.cow_copies", copied)
             clone.pages = pages
             clone.pages_version += 1
             clone.prefilled = L
@@ -1152,7 +1166,7 @@ class ServingEngine:
             clone.admit_seq = next(self._admits)
             self.slots[slot] = clone
             self._phase_end(clone)          # close "queued" (fork-pending)
-            _observe.event("serving_fork", request=clone.request_id,
+            self.obs.event("serving_fork", request=clone.request_id,
                            parent=primary.request_id, slot=slot,
                            shared_pages=len(pages) - copied, copied=copied)
             self._phase_begin(clone, DECODE)
@@ -1190,7 +1204,7 @@ class ServingEngine:
         self._close_request_span(req)
         if req.decode_start_s is not None:
             # per-request decode-phase duration (first token -> completion)
-            _observe.observe_value(
+            self.obs.observe_value(
                 "serving.decode_ms", (req.finished_s - req.decode_start_s) * 1e3)
         self.completed.append(req)
         self._slo_total += 1
@@ -1198,7 +1212,7 @@ class ServingEngine:
             self._slo_attained += 1
         else:
             # completed, but late: an SLO miss even though tokens shipped
-            _observe.inc("serving.deadline_misses")
-        _observe.event("serving_complete", request=req.request_id,
+            self.obs.inc("serving.deadline_misses")
+        self.obs.event("serving_complete", request=req.request_id,
                        generated=len(req.generated),
                        preemptions=req.preemptions, restarts=req.restarts)

@@ -31,6 +31,12 @@ numerics sentinel); :class:`EngineSupervisor` is the serving counterpart:
   never raises, but its heartbeat age climbs
   (``runtime.heartbeat_age_s``) and ``on_stall`` fires instead of the
   engine hanging forever unobserved.
+- **statusz.** With ``statusz_dir=`` set, :meth:`step` also writes an
+  atomic per-engine JSON status snapshot (same tmp+rename discipline as
+  the heartbeat, throttled to ``statusz_interval_s``): engine vitals plus
+  the health verdict when a :class:`~thunder_tpu.serving.health
+  .FleetObservatory` attached one. A directory of these files IS the
+  fleet's cross-process view (``FleetObservatory.aggregate_statusz``).
 
 >>> sup = EngineSupervisor(engine, max_restarts=3, restart_window_s=600.0)
 >>> req = sup.submit(prompt, max_new_tokens=32, deadline_s=30.0)
@@ -81,13 +87,28 @@ class EngineSupervisor:
                  stall_timeout_s: float = 30.0,
                  on_stall: Callable[[float], None] | None = None,
                  postmortem_dir: str | None = None,
-                 slo_floor: float | None = None, min_slo_samples: int = 8):
+                 slo_floor: float | None = None, min_slo_samples: int = 8,
+                 statusz_dir: str | None = None,
+                 statusz_interval_s: float = 1.0):
         self.engine = engine
+        # all supervisor emissions carry the supervised engine's label —
+        # fleet aggregation keys on it
+        self._obs = engine.obs
         self.budget = restart_budget or _retry.RestartBudget(
             max_restarts=max_restarts, window_s=restart_window_s)
         self.restarts = 0
         self.on_stall = on_stall
         self.postmortem_dir = postmortem_dir
+        # attached by FleetObservatory.add(); stays None when unsupervised
+        # by a fleet plane (statusz payloads then carry health: None)
+        self.health = None
+        self.statusz = None
+        if statusz_dir is not None:
+            from thunder_tpu.observe import statusz as _statusz
+
+            self.statusz = _statusz.StatusWriter(
+                statusz_dir, engine.engine_id,
+                interval_s=statusz_interval_s)
         self.slo_floor = slo_floor
         self.min_slo_samples = int(min_slo_samples)
         self._slo_collapsed = False     # latched: one bundle per collapse
@@ -120,6 +141,8 @@ class EngineSupervisor:
         (a restart counts — recovery IS progress)."""
         if self.heartbeat is not None:
             self.heartbeat.beat(self.engine._step_count)
+        if self.statusz is not None:
+            self.statusz.maybe_write(self.status_payload())
         try:
             worked = self.engine.step()
         except EngineFault as e:
@@ -130,6 +153,30 @@ class EngineSupervisor:
             return True
         self._check_slo()
         return worked
+
+    def status_payload(self) -> dict:
+        """The /statusz snapshot body: cheap per-step engine vitals (no
+        ``describe_state`` — that audits quiescence; this is a heartbeat
+        with content). Health state rides along when a fleet plane
+        attached an :class:`~thunder_tpu.serving.health.EngineHealth`."""
+        eng = self.engine
+        return {
+            "step": eng._step_count,
+            "admitting": eng.admitting,
+            "queue_depth": len(eng.queue),
+            "max_queue": eng.max_queue,
+            "active_requests": eng.active_requests,
+            "pages_free": eng.cache.pages_free,
+            "pages_total": eng.cache.pages_total,
+            "completed": len(eng.completed),
+            "shed": len(eng.shed),
+            "slo_attained": eng._slo_attained,
+            "slo_total": eng._slo_total,
+            "decode_rebinds": eng.decode_rebinds,
+            "restarts": self.restarts,
+            "budget": self.budget.describe(),
+            "health": (self.health.state if self.health is not None else None),
+        }
 
     def drain(self, *, deadline_s: float | None = None,
               max_steps: int = 1_000_000) -> list[Request]:
@@ -152,7 +199,7 @@ class EngineSupervisor:
                         time.perf_counter() - t0 > deadline_s:
                     victims = eng.shed_outstanding(
                         f"drain wall-clock bound ({deadline_s}s) expired")
-                    _observe.event("serving_drain_bound_expired",
+                    self._obs.event("serving_drain_bound_expired",
                                    shed=[r.request_id for r in victims])
                     break
                 if not self.step():
@@ -165,10 +212,10 @@ class EngineSupervisor:
             self.dump_postmortem(e)     # a stall IS the black-box case
             raise
         finally:
-            _observe.observe_value("serving.drain_ms",
+            self._obs.observe_value("serving.drain_ms",
                                    (time.perf_counter() - t0) * 1e3)
             # the drain episode on the scheduler track, next to its steps
-            _observe.record_span("drain", "serving:sched", t0_us,
+            self._obs.record_span("drain", "serving:sched", t0_us,
                                  _observe._now_us() - t0_us,
                                  {"completed": len(eng.completed),
                                   "shed": len(eng.shed)})
@@ -183,13 +230,19 @@ class EngineSupervisor:
             self.close()
 
     def close(self) -> None:
-        """Stop the watchdog thread (idempotent). Does not drain."""
+        """Stop the watchdog thread (idempotent). Does not drain. Flushes
+        a final statusz snapshot so the terminal state is on disk."""
+        if self.statusz is not None:
+            try:
+                self.statusz.write(self.status_payload())
+            except Exception:
+                pass
         if self.watchdog is not None:
             self.watchdog.stop()
 
     # -- recovery internals -------------------------------------------------
     def _escalate_stall(self, age_s: float) -> None:
-        _observe.event("serving_engine_stalled", age_s=age_s,
+        self._obs.event("serving_engine_stalled", age_s=age_s,
                        step=self.engine._step_count)
         # a hung engine is the paradigm black-box case: dump the ring
         # before the operator kills the process and it's gone (the
@@ -225,7 +278,7 @@ class EngineSupervisor:
         ratio = (eng._slo_attained - base_a) / total
         if ratio < self.slo_floor:
             self._slo_collapsed = True
-            _observe.event("serving_slo_collapse", attainment=round(ratio, 4),
+            self._obs.event("serving_slo_collapse", attainment=round(ratio, 4),
                            floor=self.slo_floor, samples=total)
             self.dump_postmortem(
                 RuntimeError(f"SLO attainment collapsed: {ratio:.3f} < floor "
@@ -289,12 +342,15 @@ class EngineSupervisor:
                 .last_decisions
         part("decisions.json", decisions)
         part("MANIFEST.json", lambda: {
+            "engine_id": self.engine.engine_id,
             "cause": repr(cause),
             "cause_type": (type(cause).__name__
                            if isinstance(cause, BaseException) else "str"),
             "created_s": time.time(),
             "step": self.engine._step_count,
             "restarts": self.restarts,
+            "health": (self.health.state if self.health is not None
+                       else None),
             "budget": self.budget.describe(),
             "flight_records": n_flight,
             "registry_enabled": _observe.is_enabled(),
@@ -302,15 +358,15 @@ class EngineSupervisor:
             "files": ["flight.jsonl", "engine.json", "registry.json",
                       "timeline.json", "decisions.json"],
         })
-        _observe.inc("serving.postmortems")
-        _observe.event("serving_postmortem", path=path, cause=repr(cause))
+        self._obs.inc("serving.postmortems")
+        self._obs.event("serving_postmortem", path=path, cause=repr(cause))
         return path
 
     def _restart(self, cause: BaseException) -> None:
         """The engine-level fallback rung: charge the sliding-window
         budget, rebuild pools + binding, re-admit in-flight requests."""
         if not self.budget.record():
-            _observe.event("serving_restart_budget_exhausted",
+            self._obs.event("serving_restart_budget_exhausted",
                            cause=repr(cause), budget=self.budget.describe())
             err = RestartBudgetExceeded(
                 f"engine restart budget exhausted "
@@ -327,8 +383,8 @@ class EngineSupervisor:
         recovered = self.engine.rebuild_after_fault(
             getattr(cause, "restart_state", None))
         self.restarts += 1
-        _observe.inc("serving.engine_restarts")
-        _observe.event("serving_engine_restart", cause=repr(cause),
+        self._obs.inc("serving.engine_restarts")
+        self._obs.event("serving_engine_restart", cause=repr(cause),
                        recovered=[r.request_id for r in recovered],
                        restart_ms=(time.perf_counter() - t0) * 1e3,
                        budget=self.budget.describe())
