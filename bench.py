@@ -45,6 +45,11 @@ import time
 # The shared bench JSON-line contract version, stamped by every bench in the
 # repo (bench.py, bench_generate.py, bench_serve.py) so one CI reader parses
 # them all: {metrics_schema, metric, value, unit, vs_baseline, ...extras}.
+# 13: bench_serve --fleet stamps the fleet-router scenario (fleet_engines /
+# aggregate_toks_s / scaling_vs_single vs one engine of identical geometry,
+# affinity_hit_rate vs a random-placement control arm, migrated_requests
+# from the mid-run engine-kill failover, and the affinity arm's TTFT
+# percentiles);
 # 12: bench_serve stamps engine-labeled/fleet fields (engine_id on every
 # serving line, with gauge-sourced numbers read from the TIMED engine's
 # labeled series instead of the process-global gauge any co-resident
@@ -77,7 +82,7 @@ import time
 # (whole-decode-layer megakernel, registry-sourced); 3 added block_fusions
 # (Fusion 3.0) + slab_persistent; 2 introduced registry-sourced fusion
 # counters; 1 grepped trace source for markers.
-METRICS_SCHEMA = 12
+METRICS_SCHEMA = 13
 
 
 def main():

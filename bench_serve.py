@@ -82,10 +82,29 @@ CENSUS_BUDGETS.json budget is ≤2 per layer with zero gathers) and the
 ``--xla_force_host_platform_device_count``; the smoke uses the tiny-tp
 geometry (everything divides tp=8).
 
+``--fleet`` runs the FLEET-ROUTER scenario (ISSUE 20): ``SERVE_GROUPS``
+prefix groups (each a shared multi-page prefix + per-request suffix) with
+INTERLEAVED arrivals, served three ways with identical per-engine
+geometry — ONE engine (whose prefix-cache pool cannot park every group's
+chain: the trie thrashes and prefills run cold), then
+``SERVE_FLEET_ENGINES`` engines behind a ``FleetRouter`` with the
+default health-gated / prefix-affine / least-loaded chain (each engine
+keeps its share of the groups warm — placement as a performance
+optimization), then the same fleet behind a seeded RANDOM-placement
+control arm. The schema-13 JSON line stamps ``fleet_engines``,
+``aggregate_toks_s``, ``scaling_vs_single`` (the acceptance gate wants
+>= 1.8x on 2 engines), ``affinity_hit_rate`` vs ``random_hit_rate``
+(affinity must beat random), ``ttft_ms_p50/p99`` from the affinity arm,
+and ``migrated_requests`` from a mid-run engine kill: a zero-restart-
+budget engine dies mid-decode, the router re-admits its in-flight
+requests on the survivor token-identically with zero deadline misses.
+
 Env: SERVE_MODEL, SERVE_LAYERS, SERVE_REQUESTS, SERVE_DECODE, SERVE_SLOTS,
 SERVE_CONTEXT, SERVE_PAGE, SERVE_CHUNK, SERVE_RATE, SERVE_DEADLINE_S,
-SERVE_QUEUE, SERVE_SYS, SERVE_BESTOF, SERVE_TP, SERVE_TRACE. ``--smoke``:
-tiny GQA geometry on CPU (tiny-tp under ``--mesh``).
+SERVE_QUEUE, SERVE_SYS, SERVE_BESTOF, SERVE_TP, SERVE_TRACE,
+SERVE_FLEET_ENGINES, SERVE_GROUPS, SERVE_GROUP_REQUESTS,
+SERVE_POOL_PAGES, SERVE_PREFIX_PAGES. ``--smoke``: tiny GQA geometry on
+CPU (tiny-tp under ``--mesh``).
 """
 
 from __future__ import annotations
@@ -112,6 +131,7 @@ def main():
     overload = "--overload" in sys.argv
     prefix = "--prefix" in sys.argv
     mesh = "--mesh" in sys.argv
+    fleet = "--fleet" in sys.argv
     if mesh and "tpu" not in os.environ.get("JAX_PLATFORMS", ""):
         # the CPU mesh needs its devices BEFORE the backend initializes:
         # tp host devices (tp from SERVE_TP, default 8), same trick as
@@ -141,6 +161,18 @@ def main():
         # decodes (TTFT is the story), context wide enough for prompt+decode
         os.environ.setdefault("SERVE_CONTEXT", "256")
         os.environ.setdefault("SERVE_DECODE", "16")
+    if fleet and smoke:
+        # fleet smoke: prompts of 9 prefix pages + 1 suffix page on a pool
+        # that cannot park every group's chain at once — the single-engine
+        # arm MUST thrash (that capacity cliff, not parallel compute, is
+        # what affinity routing recovers; on a 1-core host the engines
+        # can't overlap anyway); short decodes keep prefill dominant
+        os.environ.setdefault("SERVE_LAYERS", "1")
+        os.environ.setdefault("SERVE_DECODE", "5")
+        os.environ.setdefault("SERVE_SLOTS", "2")
+        os.environ.setdefault("SERVE_CONTEXT", "176")
+        os.environ.setdefault("SERVE_PAGE", "16")
+        os.environ.setdefault("SERVE_CHUNK", "16")
     if smoke:
         os.environ.setdefault("SERVE_MODEL", "tiny-gqa")
         os.environ.setdefault("SERVE_LAYERS", "1")
@@ -476,6 +508,176 @@ def main():
             n = observe.export_chrome_trace(trace_path)
             print(f"serving timeline: {n} trace events -> {trace_path}",
                   file=sys.stderr)
+        return
+
+    # ---- fleet scenario: health-aware cache-affine routing ----------------
+    if fleet:
+        from thunder_tpu.runtime import faults
+        from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+        from thunder_tpu.runtime.retry import RestartBudget, RetryPolicy
+        from thunder_tpu.serving import (
+            DEAD,
+            EngineSupervisor,
+            FleetObservatory,
+            FleetRouter,
+            HealthGate,
+            HealthPolicy,
+            RandomPlacement,
+        )
+
+        n_engines = int(os.environ.get("SERVE_FLEET_ENGINES", "2"))
+        groups = int(os.environ.get("SERVE_GROUPS", "6"))
+        per_group = int(os.environ.get("SERVE_GROUP_REQUESTS", "6"))
+        pool_pages = int(os.environ.get("SERVE_POOL_PAGES", "56"))
+        prefix_pages = int(os.environ.get("SERVE_PREFIX_PAGES", "9"))
+        pre_len, sfx_len = prefix_pages * page, page
+        # G prefix groups with INTERLEAVED arrivals: the worst case for one
+        # engine's LRU trie (the pool can't park every group's chain, so
+        # each arrival evicts the next group's pages), the best case for
+        # affinity routing (each engine keeps its share of the groups warm)
+        group_prefixes = [rng.randint(1, cfg.vocab_size,
+                                      size=pre_len).astype(np.int32)
+                          for _ in range(groups)]
+        fleet_prompts = [np.concatenate(
+            [group_prefixes[g],
+             rng.randint(1, cfg.vocab_size, size=sfx_len).astype(np.int32)])
+            for _ in range(per_group) for g in range(groups)]
+        n_fleet = len(fleet_prompts)
+        fleet_tokens = n_fleet * n_decode
+
+        def mk_engine():
+            return ServingEngine(
+                params, cfg, max_slots=slots, page_size=page,
+                max_context=max_context, n_layers=n_layers,
+                prefill_chunk=chunk, prefix_cache=True,
+                num_pages=pool_pages,
+                retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                                         max_delay_s=0.01))
+
+        def warm(eng):
+            # compile-warm prefill + decode at the real lengths, then clear
+            # the trie/completions so every timed round starts cold
+            for _ in range(2):
+                eng.submit(rng.randint(1, cfg.vocab_size,
+                                       size=pre_len + sfx_len)
+                           .astype(np.int32), max_new_tokens=2)
+            eng.drain()
+            eng.prefix.clear()
+            eng.completed.clear()
+
+        def run_round(submit, drain, engines):
+            for e in engines:
+                e.prefix.clear()
+                e.completed.clear()
+            t0 = time.perf_counter()
+            reqs = [submit(p, n_decode) for p in fleet_prompts]
+            drain()
+            wall = time.perf_counter() - t0
+            hit = sum(1 for r in reqs if r.prefix_hit_tokens > 0) / len(reqs)
+            return wall, hit, sorted(r.ttft_s * 1e3 for r in reqs)
+
+        def best_of(submit, drain, engines, rounds):
+            best = None
+            for _ in range(rounds):
+                w, hit, ttfts = run_round(submit, drain, engines)
+                if best is None or w < best[0]:
+                    best = (w, hit, ttfts)
+            return best
+
+        rounds = 3 if smoke else 2
+        single = mk_engine()
+        warm(single)
+        s_wall, s_hit, _ = best_of(single.submit, single.drain, [single],
+                                   rounds)
+        single.assert_quiescent()
+
+        def mk_router(policies=None):
+            sups = [EngineSupervisor(mk_engine()) for _ in range(n_engines)]
+            for s in sups:
+                warm(s.engine)
+            # this workload deliberately runs the pool full of PARKED
+            # prefix pages (refcount 0, evictable on demand) — low
+            # pages_free is the design, not page pressure, so the gate
+            # must not read it as DEGRADED
+            return FleetRouter(sups, policies=policies,
+                               observatory=FleetObservatory(
+                                   policy=HealthPolicy(
+                                       page_free_degraded=0.0)))
+
+        aff = mk_router()               # default health/affinity/load chain
+        a_wall, a_hit, a_ttfts = best_of(
+            aff.submit, aff.drain, list(aff.engines.values()), rounds)
+        aff.assert_quiescent()
+        rnd = mk_router([HealthGate(), RandomPlacement(seed=0)])
+        r_wall, r_hit, _ = best_of(
+            rnd.submit, rnd.drain, list(rnd.engines.values()), rounds)
+        rnd.assert_quiescent()
+
+        # -- mid-run kill: failover re-admission stays token-identical ------
+        kill_prompts = [rng.randint(1, cfg.vocab_size,
+                                    size=24).astype(np.int32)
+                        for _ in range(6)]
+        kill_refs = [np.asarray(llama.generate(params, cfg, p[None],
+                                               n_decode,
+                                               n_layers=n_layers))[0]
+                     for p in kill_prompts]
+        # zero restart budget: the first crash is terminal, so recovery IS
+        # the router's failover (zero headroom reads DEGRADED under the
+        # default health policy — this fleet runs without restart masking)
+        ksups = [EngineSupervisor(mk_engine(), restart_budget=RestartBudget(
+                     max_restarts=0, window_s=3600.0)) for _ in range(2)]
+        for s in ksups:
+            warm(s.engine)
+        krouter = FleetRouter(ksups, observatory=FleetObservatory(
+            policy=HealthPolicy(restart_headroom_min=0)))
+        kreqs = [krouter.submit(p, n_decode, deadline_s=120.0)
+                 for p in kill_prompts]
+        with faults.active(FaultPlan([FaultSpec("serving:engine",
+                                                every_n=8, max_fires=1)])):
+            krouter.drain()
+        assert all(r.done for r in kreqs), "kill run lost requests"
+        for r, ref in zip(kreqs, kill_refs):
+            np.testing.assert_array_equal(r.output(), ref)
+        assert sum(1 for st in krouter.states.values() if st == DEAD) == 1
+        migrated = [d for d in krouter.decisions if d["kind"] == "migrate"]
+        assert migrated, "the killed engine had nothing in flight"
+        krouter.assert_quiescent()      # the dead engine's pools included
+        misses = int(observe.snapshot()["counters"].get(
+            "serving.deadline_misses", 0))
+        assert misses == 0, f"failover caused {misses} deadline misses"
+
+        s_tok, a_tok, r_tok = (fleet_tokens / w
+                               for w in (s_wall, a_wall, r_wall))
+        scaling = a_tok / s_tok
+        assert scaling >= 1.8, (
+            f"fleet scaling {scaling:.2f}x < 1.8x over single engine")
+        assert a_hit > r_hit, (
+            f"affinity hit rate {a_hit:.2f} <= random {r_hit:.2f}")
+        print(f"fleet: {n_engines} engines, {groups} prefix groups x "
+              f"{per_group} requests — single {s_tok:.0f} tok/s (hit "
+              f"{s_hit:.2f}), affinity {a_tok:.0f} tok/s (hit {a_hit:.2f}, "
+              f"{scaling:.2f}x), random {r_tok:.0f} tok/s (hit {r_hit:.2f})"
+              f"; kill migrated {len(migrated)} token-identical, "
+              f"{misses} deadline misses", file=sys.stderr)
+        print(json.dumps({
+            "metrics_schema": METRICS_SCHEMA,
+            "metric": f"{geom} fleet ({n_engines} engines) aggregate "
+                      f"decode tokens/s",
+            "value": round(a_tok, 1), "unit": "tokens/s",
+            "vs_baseline": round(scaling, 3),
+            "requests": n_fleet, "decode_tokens": n_decode,
+            # schema-13 fleet-router fields
+            "fleet_engines": n_engines,
+            "aggregate_toks_s": round(a_tok, 1),
+            "single_toks_s": round(s_tok, 1),
+            "random_toks_s": round(r_tok, 1),
+            "scaling_vs_single": round(scaling, 3),
+            "affinity_hit_rate": round(a_hit, 3),
+            "random_hit_rate": round(r_hit, 3),
+            "single_hit_rate": round(s_hit, 3),
+            "migrated_requests": len(migrated),
+            "ttft_ms_p50": round(_percentile(a_ttfts, 0.50), 2),
+            "ttft_ms_p99": round(_percentile(a_ttfts, 0.99), 2)}))
         return
 
     # ---- sequential single-stream baseline (dense cache + bind) -----------

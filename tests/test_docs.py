@@ -82,7 +82,13 @@ def test_serving_metric_names_documented():
                      "serving.slo_attainment",
                      # the shared-prefix serving family (ISSUE 14)
                      "serving.prefix_hit_rate", "serving.cached_pages",
-                     "serving.cow_copies", "serving.cache_evictions"):
+                     "serving.cow_copies", "serving.cache_evictions",
+                     # the fleet-router family (ISSUE 20)
+                     "serving.router_decisions",
+                     "serving.router_affinity_hits",
+                     "serving.router_migrated_requests",
+                     "serving.router_rebalanced_requests",
+                     "serving.router_rejections"):
         assert required in names, f"code no longer emits {required}"
     with open(DOC) as f:
         doc = f.read()

@@ -436,6 +436,7 @@ def test_explain_section_coverage_audit():
         "numerics sentinel",
         "serving",
         "serving fleet",
+        "fleet router",
         "serving prefix cache",
         "serving slo/supervision",
         "request timeline",

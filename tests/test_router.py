@@ -1,0 +1,490 @@
+"""Fleet router tests: health-gated / cache-affine / least-loaded policy
+chain with a decision log for every placement, fleet-edge SLO admission
+(bounded queue + priorities applied before an engine is picked), failover
+re-admission of in-flight requests off a dead engine (token-identical,
+recompute-on-resume), drain-time rebalance, engine_id-attributed typed
+errors, the ``content_key`` <-> trie-chain correspondence, and the 3-engine
+chaos soak. CPU-only, tier-1."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from thunder_tpu import observe
+from thunder_tpu.models import llama
+from thunder_tpu.observe import flight
+from thunder_tpu.runtime import faults, quarantine
+from thunder_tpu.runtime.faults import FaultPlan, FaultSpec
+from thunder_tpu.runtime.retry import RestartBudget, RetryPolicy
+from thunder_tpu.serving import (
+    DEAD,
+    DRAINING,
+    AdmissionRejected,
+    DeadlineExceeded,
+    EngineFault,
+    EngineSupervisor,
+    FleetObservatory,
+    FleetRouter,
+    HealthPolicy,
+    InfeasibleRequest,
+    PrefixAffinity,
+    RestartBudgetExceeded,
+    ServingEngine,
+    content_key,
+)
+from thunder_tpu.serving.prefix_cache import page_chunks
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    observe.disable()
+    observe.reset()
+    quarantine.reset()
+    flight.clear()
+    yield
+    observe.disable()
+    observe.reset()
+    quarantine.reset()
+    faults.clear()
+    flight.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.CONFIGS["tiny-gqa"]
+    return cfg, llama.init_params(cfg, seed=0, scale_layers=1)
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(max_slots=3, page_size=16, max_context=64, n_layers=1,
+                    prefill_chunk=32,
+                    retry_policy=RetryPolicy(max_attempts=3,
+                                             base_delay_s=0.001,
+                                             max_delay_s=0.01))
+    defaults.update(kw)
+    return ServingEngine(params, cfg, **defaults)
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size, size=L).astype(np.int32)
+            for L in lens]
+
+
+def _references(params, cfg, prompts, max_new):
+    return [np.asarray(llama.generate(params, cfg, p[None], max_new,
+                                      n_layers=1))[0]
+            for p in prompts]
+
+
+def _fleet(params, cfg, n=2, *, budget=None, observatory=None,
+           router_kw=None, **engine_kw):
+    sups = []
+    for _ in range(n):
+        kw = {} if budget is None else {
+            "restart_budget": RestartBudget(max_restarts=budget,
+                                            window_s=3600.0)}
+        sups.append(EngineSupervisor(_engine(params, cfg, **engine_kw), **kw))
+    return FleetRouter(sups, observatory=observatory, **(router_kw or {}))
+
+
+# ---------------------------------------------------------------------------
+# routing policies + decision log
+# ---------------------------------------------------------------------------
+
+def test_router_spreads_load_and_logs_every_decision(model):
+    """Short prompts (nothing cacheable) route least-loaded and spread;
+    every placement lands in the decision log with the engine chosen, the
+    policy, its score inputs, and the alternatives it rejected — and the
+    routed outputs are token-identical to direct generation."""
+    cfg, params = model
+    prompts = _prompts(cfg, (5, 9, 13, 7))
+    refs = _references(params, cfg, prompts, 6)
+    router = _fleet(params, cfg, 2)
+    reqs = [router.submit(p, 6) for p in prompts]
+    done = router.drain()
+    assert len(done) == 4
+    for r, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(r.output(), ref)
+    router.assert_quiescent()
+    routes = [d for d in router.decisions if d["kind"] == "route"]
+    assert len(routes) == 4
+    assert {d["engine"] for d in routes} == set(router.sups)  # both used
+    for d in routes:
+        assert d["policy"] == "least_loaded"
+        assert d["engine"] not in d["alternatives"]
+        assert len(d["alternatives"]) == 1
+        # score inputs for the winning policy are recorded
+        scored = [p for p in d["policies"] if p["policy"] == "least_loaded"]
+        assert scored and "scores" in scored[0]
+        assert set(scored[0]["scores"]) == set(router.sups)
+
+
+def test_health_gate_never_routes_to_draining_engine(model):
+    """The gate leg: a DRAINING engine leaves the candidate set (the
+    rejection is recorded with the health verdict), and when NO engine is
+    routable the router rejects typed at the fleet edge with
+    ``engine_id=None`` — the rejection happened above any engine."""
+    cfg, params = model
+    router = _fleet(params, cfg, 2)
+    eids = sorted(router.sups)
+    router.engines[eids[1]].stop_admissions()
+    router.states = router.fleet.check()
+    assert router.states[eids[1]] == DRAINING
+    reqs = [router.submit(p, 4) for p in _prompts(cfg, (5, 9, 6))]
+    routes = [d for d in router.decisions if d["kind"] == "route"]
+    assert all(d["engine"] == eids[0] for d in routes)
+    assert all(d["rejected"] == {eids[1]: DRAINING} for d in routes)
+    router.engines[eids[0]].stop_admissions()
+    router.states = router.fleet.check()
+    with pytest.raises(AdmissionRejected) as ei:
+        router.submit(_prompts(cfg, (5,))[0], 4)
+    assert ei.value.engine_id is None
+    router.engines[eids[0]].admitting = True
+    router.engines[eids[1]].admitting = True
+    router.states = router.fleet.check()
+    done = router.drain()
+    assert len(done) == len(reqs)
+    router.assert_quiescent()
+
+
+def test_prefix_affinity_prefers_warm_engine(model):
+    """The cache-affine leg: a cold shared prefix hash-pins to one
+    engine; once that engine's trie is warm (first request completed and
+    donated), every repeat of the prefix routes back to it with basis
+    ``warm_hit`` and actually hits (prefix_hit_tokens > 0) — warm TTFT
+    as a placement outcome. The affinity counter records it."""
+    cfg, params = model
+    rng = np.random.RandomState(3)
+    prefix = rng.randint(1, cfg.vocab_size, size=32).astype(np.int32)
+    mk = lambda: np.concatenate(
+        [prefix, rng.randint(1, cfg.vocab_size, size=6).astype(np.int32)])
+    observe.enable(clear=True)
+    try:
+        router = _fleet(params, cfg, 2, prefix_cache=True)
+        r0 = router.submit(mk(), 4)
+        first = [d for d in router.decisions if d["kind"] == "route"][0]
+        assert first["policy"] == "prefix_affinity"
+        assert first["basis"] == "hash_pin"
+        router.drain()
+        for _ in range(2):
+            req = router.submit(mk(), 4)
+            d = [x for x in router.decisions if x["kind"] == "route"][-1]
+            assert d["engine"] == first["engine"]
+            assert d["basis"] == "warm_hit"
+            router.drain()
+            assert req.prefix_hit_tokens >= 32
+        snap = observe.snapshot()
+    finally:
+        observe.disable()
+    assert snap["counters"]["serving.router_affinity_hits"] == 2
+    assert snap["counters"]["serving.router_decisions"] == 3
+    router.assert_quiescent()
+
+
+def test_prefix_affinity_respects_load_imbalance_bound(model):
+    """Affinity is a preference, not a load-balancing override: when the
+    warm engine is ``imbalance_bound`` deeper in waiting work than the
+    least-loaded sibling, affinity abstains (the abstention and its
+    reason are logged) and least-loaded places the request."""
+    cfg, params = model
+    rng = np.random.RandomState(4)
+    prefix = rng.randint(1, cfg.vocab_size, size=32).astype(np.int32)
+    mk = lambda: np.concatenate(
+        [prefix, rng.randint(1, cfg.vocab_size, size=6).astype(np.int32)])
+    router = _fleet(params, cfg, 2, prefix_cache=True,
+                    router_kw={"policies": None})
+    router.policies[1] = PrefixAffinity(imbalance_bound=2)
+    router.submit(mk(), 4)
+    warm_eid = [d for d in router.decisions][-1]["engine"]
+    router.drain()
+    # pile un-steppable work on the warm engine: 3 queued vs 0 elsewhere
+    for p in _prompts(cfg, (5, 7, 9), seed=9):
+        router.engines[warm_eid].submit(p, 4)
+    router.submit(mk(), 4)
+    d = [x for x in router.decisions if x["kind"] == "route"][-1]
+    assert d["policy"] == "least_loaded"
+    assert d["engine"] != warm_eid
+    affinity_note = [p for p in d["policies"]
+                     if p["policy"] == "prefix_affinity"][0]
+    assert "imbalance" in affinity_note["abstain"]
+    router.drain()
+    router.assert_quiescent()
+
+
+def test_fleet_edge_admission_sheds_before_placement(model):
+    """The SLO-at-the-edge leg: with a fleet-wide bounded queue, a
+    higher-priority arrival sheds the fleet-wide lowest-priority QUEUED
+    request (typed, attributed to the engine it was queued on), and a
+    lower-priority arrival is rejected at the router (engine_id=None) —
+    one decision at the edge, not per-engine ping-pong."""
+    cfg, params = model
+    prompts = _prompts(cfg, (5, 9, 6, 7))
+    observe.enable(clear=True)
+    try:
+        router = _fleet(params, cfg, 2, router_kw={"max_queue": 2})
+        kept = [router.submit(prompts[0], 4, priority=1),
+                router.submit(prompts[1], 4, priority=1)]
+        # queue full of priority-1: a priority-0 newcomer loses
+        with pytest.raises(AdmissionRejected) as ei:
+            router.submit(prompts[2], 4, priority=0)
+        assert ei.value.engine_id is None
+        # a priority-2 newcomer sheds the lowest-priority queued victim...
+        victim = kept[1]
+        high = router.submit(prompts[3], 4, priority=2)
+        assert victim.failed
+        assert isinstance(victim.error, AdmissionRejected)
+        assert victim.error.engine_id in router.sups
+        rejects = [d for d in router.decisions if d["kind"] == "reject"]
+        assert len(rejects) == 2
+        done = router.drain()
+        snap = observe.snapshot()
+    finally:
+        observe.disable()
+    # ...and the survivors (including the high-priority arrival) complete
+    assert set(done) == {kept[0], high}
+    assert snap["counters"]["serving.router_rejections"] == 2
+    kinds = [e["kind"] for e in snap["events"]]
+    assert kinds.count("serving_route_reject") == 2
+    router.assert_quiescent()
+
+
+# ---------------------------------------------------------------------------
+# failover re-admission + rebalance
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_failover_migrates_in_flight_token_identical(model, tmp_path):
+    """The failover leg: an engine with no restart budget dies mid-decode
+    (refused restart = RestartBudgetExceeded out of its supervised step);
+    the router migrates its in-flight requests to the surviving sibling
+    via recompute-on-resume — every output token-identical to a
+    fault-free run, the dead engine ends quiescent, the decision log and
+    flight ring name the migration, and the DEAD transition's
+    cross-engine postmortem bundle embeds those migration events."""
+    cfg, params = model
+    prompts = _prompts(cfg, (5, 9, 17, 21))
+    refs = _references(params, cfg, prompts, 6)
+    obs = FleetObservatory(policy=HealthPolicy(restart_headroom_min=0),
+                           postmortem_dir=str(tmp_path))
+    observe.enable(clear=True)
+    try:
+        router = _fleet(params, cfg, 2, budget=0, observatory=obs,
+                        prefix_cache=True)
+        reqs = [router.submit(p, 6) for p in prompts]
+        with faults.active(FaultPlan([FaultSpec("serving:engine",
+                                                at_steps={3})])):
+            done = router.drain()
+        snap = observe.snapshot()
+    finally:
+        observe.disable()
+    assert len(done) == 4
+    for r, ref in zip(reqs, refs):
+        assert r.done
+        np.testing.assert_array_equal(r.output(), ref)
+    router.assert_quiescent()           # the dead engine's pools too
+    migs = [d for d in router.decisions if d["kind"] == "migrate"]
+    assert migs
+    dead = [eid for eid, st in router.states.items() if st == DEAD]
+    assert len(dead) == 1
+    assert all(d["from_engine"] == dead[0] for d in migs)
+    migrated_ids = {d["request"] for d in migs}
+    assert snap["counters"]["serving.router_migrated_requests"] == len(migs)
+    events = [e for e in snap["events"]
+              if e["kind"] == "serving_route_migrate"]
+    assert {e["request"] for e in events} == migrated_ids
+    # the migrated requests restarted exactly once (one re-prefill)
+    for r in reqs:
+        assert r.restarts == (1 if r.request_id in migrated_ids else 0)
+    # the cross-engine bundle names the migrated requests via its flight
+    # ring copy (the serving_route_migrate records)
+    bundles = [d for d in os.listdir(tmp_path) if "fleet" in d]
+    assert len(bundles) == 1
+    with open(os.path.join(tmp_path, bundles[0], "flight.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    named = {r["request"] for r in recs
+             if r.get("kind") == "serving_route_migrate"}
+    assert named == migrated_ids
+
+
+def test_rebalance_migrates_queued_off_draining_engine(model):
+    """The drain leg: ``rebalance()`` moves QUEUED requests off a
+    DRAINING engine onto a routable sibling (residents would keep their
+    KV and finish in place); the move is logged and the drained engine's
+    queue empties without shedding anything."""
+    cfg, params = model
+    prompts = _prompts(cfg, (5, 9, 13))
+    refs = _references(params, cfg, prompts, 5)
+    observe.enable(clear=True)
+    try:
+        router = _fleet(params, cfg, 2)
+        eids = sorted(router.sups)
+        reqs = [router.engines[eids[1]].submit(p, 5) for p in prompts]
+        router.engines[eids[1]].stop_admissions()
+        moved = router.rebalance()
+        assert [r.request_id for r in moved] == [r.request_id for r in reqs]
+        assert not router.engines[eids[1]].queue
+        assert len(router.engines[eids[0]].queue) == 3
+        done = router.drain()
+        snap = observe.snapshot()
+    finally:
+        observe.disable()
+    assert len(done) == 3
+    for r, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(r.output(), ref)
+    rebs = [d for d in router.decisions if d["kind"] == "rebalance"]
+    assert [d["request"] for d in rebs] == [r.request_id for r in reqs]
+    assert all(d["from_engine"] == eids[1] and d["engine"] == eids[0]
+               for d in rebs)
+    assert snap["counters"]["serving.router_rebalanced_requests"] == 3
+    assert sum(1 for e in snap["events"]
+               if e["kind"] == "serving_route_rebalance") == 3
+    router.assert_quiescent()
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_soak_kill_one_engine_under_mixed_priority(model):
+    """The acceptance soak: seeded faults kill ONE of three engines
+    mid-decode under mixed-priority traffic; every surviving request is
+    token-identical to a fault-free reference, zero deadline misses among
+    accepted requests, all pools quiescent, and the decision log shows
+    the migration."""
+    cfg, params = model
+    rng = np.random.RandomState(42)
+    lengths = (5, 17, 9, 21, 12, 7, 19, 6, 15, 11, 8, 13)
+    prompts = _prompts(cfg, lengths, seed=42)
+    priorities = [int(rng.randint(0, 3)) for _ in prompts]
+    refs = _references(params, cfg, prompts, 6)
+    obs = FleetObservatory(policy=HealthPolicy(restart_headroom_min=0))
+    observe.enable(clear=True)
+    try:
+        router = _fleet(params, cfg, 3, budget=0, observatory=obs,
+                        prefix_cache=True)
+        reqs = [router.submit(p, 6, priority=pr, deadline_s=120.0)
+                for p, pr in zip(prompts, priorities)]
+        with faults.active(FaultPlan([FaultSpec("serving:engine",
+                                                at_steps={7})])):
+            done = router.drain()
+        snap = observe.snapshot()
+    finally:
+        observe.disable()
+    # no overload, generous deadlines: every accepted request survives
+    assert len(done) == len(prompts)
+    for r, ref in zip(reqs, refs):
+        assert r.done, (r.request_id, r.state)
+        np.testing.assert_array_equal(r.output(), ref)
+    assert snap["counters"].get("serving.deadline_misses", 0) == 0
+    assert snap["counters"].get("serving.shed_requests", 0) == 0
+    router.assert_quiescent()
+    assert sum(1 for st in router.states.values() if st == DEAD) == 1
+    migs = [d for d in router.decisions if d["kind"] == "migrate"]
+    assert migs, "the killed engine had in-flight requests to migrate"
+    assert snap["counters"]["serving.router_migrated_requests"] == len(migs)
+
+
+# ---------------------------------------------------------------------------
+# typed errors carry engine_id
+# ---------------------------------------------------------------------------
+
+def test_serving_errors_carry_engine_id_backward_compatibly(model):
+    """Satellite contract: the typed serving errors carry the raising
+    engine's id; constructors stay backward-compatible (engine_id
+    defaults to None for pre-fleet callers)."""
+    for err in (AdmissionRejected("x"), DeadlineExceeded("x"),
+                EngineFault("x"), RestartBudgetExceeded("x")):
+        assert err.engine_id is None
+    cfg, params = model
+    eng = _engine(params, cfg)
+    with pytest.raises(InfeasibleRequest) as ei:
+        eng.submit(np.ones(5, np.int32), 1000)
+    assert ei.value.engine_id == eng.engine_id
+    eng.stop_admissions()
+    with pytest.raises(AdmissionRejected) as ei:
+        eng.submit(np.ones(5, np.int32), 4)
+    assert ei.value.engine_id == eng.engine_id
+    eng.admitting = True
+    req = eng.submit(np.ones(5, np.int32), 4, deadline_s=0.0)
+    eng.step()
+    assert isinstance(req.error, DeadlineExceeded)
+    assert req.error.engine_id == eng.engine_id
+    eng.drain()
+    eng.assert_quiescent()
+
+
+@pytest.mark.chaos
+def test_restart_budget_and_engine_fault_carry_engine_id(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    sup = EngineSupervisor(eng, restart_budget=RestartBudget(
+        max_restarts=0, window_s=3600.0))
+    sup.submit(np.ones(5, np.int32), 6)
+    with faults.active(FaultPlan([FaultSpec("serving:engine",
+                                            at_steps={2})])):
+        with pytest.raises(RestartBudgetExceeded) as ei:
+            sup.drain()
+    assert ei.value.engine_id == eng.engine_id
+    assert isinstance(ei.value.__cause__, EngineFault)
+    assert ei.value.__cause__.engine_id == eng.engine_id
+
+
+# ---------------------------------------------------------------------------
+# content_key: one owner for the trie's content hashing
+# ---------------------------------------------------------------------------
+
+def test_content_key_matches_trie_chain_sharing():
+    """Two prompts share a page-size content_key exactly when they would
+    share a full trie chain (identical page_chunks); the digest ignores
+    the uncacheable tail, and the page-free variant does not."""
+    rng = np.random.RandomState(0)
+    base = rng.randint(1, 1000, size=40).astype(np.int32)
+    same_chain = base.copy()
+    same_chain[-3:] = [1, 2, 3]          # tail differs, full pages agree
+    other = base.copy()
+    other[5] = base[5] + 1               # first full page differs
+    ps = 16
+    assert page_chunks(base, ps) == page_chunks(same_chain, ps)
+    assert content_key(base, ps) == content_key(same_chain, ps)
+    assert page_chunks(base, ps) != page_chunks(other, ps)
+    assert content_key(base, ps) != content_key(other, ps)
+    # without page_size the digest covers every token
+    assert content_key(base) != content_key(same_chain)
+    assert content_key(base) == content_key(list(map(int, base)))
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_explain_renders_fleet_router_section(model):
+    """The decision log's flight-ring copy renders as the ``fleet
+    router`` explain section — registry OFF, the postmortem reading."""
+    import thunder_tpu as tt
+    import jax.numpy as jnp
+
+    cfg, params = model
+    router = _fleet(params, cfg, 2)
+    for p in _prompts(cfg, (5, 9)):
+        router.submit(p, 4)
+    router.drain()
+    jf = tt.jit(lambda x: x * 2.0)
+    jf(jnp.ones(4))
+    report = observe.explain(jf)
+    assert "== fleet router ==" in report
+    section = report.split("== fleet router ==")[1]
+    assert "decisions: 2" in section
+    assert "least_loaded" in section
+
+
+# ---------------------------------------------------------------------------
+# marker audit (same contract as test_fleet / test_serving_supervisor)
+# ---------------------------------------------------------------------------
+
+def test_router_tests_stay_in_tier1():
+    """Marker audit: routing regressions must fail the gate that runs on
+    every PR, so nothing here may carry the slow marker."""
+    with open(__file__) as f:
+        src = f.read()
+    marker = "mark." + "slow"  # split so this line doesn't trip the scan
+    assert marker not in src, "router tests must stay in the tier-1 budget"

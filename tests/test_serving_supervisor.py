@@ -269,7 +269,8 @@ def test_serving_fault_injection_tests_carry_chaos_marker():
     needle = "faults." + "active("  # split so this audit doesn't flag itself
     for fname in ("test_serving.py", "test_serving_supervisor.py",
                   "test_flight.py", "test_prefix_cache.py",
-                  "test_serving_sampling.py", "test_fleet.py"):
+                  "test_serving_sampling.py", "test_fleet.py",
+                  "test_router.py"):
         with open(os.path.join(here, fname)) as f:
             src = f.read()
         tests = list(re.finditer(r"^\s*def (test_\w+)", src, re.M))

@@ -188,6 +188,70 @@ def _model_vs_measured_lines() -> list[str]:
     return out
 
 
+_ROUTER_MAX_DECISIONS = 8
+
+
+def _fleet_router_lines() -> list[str]:
+    """The fleet router's placement story, read from the ALWAYS-ON flight
+    ring (``serving_route_*`` events) — renders registry-off, so a
+    postmortem can answer "why did this request land on that engine".
+    Placement totals per engine/policy, failover migrations and
+    drain-time rebalances by request, fleet-edge rejections, then the
+    most recent decisions with the alternatives they rejected. Empty when
+    no router ever ran."""
+    from thunder_tpu.observe import flight as _flight
+
+    recs = [r for r in _flight.snapshot()
+            if r["type"] == "event"
+            and str(r.get("kind", "")).startswith("serving_route_")]
+    if not recs:
+        return []
+    out: list[str] = []
+    decisions = [r for r in recs if r["kind"] == "serving_route_decision"]
+    by_engine: dict[str, int] = {}
+    by_policy: dict[str, int] = {}
+    for r in decisions:
+        by_engine[r.get("engine", "?")] = by_engine.get(
+            r.get("engine", "?"), 0) + 1
+        by_policy[r.get("policy", "?")] = by_policy.get(
+            r.get("policy", "?"), 0) + 1
+    if decisions:
+        out.append(f"  decisions: {len(decisions)}  by engine: " + ", ".join(
+            f"{e} x{by_engine[e]}" for e in sorted(by_engine))
+            + "  by policy: " + ", ".join(
+                f"{p} x{by_policy[p]}" for p in sorted(by_policy)))
+    migrates = [r for r in recs if r["kind"] == "serving_route_migrate"]
+    for r in migrates:
+        out.append(f"  migrated: req {r.get('request', '?')} "
+                   f"{r.get('from_engine', '?')} -> {r.get('engine', '?')} "
+                   f"({r.get('generated', 0)} tokens generated, "
+                   f"restart {r.get('restarts', '?')})")
+    rebalances = [r for r in recs if r["kind"] == "serving_route_rebalance"]
+    if rebalances:
+        out.append("  rebalanced: " + ", ".join(
+            f"req {r.get('request', '?')} {r.get('from_engine', '?')}"
+            f"->{r.get('engine', '?')}" for r in rebalances))
+    rejects = [r for r in recs if r["kind"] == "serving_route_reject"]
+    if rejects:
+        out.append(f"  fleet-edge rejections: {len(rejects)}")
+    shown = decisions[-_ROUTER_MAX_DECISIONS:]
+    if len(decisions) > len(shown):
+        out.append(f"  (... {len(decisions) - len(shown)} earlier "
+                   f"decision(s) aged out of this view)")
+    for r in shown:
+        alts = r.get("alternatives") or []
+        rej = r.get("rejected") or {}
+        parts = [f"req {r.get('request', '?')} -> {r.get('engine', '?')} "
+                 f"[{r.get('policy', '?')}/{r.get('basis', '?')}]"]
+        if alts:
+            parts.append(f"over {', '.join(map(str, alts))}")
+        if rej:
+            parts.append("gated " + ", ".join(
+                f"{e}:{why}" for e, why in sorted(rej.items())))
+        out.append("  " + " ".join(parts))
+    return out
+
+
 def explain(jfn) -> str:
     """Return the textual report. The structured data behind it stays
     available on ``thunder_tpu.compile_stats(jfn)`` (``last_decisions``,
@@ -490,6 +554,15 @@ def explain(jfn) -> str:
                     if k in m:
                         parts.append(f"{short}={m[k]:g}")
                 lines.append(" ".join(parts))
+
+    # -- fleet router (flight recorder) --------------------------------------
+    # placement decisions, migrations, and rebalances from the always-on
+    # flight ring — "why did this request land on that engine", registry-off
+    router = _fleet_router_lines()
+    if router:
+        lines.append("")
+        lines.append("== fleet router ==")
+        lines.extend(router)
 
     # -- request timeline (flight recorder) ---------------------------------
     # sourced from the ALWAYS-ON flight ring, so it renders even when the

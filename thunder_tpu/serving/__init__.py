@@ -39,6 +39,13 @@ The layers (ROADMAP item 1 + the serving containment story):
   :class:`~health.FleetObservatory` aggregates N supervised engines
   (fleet SLO, merged explain section, cross-engine postmortems, statusz
   directory aggregation).
+- :mod:`thunder_tpu.serving.router` — one ``submit()``/``step()`` surface
+  over N supervised engines: health-gated, cache-affine, least-loaded
+  placement through a composable policy chain
+  (:class:`~router.FleetRouter`), a decision log for every placement,
+  failover re-admission of in-flight requests off dead engines
+  (token-identical, recompute-on-resume), and drain-time
+  :meth:`~router.FleetRouter.rebalance`.
 
 >>> from thunder_tpu.serving import EngineSupervisor, ServingEngine
 >>> eng = ServingEngine(params, cfg, max_slots=8, page_size=16,
@@ -79,7 +86,18 @@ from thunder_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
     PageGeometry,
 )
-from thunder_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from thunder_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixCache,
+    content_key,
+)
+from thunder_tpu.serving.router import (  # noqa: F401
+    FleetRouter,
+    HealthGate,
+    LeastLoaded,
+    PrefixAffinity,
+    RandomPlacement,
+    RoutingPolicy,
+)
 from thunder_tpu.serving.runner import PagedLlamaRunner  # noqa: F401
 from thunder_tpu.serving.sampling import (  # noqa: F401
     GREEDY,

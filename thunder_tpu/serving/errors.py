@@ -38,6 +38,13 @@ somebody". The hierarchy:
   typed instead of as an opaque XLA partitioner error. Subclasses
   ``ValueError`` too: it is a configuration bug.
 
+Every per-engine error above (admission, deadline, fault, restart budget)
+also carries ``engine_id`` so a fleet-level caller — the router, a
+postmortem bundle — can attribute the failure to the engine that raised
+it without string-parsing the message. ``engine_id`` is ``None`` when the
+rejection happened above any single engine (e.g. the router's own
+fleet-edge admission queue).
+
 :class:`RestartState` is not an error: it is the typed record of what a
 post-crash rebuild must reproduce — pool geometry, dtype, AND the mesh /
 sharding plan — carried on :class:`EngineFault` so the supervisor's
@@ -80,9 +87,11 @@ class AdmissionRejected(ServingError):
     """The engine refused (or revoked) admission for capacity/lifecycle
     reasons — draining, a full bounded queue, or priority shedding."""
 
-    def __init__(self, message: str, *, request_id: int | None = None):
+    def __init__(self, message: str, *, request_id: int | None = None,
+                 engine_id: str | None = None):
         super().__init__(message)
         self.request_id = request_id
+        self.engine_id = engine_id
 
 
 class InfeasibleRequest(AdmissionRejected, ValueError):
@@ -94,10 +103,12 @@ class DeadlineExceeded(ServingError):
     """The request's SLO deadline passed before completion."""
 
     def __init__(self, message: str, *, request_id: int | None = None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 engine_id: str | None = None):
         super().__init__(message)
         self.request_id = request_id
         self.deadline_s = deadline_s
+        self.engine_id = engine_id
 
 
 class EngineFault(ServingError):
@@ -107,10 +118,12 @@ class EngineFault(ServingError):
     recover. Carries the dispatch ``domain`` that escalated."""
 
     def __init__(self, message: str, *, domain: str = "",
-                 restart_state: RestartState | None = None):
+                 restart_state: RestartState | None = None,
+                 engine_id: str | None = None):
         super().__init__(message)
         self.domain = domain
         self.restart_state = restart_state
+        self.engine_id = engine_id
 
 
 class EngineStallError(ServingError):
@@ -126,10 +139,11 @@ class RestartBudgetExceeded(ServingError):
     """The supervisor's sliding-window restart budget is exhausted."""
 
     def __init__(self, message: str, *, in_window: int = 0,
-                 max_restarts: int = 0):
+                 max_restarts: int = 0, engine_id: str | None = None):
         super().__init__(message)
         self.in_window = in_window
         self.max_restarts = max_restarts
+        self.engine_id = engine_id
 
 
 class ShardingGeometryError(ServingError, ValueError):

@@ -57,4 +57,13 @@ EVENT_KINDS = frozenset({
     #                                 the breach reasons that drove it)
     "serving_fleet_postmortem",     # cross-engine bundle written: names the
     #                                 faulting engine, captures siblings
+    # fleet router (router.py)
+    "serving_route_decision",       # placement chosen: engine, policy,
+    #                                 basis, alternatives rejected
+    "serving_route_migrate",        # failover re-admission: in-flight
+    #                                 request moved off a dead engine
+    "serving_route_rebalance",      # queued request moved off a DRAINING
+    #                                 engine
+    "serving_route_reject",         # fleet-edge admission shed: no routable
+    #                                 engine, or the bounded queue overflowed
 })

@@ -39,8 +39,40 @@ Lifecycle (see :class:`~thunder_tpu.serving.kv_cache.PagedKVCache`):
 
 from __future__ import annotations
 
+import hashlib
+
 from thunder_tpu.observe import registry as _observe
 from thunder_tpu.serving.kv_cache import PagedKVCache
+
+
+def page_chunks(tokens, page_size: int) -> list[tuple]:
+    """THE owner of the trie's content addressing: the per-page token-id
+    tuples that key trie edges, capped at the last full page strictly
+    before the final token (the lookup/donate cap — the tail always
+    re-prefills, so it is never content-addressed). Both the trie walk
+    and :func:`content_key` derive from this, so an external consumer of
+    content keys (the fleet router's prefix affinity) can never drift
+    from the keys the trie itself uses."""
+    ps = page_size
+    n_full = (len(tokens) - 1) // ps
+    return [tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            for i in range(n_full)]
+
+
+def content_key(tokens, page_size: int | None = None) -> str:
+    """Stable content digest of a prompt prefix. With ``page_size``, the
+    digest covers exactly the :func:`page_chunks` the trie would key —
+    two prompts share a digest iff they would share a full trie chain.
+    Without it, the digest covers the raw token ids (useful for whole-
+    prompt identity). The fleet router hashes this to pin a shared
+    prefix to one engine deterministically."""
+    if page_size is not None:
+        flat = [t for chunk in page_chunks(tokens, page_size)
+                for t in chunk]
+    else:
+        flat = [int(t) for t in tokens]
+    payload = ",".join(str(t) for t in flat).encode()
+    return hashlib.blake2b(payload, digest_size=8).hexdigest()
 
 
 class _Node:
@@ -86,12 +118,9 @@ class PrefixCache:
         the request always prefills at least its tail (the rows the first
         decode step needs must exist, and a zero-work prefill has no
         program to run). Pair with :meth:`claim` once admission commits."""
-        ps = self.page_size
-        max_pages = (len(tokens) - 1) // ps
         chain: list[int] = []
         level = self._root
-        for i in range(max_pages):
-            key = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+        for key in page_chunks(tokens, self.page_size):
             node = level.get(key)
             if node is None:
                 break
@@ -144,11 +173,9 @@ class PrefixCache:
         page holds one unwritten row and caching it would hand garbage
         K/V to every future prefix hit. Symmetric with
         :meth:`lookup`'s cap."""
-        ps = self.page_size
-        n_full = min((len(tokens) - 1) // ps, len(pages))
+        chunks = page_chunks(tokens, self.page_size)[:len(pages)]
         level, parent, added = self._root, None, 0
-        for i in range(n_full):
-            key = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+        for i, key in enumerate(chunks):
             node = level.get(key)
             if node is None:
                 node = _Node(pages[i], parent, key)

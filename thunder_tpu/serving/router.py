@@ -1,0 +1,456 @@
+"""Fleet router: health-aware, cache-affine request placement across N
+supervised engines.
+
+The paper's thesis — a trace compiler should dispatch each region to
+whichever executor serves it best — recurs one level up at pod scale:
+which *engine* should serve this request. :class:`FleetRouter` fronts N
+:class:`~thunder_tpu.serving.supervisor.EngineSupervisor`\\ s behind one
+``submit()``/``step()`` surface and makes placement a first-class,
+observable, cost-scored decision:
+
+- **Routing policies** are pluggable and composable: the router walks its
+  policy chain in order — each policy may *narrow* the candidate set
+  (:meth:`RoutingPolicy.filter`) and/or *pick* an engine
+  (:meth:`RoutingPolicy.pick`); the first pick wins. The default chain is
+  :class:`HealthGate` (never route to a DEGRADED/DRAINING/DEAD engine —
+  the :mod:`~thunder_tpu.serving.health` state machine's verdicts are the
+  gate), :class:`PrefixAffinity` (prefer the engine whose prefix-cache
+  trie is warm for this prompt; when the whole fleet is cold, pin the
+  prefix to one engine by hashing its
+  :func:`~thunder_tpu.serving.prefix_cache.content_key` so the NEXT
+  request with the same prefix lands warm), then :class:`LeastLoaded`
+  (fewest waiting requests, most free KV pages — the same quantities the
+  labeled ``serving.queue_depth`` / ``serving.kv_pages_free`` gauges
+  publish). Affinity abstains when honoring it would breach its
+  load-imbalance bound, falling back to least-loaded.
+- **Every decision is logged**: the engine chosen, the policy that chose
+  it, its score inputs, and every alternative rejected (with why) land in
+  :attr:`FleetRouter.decisions` and in the always-on flight ring as
+  ``serving_route_decision`` events — ``observe.explain()`` renders them
+  as the "fleet router" section, alive even with the registry disabled.
+- **Failover re-admission**: when an engine exhausts its restart budget
+  (:class:`~thunder_tpu.serving.errors.RestartBudgetExceeded` out of a
+  supervised step — the health plane's terminal DEAD verdict), the router
+  rebuilds the dead engine's pools, extracts every in-flight request, and
+  re-admits each on a healthy sibling via the existing recompute-on-
+  resume discipline (prompt + generated tokens re-prefill), so surviving
+  outputs stay token-identical to an undisturbed run. The DEAD
+  transition's cross-engine postmortem bundle embeds the flight ring —
+  which names every migrated request in its ``serving_route_migrate``
+  events.
+- **Drain/rebalance**: :meth:`rebalance` migrates *queued* (not
+  resident) requests off engines the health plane reports DRAINING, and
+  fleet-edge admission applies the SLO machinery — priorities against a
+  fleet-wide bounded queue — *before* picking an engine, so overload
+  sheds once at the router instead of ping-ponging per-engine
+  rejections.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from thunder_tpu.observe import registry as _observe
+from thunder_tpu.serving.errors import (
+    AdmissionRejected,
+    RestartBudgetExceeded,
+)
+from thunder_tpu.serving.health import DEAD, DRAINING, HEALTHY, FleetObservatory
+from thunder_tpu.serving.prefix_cache import content_key
+from thunder_tpu.serving.scheduler import Request
+
+
+class RoutingPolicy:
+    """One link of the router's policy chain. ``filter`` narrows the
+    candidate set (gates); ``pick`` chooses an engine or abstains with
+    ``None`` (scorers). Both receive the router so they can read engine
+    state; both return a notes dict that lands verbatim in the decision
+    log — a policy that abstains or rejects must say why."""
+
+    name = "policy"
+
+    def filter(self, router: "FleetRouter", candidates: list[str],
+               prompt, priority: int):
+        """Return ``(kept, rejected)`` where ``rejected`` maps engine_id
+        to the reason it left the candidate set."""
+        return candidates, {}
+
+    def pick(self, router: "FleetRouter", candidates: list[str],
+             prompt, priority: int):
+        """Return ``(engine_id | None, notes)`` — ``None`` abstains and
+        the chain continues."""
+        return None, {}
+
+
+class HealthGate(RoutingPolicy):
+    """Admit only engines the health plane currently calls HEALTHY — a
+    DEGRADED engine is shedding breaches, a DRAINING one refuses
+    admissions anyway, and a DEAD one is terminal. Uses the router's
+    cached verdicts (refreshed every ``step()``), so gating reads the
+    same state machine statusz and postmortems report."""
+
+    name = "health_gate"
+
+    def filter(self, router, candidates, prompt, priority):
+        kept, rejected = [], {}
+        for eid in candidates:
+            state = router.states.get(eid, HEALTHY)
+            if state == HEALTHY:
+                kept.append(eid)
+            else:
+                rejected[eid] = state
+        return kept, rejected
+
+
+class PrefixAffinity(RoutingPolicy):
+    """Cache-affine placement: prefer the engine whose prefix trie is
+    warm for this prompt (most cached prefix tokens, via the same
+    ``lookup`` the admission path runs). When every trie is cold, pin the
+    prompt's :func:`content_key` digest to one engine so repeats of the
+    same prefix concentrate instead of spraying — warm-TTFT is a
+    placement outcome, not luck. Abstains (falls back to the next policy)
+    when the preferred engine already has ``imbalance_bound`` more
+    waiting requests than the least-loaded candidate: affinity is a
+    performance preference, not a load-balancing override."""
+
+    name = "prefix_affinity"
+
+    def __init__(self, imbalance_bound: int = 4):
+        self.imbalance_bound = int(imbalance_bound)
+
+    def pick(self, router, candidates, prompt, priority):
+        cached = [eid for eid in candidates
+                  if router.engines[eid].prefix is not None]
+        if not cached:
+            return None, {"abstain": "no prefix caches in fleet"}
+        page_size = router.engines[cached[0]].geom.page_size
+        if (len(prompt) - 1) // page_size < 1:
+            # shorter than one full page: the trie can never cache it, so
+            # neither warmth nor pinning applies — load balance instead
+            return None, {"abstain": "no cacheable prefix pages"}
+        warm = {}
+        for eid in cached:
+            trie = router.engines[eid].prefix
+            warm[eid] = len(trie.lookup(prompt)) * trie.page_size
+        digest = content_key(prompt, page_size=page_size)
+        best = max(cached, key=lambda e: warm[e])
+        if warm[best] > 0:
+            target, basis = best, "warm_hit"
+        else:
+            target = sorted(cached)[int(digest, 16) % len(cached)]
+            basis = "hash_pin"
+        loads = {eid: router.load(eid) for eid in candidates}
+        notes = {"basis": basis, "warm_tokens": warm, "digest": digest,
+                 "load": loads}
+        if loads[target] - min(loads.values()) > self.imbalance_bound:
+            notes["abstain"] = (
+                f"imbalance: {target} load {loads[target]} exceeds "
+                f"min {min(loads.values())} by more than "
+                f"{self.imbalance_bound}")
+            return None, notes
+        return target, notes
+
+
+class LeastLoaded(RoutingPolicy):
+    """Terminal fallback: fewest waiting requests (queue depth + resident
+    slots), ties broken by most free KV pages — the quantities the
+    engine-labeled ``serving.queue_depth`` / ``serving.active_requests`` /
+    ``serving.kv_pages_free`` gauges publish, read straight off the
+    engines so the decision works with the registry disabled."""
+
+    name = "least_loaded"
+
+    def pick(self, router, candidates, prompt, priority):
+        scores = {eid: {"load": router.load(eid),
+                        "kv_pages_free": router.engines[eid].cache.pages_free}
+                  for eid in candidates}
+        target = min(candidates,
+                     key=lambda e: (scores[e]["load"],
+                                    -scores[e]["kv_pages_free"], e))
+        return target, {"scores": scores}
+
+
+class RandomPlacement(RoutingPolicy):
+    """Seeded uniform-random placement — the control arm benchmarks
+    compare affinity routing against. Never use it in a real chain."""
+
+    name = "random"
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.RandomState(seed)
+
+    def pick(self, router, candidates, prompt, priority):
+        return candidates[int(self._rng.randint(len(candidates)))], {}
+
+
+class FleetRouter:
+    """One ``submit()``/``step()`` surface over N supervised engines.
+
+    ``supervisors`` is the fleet; a shared
+    :class:`~thunder_tpu.serving.health.FleetObservatory` is created (or
+    passed via ``observatory=``) so routing, statusz, and postmortems all
+    read the same health verdicts. ``max_queue`` bounds the TOTAL queued
+    requests across the fleet at the router edge: overflow sheds the
+    fleet-wide lowest-priority queued request (or rejects the newcomer if
+    nothing queued is lower), once, before any engine is picked.
+    """
+
+    def __init__(self, supervisors, *, policies=None,
+                 observatory: FleetObservatory | None = None,
+                 max_queue: int | None = None, decision_log: int = 256):
+        sups = list(supervisors)
+        if not sups:
+            raise ValueError("FleetRouter needs at least one supervisor")
+        self.fleet = observatory if observatory is not None \
+            else FleetObservatory()
+        for sup in sups:
+            if sup.engine.engine_id not in self.fleet.supervisors:
+                self.fleet.add(sup)
+        self.sups = {s.engine.engine_id: s for s in sups}
+        self.engines = {eid: s.engine for eid, s in self.sups.items()}
+        self.policies = list(policies) if policies is not None else \
+            [HealthGate(), PrefixAffinity(), LeastLoaded()]
+        self.max_queue = max_queue
+        self.decisions: deque = deque(maxlen=decision_log)
+        self._decision_seq = 0
+        self.states = self.fleet.check()
+
+    # -- state reads --------------------------------------------------------
+    def load(self, engine_id: str) -> int:
+        """Waiting requests on one engine: queued + resident."""
+        eng = self.engines[engine_id]
+        return len(eng.queue) + eng.active_requests
+
+    @property
+    def idle(self) -> bool:
+        return all(e.idle for e in self.engines.values())
+
+    @property
+    def completed(self) -> list[Request]:
+        """Completion-ordered union of every engine's completed list."""
+        done = [r for e in self.engines.values() for r in e.completed]
+        return sorted(done, key=lambda r: r.finished_s or 0.0)
+
+    def assert_quiescent(self) -> None:
+        for eng in self.engines.values():
+            eng.assert_quiescent()
+
+    # -- placement ----------------------------------------------------------
+    def _route(self, prompt, priority: int, exclude=()):
+        """Walk the policy chain. Returns ``(engine_id | None, record)``
+        — ``None`` means no candidate survived (the record still says
+        which policy rejected whom)."""
+        candidates = sorted(eid for eid in self.sups if eid not in exclude)
+        record = {"rejected": {eid: "excluded" for eid in exclude
+                               if eid in self.sups},
+                  "policies": []}
+        for policy in self.policies:
+            candidates, rejected = policy.filter(
+                self, candidates, prompt, priority)
+            record["rejected"].update(rejected)
+            if not candidates:
+                record["policies"].append({"policy": policy.name,
+                                           "exhausted": True})
+                return None, record
+            choice, notes = policy.pick(self, candidates, prompt, priority)
+            record["policies"].append(
+                {"policy": policy.name, **notes})
+            if choice is not None:
+                record["engine"] = choice
+                record["policy"] = policy.name
+                record["basis"] = notes.get("basis", policy.name)
+                record["alternatives"] = [e for e in candidates
+                                          if e != choice]
+                return choice, record
+        # every policy abstained (a gate-only chain): first survivor wins
+        record["engine"] = candidates[0]
+        record["policy"] = "first_routable"
+        record["basis"] = "first_routable"
+        record["alternatives"] = candidates[1:]
+        return candidates[0], record
+
+    def _log_decision(self, kind: str, record: dict, request_id=None,
+                      **extra) -> dict:
+        self._decision_seq += 1
+        entry = {"seq": self._decision_seq, "kind": kind,
+                 "request": request_id, **record, **extra}
+        self.decisions.append(entry)
+        return entry
+
+    def _shed_for_capacity(self, priority: int) -> None:
+        """Fleet-edge bounded queue: applied BEFORE any engine is picked.
+        Raises (typed, engine_id=None — the rejection happened above any
+        single engine) when the newcomer loses; otherwise sheds the
+        fleet-wide lowest-priority queued request in place."""
+        if self.max_queue is None:
+            return
+        queued = [(r, eid) for eid, eng in self.engines.items()
+                  for r in eng.queue]
+        if len(queued) < self.max_queue:
+            return
+        victim, victim_eid = min(
+            queued, key=lambda rq: (rq[0].priority, -rq[0].request_id)) \
+            if queued else (None, None)
+        _observe.inc("serving.router_rejections")
+        if victim is None or victim.priority >= priority:
+            _observe.event("serving_route_reject", priority=priority,
+                           fleet_queued=len(queued),
+                           max_queue=self.max_queue)
+            self._log_decision("reject", {"fleet_queued": len(queued),
+                                          "max_queue": self.max_queue,
+                                          "priority": priority})
+            raise AdmissionRejected(
+                f"fleet admission queue full ({self.max_queue}) and every "
+                f"queued request has priority >= {priority}",
+                engine_id=None)
+        _observe.event("serving_route_reject", request=victim.request_id,
+                       engine=victim_eid, priority=victim.priority,
+                       shed_for_priority=priority,
+                       fleet_queued=len(queued))
+        self._log_decision("reject", {"engine": victim_eid,
+                                      "shed_for_priority": priority},
+                           request_id=victim.request_id)
+        self.engines[victim_eid]._shed(victim, AdmissionRejected(
+            f"request {victim.request_id} (priority {victim.priority}) "
+            f"shed from the fleet admission queue for a higher-priority "
+            f"arrival", request_id=victim.request_id,
+            engine_id=victim_eid))
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               **kwargs) -> Request:
+        """Route one request: fleet-edge SLO admission first (bounded
+        queue + priorities — overload sheds HERE, once), then the policy
+        chain picks an engine and the request enters that engine's
+        ordinary admission path (deadline enforcement included). Raises
+        ``AdmissionRejected(engine_id=None)`` when no routable engine
+        exists."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._shed_for_capacity(priority)
+        eid, record = self._route(prompt, priority)
+        if eid is None:
+            _observe.inc("serving.router_rejections")
+            _observe.event("serving_route_reject", priority=priority,
+                           rejected=record["rejected"])
+            self._log_decision("reject", record)
+            raise AdmissionRejected(
+                f"no routable engine: {record['rejected']}", engine_id=None)
+        req = self.sups[eid].submit(prompt, max_new_tokens,
+                                    priority=priority, **kwargs)
+        self._log_decision("route", record, request_id=req.request_id)
+        _observe.inc("serving.router_decisions")
+        if record["policy"] == "prefix_affinity" \
+                and record["basis"] == "warm_hit":
+            _observe.inc("serving.router_affinity_hits")
+        _observe.event("serving_route_decision", request=req.request_id,
+                       engine=eid, policy=record["policy"],
+                       basis=record["basis"],
+                       alternatives=record["alternatives"],
+                       rejected=record["rejected"])
+        return req
+
+    # -- fleet stepping -----------------------------------------------------
+    def step(self) -> bool:
+        """One fleet iteration: step every non-DEAD engine; an engine
+        whose restart budget is exhausted mid-step fails over (its
+        in-flight requests migrate to healthy siblings) instead of
+        crashing the fleet; finish with one health sweep so routing's
+        verdicts are at most a step stale."""
+        worked = False
+        for eid in sorted(self.sups):
+            if self.states.get(eid) == DEAD:
+                continue
+            try:
+                worked = self.sups[eid].step() or worked
+            except RestartBudgetExceeded as e:
+                self._failover(eid, e)
+                worked = True
+        self.states = self.fleet.check()
+        return worked
+
+    def drain(self, max_steps: int = 1_000_000) -> list[Request]:
+        """Step the fleet until every engine is idle. Returns completed
+        requests fleet-wide in completion order."""
+        for _ in range(max_steps):
+            if self.idle:
+                break
+            self.step()
+        return self.completed
+
+    def _failover(self, engine_id: str, cause: RestartBudgetExceeded):
+        """Failover re-admission: the refused restart left ``engine_id``
+        with consumed pools and stranded residents. Rebuild its pools
+        (``rebuild_after_fault`` — the same recompute-on-resume reset the
+        supervisor's restart rung uses, so token identity is inherited,
+        and the dead engine ends quiescent), then re-route every
+        in-flight request to a healthy sibling. The health sweep that
+        follows records the DEAD transition and auto-dumps the
+        cross-engine postmortem — whose flight ring names every migrated
+        request. Raises ``cause`` when no sibling is routable (the
+        failure must escalate, not strand requests silently)."""
+        eng = self.engines[engine_id]
+        eng.rebuild_after_fault()      # residents -> queue, fresh pools
+        victims = list(eng.queue)
+        migrated = []
+        for req in victims:
+            target, record = self._route(req.work_prompt, req.priority,
+                                         exclude=(engine_id,))
+            if target is None:
+                break
+            eng.queue.remove(req)
+            self.engines[target].queue.append(req)
+            migrated.append(req)
+            self._log_decision("migrate", record,
+                               request_id=req.request_id,
+                               from_engine=engine_id)
+            _observe.inc("serving.router_migrated_requests")
+            _observe.event("serving_route_migrate", request=req.request_id,
+                           from_engine=engine_id, engine=target,
+                           generated=len(req.generated),
+                           restarts=req.restarts, cause=repr(cause))
+        self.states = self.fleet.check()   # DEAD transition + postmortem
+        if len(migrated) < len(victims):
+            raise cause
+
+    # -- drain / rebalance --------------------------------------------------
+    def rebalance(self) -> list[Request]:
+        """Migrate queued (not resident) requests off every DRAINING
+        engine onto routable siblings — residents keep their KV and
+        finish where they are; queued requests have no device state, so
+        moving them is free. Requests with no routable target stay put
+        (the drain's own deadline machinery decides their fate)."""
+        self.states = self.fleet.check()
+        moved = []
+        for eid in sorted(self.sups):
+            if self.states.get(eid) != DRAINING:
+                continue
+            eng = self.engines[eid]
+            for req in list(eng.queue):
+                target, record = self._route(req.work_prompt, req.priority,
+                                             exclude=(eid,))
+                if target is None:
+                    break
+                eng.queue.remove(req)
+                self.engines[target].queue.append(req)
+                moved.append(req)
+                self._log_decision("rebalance", record,
+                                   request_id=req.request_id,
+                                   from_engine=eid)
+                _observe.inc("serving.router_rebalanced_requests")
+                _observe.event("serving_route_rebalance",
+                               request=req.request_id, from_engine=eid,
+                               engine=target, priority=req.priority)
+        return moved
+
+    def describe(self) -> dict:
+        """Router state for statusz/postmortem embedding: health verdicts,
+        per-engine load, and the decision log tail."""
+        return {
+            "engines": {eid: {"state": self.states.get(eid),
+                              "load": self.load(eid),
+                              "kv_pages_free": eng.cache.pages_free}
+                        for eid, eng in self.engines.items()},
+            "max_queue": self.max_queue,
+            "decisions": list(self.decisions)[-16:],
+        }

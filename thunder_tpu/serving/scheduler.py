@@ -377,7 +377,8 @@ class ServingEngine:
         if total > self.max_context:
             raise InfeasibleRequest(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the engine context window ({self.max_context})")
+                f"exceeds the engine context window ({self.max_context})",
+                engine_id=self.engine_id)
         # worst-case page footprint: the larger of the final context and the
         # chunk-PADDED prefill high-water mark (the last chunk rounds up to
         # a ladder size, which can transiently need more pages than the
@@ -387,7 +388,7 @@ class ServingEngine:
             raise InfeasibleRequest(
                 f"request needs up to {self.geom.pages_for(worst)} KV pages; "
                 f"the pool only has {self.cache.pages_total} — enlarge "
-                f"num_pages")
+                f"num_pages", engine_id=self.engine_id)
         now = time.perf_counter()
 
         def new_request(sp: SamplingParams, parent=None) -> Request:
@@ -418,7 +419,8 @@ class ServingEngine:
         if not self.admitting:
             err = AdmissionRejected(
                 f"request {req.request_id} rejected: engine is draining, "
-                f"admissions are stopped", request_id=req.request_id)
+                f"admissions are stopped", request_id=req.request_id,
+                engine_id=self.engine_id)
             self._shed(req, err)
             raise err
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
@@ -431,13 +433,15 @@ class ServingEngine:
                 err = AdmissionRejected(
                     f"request {req.request_id} rejected: admission queue "
                     f"full ({self.max_queue}) and every queued request has "
-                    f"priority >= {req.priority}", request_id=req.request_id)
+                    f"priority >= {req.priority}", request_id=req.request_id,
+                    engine_id=self.engine_id)
                 self._shed(req, err)
                 raise err
             self._shed(victim, AdmissionRejected(
                 f"request {victim.request_id} (priority {victim.priority}) "
                 f"shed from the full admission queue for higher-priority "
-                f"request {req.request_id}", request_id=victim.request_id))
+                f"request {req.request_id}", request_id=victim.request_id,
+                engine_id=self.engine_id))
         self.queue.append(req)
         self._gauges()
         return req
@@ -520,7 +524,7 @@ class ServingEngine:
         for req in victims:
             self._shed(req, DeadlineExceeded(
                 f"request {req.request_id} shed: {reason}",
-                request_id=req.request_id))
+                request_id=req.request_id, engine_id=self.engine_id))
         return victims
 
     def rebuild_after_fault(self, restart_state: RestartState | None = None) \
@@ -712,7 +716,8 @@ class ServingEngine:
                 f"request {req.request_id} missed its deadline "
                 f"({req.deadline_at - req.submitted_s:.3f}s) in state "
                 f"{req.state}", request_id=req.request_id,
-                deadline_s=req.deadline_at - req.submitted_s))
+                deadline_s=req.deadline_at - req.submitted_s,
+                engine_id=self.engine_id))
         return bool(expired)
 
     def _shed(self, req: Request, error: BaseException) -> None:
@@ -737,7 +742,7 @@ class ServingEngine:
             self._shed(clone, kind(
                 f"request {clone.request_id} shed with its fork primary "
                 f"{req.request_id} ({type(error).__name__})",
-                request_id=clone.request_id))
+                request_id=clone.request_id, engine_id=self.engine_id))
         req.fork_pending = []
         self._phase_end(req, reason=type(error).__name__)
         req.state = SHED
@@ -865,7 +870,8 @@ class ServingEngine:
                     f"{domain} dispatch consumed the donated page pools; "
                     f"in-place retry is impossible — supervisor restart "
                     f"(pool rebuild + re-prefill) required", domain=domain,
-                    restart_state=self._restart_state) from e
+                    restart_state=self._restart_state,
+                    engine_id=self.engine_id) from e
             raise
 
     def _prefill_one(self) -> bool:
@@ -1193,7 +1199,8 @@ class ServingEngine:
                     f"request {clone.request_id} shed: fork primary "
                     f"{req.request_id} finished before the clone could "
                     f"fork and the admission queue is full "
-                    f"({self.max_queue})", request_id=clone.request_id))
+                    f"({self.max_queue})", request_id=clone.request_id,
+                    engine_id=self.engine_id))
             else:
                 self.queue.appendleft(clone)
         req.fork_pending = []

@@ -372,7 +372,8 @@ class EngineSupervisor:
                 f"engine restart budget exhausted "
                 f"({self.budget.describe()}); last fault: {cause!r}",
                 in_window=self.budget.in_window,
-                max_restarts=self.budget.max_restarts)
+                max_restarts=self.budget.max_restarts,
+                engine_id=self.engine.engine_id)
             self.dump_postmortem(err)
             raise err from cause
         t0 = time.perf_counter()
